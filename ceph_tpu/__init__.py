@@ -1,0 +1,10 @@
+"""ceph_tpu: a TPU-native erasure-coding framework.
+
+From-scratch implementation of the capabilities of Ceph's erasure-code
+subsystem (reference: justincmoy/ceph 13.0.1, src/erasure-code/), redesigned
+TPU-first: codec math is expressed as GF(2) / GF(2^w) matrix products that
+run on the MXU via XLA and Pallas, with bit-exact CPU oracles and the
+reference's plugin/benchmark/test surface around them.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,77 @@
+"""GF(2) bit-matrix utilities (jerasure bitmatrix convention).
+
+A w-bit field element e expands to a w x w 0/1 matrix over GF(2): column x is
+the bit-decomposition of e * 2^x (bit l of that product sits at row l).  A
+k x m element matrix expands to an (m*w) x (k*w) bitmatrix; bitmatrix codes
+(cauchy, liberation family) encode by XORing data *packets* selected by the
+rows (reference: jerasure_matrix_to_bitmatrix /jerasure_schedule_encode call
+sites at src/erasure-code/jerasure/ErasureCodeJerasure.cc:298-302,259-261).
+
+This bit-level view is also exactly what the TPU engine executes: a GF(2)
+matmul on the MXU (see ceph_tpu/ops/xla_gf.py).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ops.gf import gf
+
+
+def element_bitmatrix(e: int, w: int) -> np.ndarray:
+    """w x w bitmatrix of multiply-by-e: out[l, x] = bit l of (e * 2^x)."""
+    F = gf(w)
+    B = np.zeros((w, w), dtype=np.uint8)
+    v = e
+    for x in range(w):
+        for l in range(w):
+            B[l, x] = (v >> l) & 1
+        v = F.mul(v, 2)
+    return B
+
+
+def matrix_to_bitmatrix(M: np.ndarray, w: int) -> np.ndarray:
+    """Expand an m x k element matrix into an (m*w) x (k*w) GF(2) bitmatrix."""
+    m, k = M.shape
+    B = np.zeros((m * w, k * w), dtype=np.uint8)
+    for i in range(m):
+        for j in range(k):
+            B[i * w : (i + 1) * w, j * w : (j + 1) * w] = element_bitmatrix(
+                int(M[i, j]), w
+            )
+    return B
+
+
+def n_ones(e: int, w: int) -> int:
+    """Number of ones in the bitmatrix of e (jerasure cauchy_n_ones)."""
+    F = gf(w)
+    total = 0
+    v = e
+    for _ in range(w):
+        total += bin(v).count("1")
+        v = F.mul(v, 2)
+    return total
+
+
+def invert_bitmatrix(B: np.ndarray) -> np.ndarray:
+    """Invert a square 0/1 matrix over GF(2) (Gauss-Jordan with XOR rows)."""
+    B = B.astype(np.uint8).copy()
+    n = B.shape[0]
+    assert B.shape == (n, n)
+    inv = np.eye(n, dtype=np.uint8)
+    for col in range(n):
+        pivot = -1
+        for r in range(col, n):
+            if B[r, col]:
+                pivot = r
+                break
+        if pivot < 0:
+            raise np.linalg.LinAlgError("singular bitmatrix")
+        if pivot != col:
+            B[[col, pivot]] = B[[pivot, col]]
+            inv[[col, pivot]] = inv[[pivot, col]]
+        for r in range(n):
+            if r != col and B[r, col]:
+                B[r, :] ^= B[col, :]
+                inv[r, :] ^= inv[col, :]
+    return inv

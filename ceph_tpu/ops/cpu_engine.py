@@ -1,0 +1,190 @@
+"""CPU reference codec engine: matrix and bitmatrix (packetized) encode/decode.
+
+Numpy reimplementation of the jerasure compute semantics the reference plugins
+drive (reference call sites: src/erasure-code/jerasure/ErasureCodeJerasure.cc:
+151-165 jerasure_matrix_encode/decode, :255-270 jerasure_schedule_encode /
+jerasure_schedule_decode_lazy).  This is the bit-exactness oracle for the TPU
+engine and the fallback when no device is attached.
+
+Semantics notes:
+* matrix codes (w in {8,16,32}): a chunk is a dense little-endian array of
+  w-bit words; coding[i] = XOR_j (matrix[i,j] * data[j]) elementwise over
+  words.
+* bitmatrix codes: a chunk is S super-packets, each w packet-rows of
+  `packetsize` bytes; coding packet-row (i,l) = XOR of data packet-rows (j,x)
+  selected by bitmatrix row i*w+l.  Chunk size must be a multiple of
+  w*packetsize (the reference guarantees this via get_alignment, see
+  ErasureCodeJerasure.cc:272-286).
+* decode recovers erased data chunks by inverting the surviving submatrix and
+  then re-encodes erased coding chunks; recovered bytes are the unique
+  solution, hence bit-identical to any other correct evaluation order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ceph_tpu.ops.gf import gf
+from ceph_tpu.matrices.bitmatrix import invert_bitmatrix
+
+
+def _as_words(chunk: np.ndarray, w: int) -> np.ndarray:
+    dtype = {8: np.uint8, 16: np.uint16, 32: np.uint32}[w]
+    return chunk.view(dtype)
+
+
+def matrix_encode(matrix: np.ndarray, data: np.ndarray, w: int) -> np.ndarray:
+    """data: [k, size] uint8 -> coding [m, size] uint8."""
+    F = gf(w)
+    m, k = matrix.shape
+    assert data.shape[0] == k
+    size = data.shape[1]
+    assert size % (w // 8) == 0, "chunk size must be a multiple of w/8"
+    words = _as_words(data, w)  # [k, size/(w/8)]
+    out = np.zeros((m, words.shape[1]), dtype=words.dtype)
+    for i in range(m):
+        acc = out[i]
+        for j in range(k):
+            c = int(matrix[i, j])
+            if c:
+                acc ^= F.mul_region(c, words[j])
+    return out.view(np.uint8)
+
+
+def matrix_decode(
+    matrix: np.ndarray,
+    chunks: dict[int, np.ndarray],
+    k: int,
+    m: int,
+    w: int,
+    size: int,
+) -> dict[int, np.ndarray]:
+    """Recover all erased chunks given surviving `chunks` {id: [size] uint8}.
+
+    Returns a dict holding every chunk 0..k+m-1 (survivors pass through).
+    """
+    F = gf(w)
+    available = sorted(chunks.keys())
+    erased = [i for i in range(k + m) if i not in chunks]
+    if not erased:
+        return dict(chunks)
+    if len(available) < k:
+        raise ValueError("not enough chunks to decode")
+    out = {i: np.asarray(chunks[i], dtype=np.uint8) for i in available}
+
+    erased_data = [e for e in erased if e < k]
+    if erased_data:
+        # rows of the generator matrix for the first k surviving chunks
+        sel = available[:k]
+        A = np.zeros((k, k), dtype=np.uint32)
+        for r, cid in enumerate(sel):
+            if cid < k:
+                A[r, cid] = 1
+            else:
+                A[r, :] = matrix[cid - k, :]
+        inv = F.mat_invert(A)
+        words = np.stack([_as_words(out[cid], w) for cid in sel])
+        for e in erased_data:
+            acc = np.zeros(words.shape[1], dtype=words.dtype)
+            for r in range(k):
+                c = int(inv[e, r])
+                if c:
+                    acc ^= F.mul_region(c, words[r])
+            out[e] = acc.view(np.uint8)
+
+    data = np.stack([out[j] for j in range(k)])
+    for e in erased:
+        if e >= k:
+            out[e] = matrix_encode(matrix[e - k : e - k + 1, :], data, w)[0]
+    return out
+
+
+# ---- bitmatrix (packetized) codes ----------------------------------------
+
+
+def _to_packet_rows(data: np.ndarray, w: int, packetsize: int) -> np.ndarray:
+    """[k, size] bytes -> [k*w, S, packetsize] packet rows."""
+    k, size = data.shape
+    assert size % (w * packetsize) == 0, (
+        f"chunk size {size} must be a multiple of w*packetsize={w * packetsize}"
+    )
+    s = size // (w * packetsize)
+    return (
+        data.reshape(k, s, w, packetsize)
+        .transpose(0, 2, 1, 3)
+        .reshape(k * w, s, packetsize)
+    )
+
+
+def _from_packet_rows(rows: np.ndarray, w: int, packetsize: int) -> np.ndarray:
+    """[n*w, S, packetsize] -> [n, size] bytes."""
+    nw, s, p = rows.shape
+    n = nw // w
+    return (
+        rows.reshape(n, w, s, p).transpose(0, 2, 1, 3).reshape(n, s * w * p)
+    )
+
+
+def bitmatrix_encode(
+    bitmatrix: np.ndarray, data: np.ndarray, w: int, packetsize: int
+) -> np.ndarray:
+    """bitmatrix: [m*w, k*w]; data: [k, size] -> coding [m, size]."""
+    mw = bitmatrix.shape[0]
+    rows = _to_packet_rows(data, w, packetsize)  # [k*w, S, P]
+    out = np.zeros((mw,) + rows.shape[1:], dtype=np.uint8)
+    for r in range(mw):
+        idx = np.nonzero(bitmatrix[r])[0]
+        if len(idx):
+            out[r] = np.bitwise_xor.reduce(rows[idx], axis=0)
+    return _from_packet_rows(out, w, packetsize)
+
+
+def bitmatrix_decode(
+    bitmatrix: np.ndarray,
+    chunks: dict[int, np.ndarray],
+    k: int,
+    m: int,
+    w: int,
+    size: int,
+    packetsize: int,
+) -> dict[int, np.ndarray]:
+    """Recover all erased chunks for a bitmatrix code."""
+    available = sorted(chunks.keys())
+    erased = [i for i in range(k + m) if i not in chunks]
+    if not erased:
+        return dict(chunks)
+    if len(available) < k:
+        raise ValueError("not enough chunks to decode")
+    out = {i: np.asarray(chunks[i], dtype=np.uint8) for i in available}
+
+    erased_data = [e for e in erased if e < k]
+    if erased_data:
+        sel = available[:k]
+        A = np.zeros((k * w, k * w), dtype=np.uint8)
+        for r, cid in enumerate(sel):
+            if cid < k:
+                A[r * w : (r + 1) * w, cid * w : (cid + 1) * w] = np.eye(
+                    w, dtype=np.uint8
+                )
+            else:
+                A[r * w : (r + 1) * w, :] = bitmatrix[
+                    (cid - k) * w : (cid - k + 1) * w, :
+                ]
+        inv = invert_bitmatrix(A)
+        srows = np.concatenate(
+            [_to_packet_rows(out[cid][None, :], w, packetsize) for cid in sel]
+        )  # [k*w, S, P]
+        for e in erased_data:
+            rec = np.zeros((w,) + srows.shape[1:], dtype=np.uint8)
+            for l in range(w):
+                idx = np.nonzero(inv[e * w + l])[0]
+                if len(idx):
+                    rec[l] = np.bitwise_xor.reduce(srows[idx], axis=0)
+            out[e] = _from_packet_rows(rec, w, packetsize)[0]
+
+    data = np.stack([out[j] for j in range(k)])
+    for e in erased:
+        if e >= k:
+            rows = bitmatrix[(e - k) * w : (e - k + 1) * w, :]
+            out[e] = bitmatrix_encode(rows, data, w, packetsize)[0]
+    return out

@@ -1,0 +1,15 @@
+"""Test configuration: force an 8-device virtual CPU mesh for sharding tests.
+
+Real TPU hardware (single chip) is only used by bench.py; unit tests must be
+deterministic and runnable anywhere, so we pin JAX to CPU with 8 virtual
+devices before jax initializes (mirrors how the driver dry-runs multi-chip).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

@@ -1,0 +1,106 @@
+"""CPU codec engine round-trips: matrix + bitmatrix codes, exhaustive erasures.
+
+Mirrors the reference's encode_decode typed-suite pattern
+(src/test/erasure-code/TestErasureCodeJerasure.cc) and the exhaustive erasure
+sweep of ceph_erasure_code_benchmark decode mode.
+"""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.matrices import cauchy, liberation, reed_sol
+from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
+from ceph_tpu.ops import cpu_engine
+
+
+def _payload(k, size, seed=7):
+    rng = np.random.RandomState(seed)
+    return rng.randint(0, 256, size=(k, size)).astype(np.uint8)
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 4)])
+def test_matrix_roundtrip_exhaustive(k, m, w):
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    data = _payload(k, 128)
+    coding = cpu_engine.matrix_encode(M, data, w)
+    assert coding.shape == (m, 128)
+    all_chunks = {i: data[i] for i in range(k)}
+    all_chunks.update({k + i: coding[i] for i in range(m)})
+    for nerase in range(1, m + 1):
+        for erased in itertools.combinations(range(k + m), nerase):
+            have = {i: c for i, c in all_chunks.items() if i not in erased}
+            rec = cpu_engine.matrix_decode(M, have, k, m, w, 128)
+            for e in erased:
+                assert np.array_equal(rec[e], all_chunks[e]), (erased, e)
+
+
+@pytest.mark.parametrize("k,m,w,ps", [(4, 2, 4, 8), (8, 4, 8, 16), (4, 2, 8, 32)])
+def test_cauchy_bitmatrix_roundtrip(k, m, w, ps):
+    M = cauchy.good_general_coding_matrix(k, m, w)
+    B = matrix_to_bitmatrix(M, w)
+    size = w * ps * 2
+    data = _payload(k, size)
+    coding = cpu_engine.bitmatrix_encode(B, data, w, ps)
+    all_chunks = {i: data[i] for i in range(k)}
+    all_chunks.update({k + i: coding[i] for i in range(m)})
+    for erased in itertools.combinations(range(k + m), m):
+        have = {i: c for i, c in all_chunks.items() if i not in erased}
+        rec = cpu_engine.bitmatrix_decode(B, have, k, m, w, size, ps)
+        for e in erased:
+            assert np.array_equal(rec[e], all_chunks[e]), (erased, e)
+
+
+def test_cauchy_bitmatrix_equals_matrix_encode_w8():
+    """For w=8 and packetsize=1, bitmatrix packet rows coincide with bit-planes
+    only under the packet layout -- but full-chunk parity must match the GF
+    matrix product chunk-for-chunk when packetsize divides evenly."""
+    k, m, w, ps = 4, 2, 8, 4
+    M = cauchy.original_coding_matrix(k, m, w)
+    B = matrix_to_bitmatrix(M, w)
+    size = w * ps * 3
+    data = _payload(k, size)
+    bm = cpu_engine.bitmatrix_encode(B, data, w, ps)
+    # bitmatrix semantics operate on packet rows, not bytes; verify instead
+    # against a direct packet-level model
+    rows = cpu_engine._to_packet_rows(data, w, ps)
+    expect_first = np.zeros_like(rows[0])
+    for c in np.nonzero(B[0])[0]:
+        expect_first ^= rows[c]
+    got = cpu_engine._to_packet_rows(bm[:1], w, ps)[0]
+    assert np.array_equal(got, expect_first)
+
+
+@pytest.mark.parametrize("k,w", [(3, 5), (5, 7)])
+def test_liberation_roundtrip(k, w):
+    B = liberation.liberation_coding_bitmatrix(k, w)
+    ps = 8
+    size = w * ps * 2
+    data = _payload(k, size)
+    coding = cpu_engine.bitmatrix_encode(B, data, w, ps)
+    all_chunks = {i: data[i] for i in range(k)}
+    all_chunks.update({k + i: coding[i] for i in range(2)})
+    for erased in itertools.combinations(range(k + 2), 2):
+        have = {i: c for i, c in all_chunks.items() if i not in erased}
+        rec = cpu_engine.bitmatrix_decode(B, have, k, 2, w, size, ps)
+        for e in erased:
+            assert np.array_equal(rec[e], all_chunks[e])
+
+
+def test_r6_parity_values():
+    """P = XOR of data; Q = XOR of 2^j * data_j (reed_sol_r6 semantics)."""
+    from ceph_tpu.ops.gf import gf
+
+    k, w = 4, 8
+    F = gf(w)
+    M = reed_sol.r6_coding_matrix(k, w)
+    data = _payload(k, 64)
+    coding = cpu_engine.matrix_encode(M, data, w)
+    p = np.bitwise_xor.reduce(data, axis=0)
+    q = np.zeros(64, dtype=np.uint8)
+    for j in range(k):
+        q ^= F.mul_region(F.pow(2, j), data[j])
+    assert np.array_equal(coding[0], p)
+    assert np.array_equal(coding[1], q)

@@ -1,0 +1,78 @@
+"""GF(2^w) arithmetic unit tests (field axioms + known values + regions)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.ops.gf import PRIM_POLY, gf
+
+
+@pytest.mark.parametrize("w", [4, 8, 16, 32])
+def test_field_axioms_sampled(w):
+    F = gf(w)
+    rng = np.random.RandomState(w)
+    hi = min(F.max, 1 << 16)
+    samples = [int(x) for x in rng.randint(1, hi, size=12)] + [1, F.max]
+    for a in samples[:6]:
+        assert F.mul(a, 1) == a
+        assert F.mul(a, 0) == 0
+        ainv = F.inv(a)
+        assert F.mul(a, ainv) == 1
+        for b in samples[:6]:
+            assert F.mul(a, b) == F.mul(b, a)
+            for c in samples[:3]:
+                # distributivity over XOR (field addition)
+                assert F.mul(a, b ^ c) == F.mul(a, b) ^ F.mul(a, c)
+
+
+def test_known_values_w8():
+    # classic GF(256)/0x11D values
+    F = gf(8)
+    assert F.mul(2, 128) == 0x1D
+    assert F.inv(2) == 0x8E  # 0x8E<<1 = 0x11C = 0x11D ^ 1
+    assert F.mul(2, 0x8E) == 1
+
+
+@pytest.mark.parametrize("w", [8, 16, 32])
+def test_region_matches_scalar(w):
+    F = gf(w)
+    rng = np.random.RandomState(w)
+    region = rng.randint(0, F.order if w < 32 else 2**32, size=64).astype(
+        F.word_dtype
+    )
+    for c in [1, 2, 7, F.max]:
+        out = F.mul_region(c, region)
+        for idx in range(0, 64, 17):
+            assert int(out[idx]) == F.mul(c, int(region[idx]))
+
+
+def test_exp_log_roundtrip_w16():
+    F = gf(16)
+    for a in [1, 2, 3, 0xFFFF, 0x1234]:
+        assert int(F.exp_table[int(F.log_table[a])]) == a
+
+
+@pytest.mark.parametrize("w", [4, 8, 16])
+def test_primitive(w):
+    # x generates the full multiplicative group (GF construction asserts this)
+    F = gf(w)
+    assert F.log_table is not None
+    assert len(set(F.exp_table[: F.max].tolist())) == F.max
+
+
+def test_mat_invert():
+    F = gf(8)
+    rng = np.random.RandomState(0)
+    for _ in range(5):
+        while True:
+            M = rng.randint(0, 256, size=(5, 5)).astype(np.uint32)
+            try:
+                inv = F.mat_invert(M)
+                break
+            except np.linalg.LinAlgError:
+                continue
+        prod = F.mat_mul(M, inv)
+        assert np.array_equal(prod, np.eye(5, dtype=np.uint32))
+
+
+def test_poly_constants():
+    assert PRIM_POLY[8] == 0x1D and PRIM_POLY[16] == 0x100B

@@ -1,0 +1,135 @@
+"""Matrix-construction invariants (the properties the reference relies on)."""
+
+import itertools
+
+import numpy as np
+import pytest
+
+from ceph_tpu.matrices import cauchy, isa, liberation, reed_sol
+from ceph_tpu.matrices.bitmatrix import (
+    element_bitmatrix,
+    invert_bitmatrix,
+    matrix_to_bitmatrix,
+    n_ones,
+)
+from ceph_tpu.ops.gf import gf
+
+
+def _is_mds(matrix, k, m, w):
+    """Every combination of m erasures must leave an invertible system."""
+    F = gf(w)
+    full = np.vstack([np.eye(k, dtype=np.uint32), matrix])
+    for erased in itertools.combinations(range(k + m), m):
+        rows = [i for i in range(k + m) if i not in erased][:k]
+        sub = full[rows, :]
+        try:
+            F.mat_invert(sub)
+        except np.linalg.LinAlgError:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("k,m,w", [(2, 1, 8), (4, 2, 8), (8, 4, 8), (3, 2, 16), (5, 3, 32), (6, 2, 8)])
+def test_vandermonde_invariants(k, m, w):
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    assert M.shape == (m, k)
+    # first parity row all ones: required by row_k_ones=1 decode fast path
+    assert np.all(M[0] == 1)
+    assert _is_mds(M, k, m, w)
+
+
+@pytest.mark.parametrize("k,w", [(4, 8), (8, 16), (10, 32)])
+def test_r6_matrix(k, w):
+    F = gf(w)
+    M = reed_sol.r6_coding_matrix(k, w)
+    assert np.all(M[0] == 1)
+    assert M[1, 0] == 1
+    for j in range(1, k):
+        assert int(M[1, j]) == F.mul(int(M[1, j - 1]), 2)
+    assert _is_mds(M, k, 2, w)
+
+
+@pytest.mark.parametrize("k,m,w", [(4, 2, 8), (8, 4, 8), (5, 3, 16)])
+def test_cauchy_matrices(k, m, w):
+    Mo = cauchy.original_coding_matrix(k, m, w)
+    F = gf(w)
+    for i in range(m):
+        for j in range(k):
+            assert F.mul(int(Mo[i, j]), i ^ (m + j)) == 1
+    assert _is_mds(Mo, k, m, w)
+
+    Mg = cauchy.good_general_coding_matrix(k, m, w)
+    assert np.all(Mg[0] == 1)  # improvement normalizes first row to ones
+    assert _is_mds(Mg, k, m, w)
+    # improvement never increases the total bitmatrix density
+    ones_o = sum(n_ones(int(x), w) for x in Mo.flat)
+    ones_g = sum(n_ones(int(x), w) for x in Mg.flat)
+    assert ones_g <= ones_o
+
+
+def test_element_bitmatrix_is_multiplication():
+    F = gf(8)
+    rng = np.random.RandomState(1)
+    for e in [1, 2, 0x1D, 0xFF, 37]:
+        B = element_bitmatrix(e, 8)
+        for d in rng.randint(0, 256, size=8):
+            dbits = np.array([(int(d) >> x) & 1 for x in range(8)], dtype=np.uint8)
+            pbits = (B @ dbits) % 2
+            p = sum(int(b) << l for l, b in enumerate(pbits))
+            assert p == F.mul(e, int(d))
+
+
+def test_bitmatrix_invert():
+    B = matrix_to_bitmatrix(reed_sol.vandermonde_coding_matrix(3, 3, 8)[:3, :3], 8)
+    inv = invert_bitmatrix(B)
+    assert np.array_equal((inv @ B) % 2, np.eye(24, dtype=np.uint8))
+
+
+def _bitmatrix_mds(B, k, m, w):
+    """All m-erasure combinations invertible at the bit level."""
+    full = np.vstack(
+        [
+            np.hstack(
+                [np.eye(w, dtype=np.uint8) if j == i else np.zeros((w, w), np.uint8) for j in range(k)]
+            )
+            for i in range(k)
+        ]
+        + [B]
+    )
+    for erased in itertools.combinations(range(k + m), m):
+        rows = [i for i in range(k + m) if i not in erased][:k]
+        sub = np.vstack([full[r * w : (r + 1) * w] for r in rows])
+        try:
+            invert_bitmatrix(sub)
+        except np.linalg.LinAlgError:
+            return False
+    return True
+
+
+@pytest.mark.parametrize("k,w", [(2, 3), (3, 5), (5, 7), (7, 7), (6, 11)])
+def test_liberation_mds(k, w):
+    B = liberation.liberation_coding_bitmatrix(k, w)
+    assert B.shape == (2 * w, k * w)
+    assert _bitmatrix_mds(B, k, 2, w)
+
+
+@pytest.mark.parametrize("k,w", [(2, 4), (4, 6), (6, 10), (10, 10)])
+def test_blaum_roth_mds(k, w):
+    B = liberation.blaum_roth_coding_bitmatrix(k, w)
+    assert _bitmatrix_mds(B, k, 2, w)
+
+
+@pytest.mark.parametrize("k", [2, 5, 8])
+def test_liber8tion_mds(k):
+    B = liberation.liber8tion_coding_bitmatrix(k)
+    assert _bitmatrix_mds(B, k, 2, 8)
+
+
+@pytest.mark.parametrize("k,m", [(4, 2), (8, 4), (10, 4), (21, 4)])
+def test_isa_matrices(k, m):
+    A = isa.gen_cauchy1_matrix(k, m)
+    assert np.array_equal(A[:k], np.eye(k, dtype=np.uint32))
+    assert _is_mds(A[k:], k, m, 8)
+    R = isa.gen_rs_matrix(k, m)
+    assert np.all(R[k] == 1)  # first coding row: g=1 -> all ones
+    assert _is_mds(R[k:], k, m, 8)
