@@ -1,0 +1,132 @@
+"""ErasureCodePluginTpu: the TPU-native codec plugin (the north star).
+
+A drop-in peer to the jerasure/isa/shec plugins behind the same registry
+(BASELINE.json north_star; reference plugin shape:
+src/erasure-code/jerasure/ErasureCodePluginJerasure.cc): profile
+``plugin=tpu technique=<any jerasure technique> k=.. m=..`` yields a codec
+whose encode/decode run as bit-sliced GF(2) matmuls on the MXU
+(ceph_tpu/ops/xla_gf.py), bit-exact with the CPU oracle for every technique.
+
+Beyond the synchronous per-stripe contract, the plugin exposes the batched
+entry points the reference API cannot express (SURVEY.md section 5 "Hard
+parts": sync-API <-> async-device impedance): ``encode_batch`` fuses a whole
+stripe batch into one device dispatch -- stripes are the batch dimension,
+concatenated along the matmul N axis, exactly how the MXU wants them.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from ceph_tpu.ops import xla_gf
+from ceph_tpu.plugins import jerasure as jer
+from ceph_tpu.plugins import registry as registry_mod
+from ceph_tpu.plugins.interface import ErasureCodeProfile
+
+
+class _TpuMixin:
+    """Forces the XLA engine and adds batched entry points."""
+
+    def _engine(self):
+        return xla_gf
+
+    # -- batched API (TPU extension) --------------------------------------
+
+    def encode_batch(self, stripes: Sequence[bytes | np.ndarray]) -> List[Dict[int, np.ndarray]]:
+        """Encode many equal-length stripes in one device dispatch.
+
+        Each stripe is padded/split exactly like encode(); all stripes must
+        share a length so they share a chunk size.
+        """
+        if not stripes:
+            return []
+        prepared = [self.encode_prepare(_to_u8(s)) for s in stripes]
+        k, m = self.k, self.m
+        blocksize = len(prepared[0][0])
+        nb = len(prepared)
+        # stack: [k, nb * blocksize] -- stripes ride the matmul N axis
+        data = np.stack(
+            [np.concatenate([p[j] for p in prepared]) for j in range(k)]
+        )
+        coding = self.jerasure_encode(data)  # [m, nb*blocksize]
+        out: List[Dict[int, np.ndarray]] = []
+        for s in range(nb):
+            enc = dict(prepared[s])
+            for i in range(m):
+                enc[k + i] = coding[i, s * blocksize : (s + 1) * blocksize]
+            out.append(enc)
+        return out
+
+    def decode_batch(
+        self,
+        chunk_maps: Sequence[Dict[int, np.ndarray]],
+    ) -> List[Dict[int, np.ndarray]]:
+        """Reconstruct every stripe; stripes sharing an erasure signature are
+        fused into one device dispatch (the ISA-L decode-table-LRU analogue:
+        one host inversion covers the whole signature group)."""
+        if not chunk_maps:
+            return []
+        groups: Dict[tuple, List[int]] = {}
+        for idx, cm in enumerate(chunk_maps):
+            groups.setdefault(tuple(sorted(cm.keys())), []).append(idx)
+        results: List[Dict[int, np.ndarray]] = [None] * len(chunk_maps)  # type: ignore
+        for sig, idxs in groups.items():
+            blocksize = len(next(iter(chunk_maps[idxs[0]].values())))
+            fused = {
+                cid: np.concatenate([chunk_maps[i][cid] for i in idxs])
+                for cid in sig
+            }
+            rec = self.jerasure_decode(fused, blocksize * len(idxs))
+            for pos, i in enumerate(idxs):
+                results[i] = {
+                    cid: arr[pos * blocksize : (pos + 1) * blocksize]
+                    for cid, arr in rec.items()
+                }
+        return results
+
+
+def _to_u8(buf) -> np.ndarray:
+    if isinstance(buf, np.ndarray):
+        return np.ascontiguousarray(buf, dtype=np.uint8).ravel()
+    return np.frombuffer(bytes(buf), dtype=np.uint8)
+
+
+def _make_tpu_class(base):
+    name = "Tpu" + base.__name__
+    return type(name, (_TpuMixin, base), {})
+
+
+TECHNIQUES = {
+    tech: _make_tpu_class(cls) for tech, cls in jer.TECHNIQUES.items()
+}
+
+
+class ErasureCodePluginTpu(registry_mod.ErasureCodePlugin):
+    def factory(self, directory: str, profile: ErasureCodeProfile):
+        technique = profile.get("technique") or "reed_sol_van"
+        profile["technique"] = technique
+        cls = TECHNIQUES.get(technique)
+        if cls is None:
+            from ceph_tpu.plugins.interface import ErasureCodeError
+            import errno
+
+            raise ErasureCodeError(
+                errno.ENOENT, f"technique={technique} is not a valid technique"
+            )
+        ec = cls()
+        profile["backend"] = "tpu"
+        ec.init(profile)
+        return ec
+
+
+def __erasure_code_version__() -> str:
+    from ceph_tpu import __version__
+
+    return __version__
+
+
+def __erasure_code_init__(name: str, directory: str) -> int:
+    registry_mod.instance().add(name, ErasureCodePluginTpu())
+    return 0

@@ -1,0 +1,10 @@
+"""Fixture: init returns an error (registry must propagate -ESRCH)."""
+
+
+def __erasure_code_version__():
+    from ceph_tpu import __version__
+    return __version__
+
+
+def __erasure_code_init__(name, directory):
+    return -3  # -ESRCH

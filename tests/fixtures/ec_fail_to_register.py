@@ -1,0 +1,10 @@
+"""Fixture: init succeeds but never registers (registry must fail -EBADF)."""
+
+
+def __erasure_code_version__():
+    from ceph_tpu import __version__
+    return __version__
+
+
+def __erasure_code_init__(name, directory):
+    return 0

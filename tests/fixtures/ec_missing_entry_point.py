@@ -1,0 +1,6 @@
+"""Fixture: plugin with no init entry point (registry must fail -ENOENT)."""
+
+
+def __erasure_code_version__():
+    from ceph_tpu import __version__
+    return __version__

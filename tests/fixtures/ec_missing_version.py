@@ -1,0 +1,5 @@
+"""Fixture: plugin with no version entry point (registry must fail -EXDEV)."""
+
+
+def __erasure_code_init__(name, directory):
+    return 0

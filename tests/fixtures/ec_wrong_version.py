@@ -1,0 +1,9 @@
+"""Fixture: version mismatch (registry must fail -EXDEV)."""
+
+
+def __erasure_code_version__():
+    return "an older version"
+
+
+def __erasure_code_init__(name, directory):
+    return 0
