@@ -1,0 +1,125 @@
+"""North-star benchmark: TPU erasure-code encode+decode throughput.
+
+Metric (BASELINE.json): k=8, m=4 reed_sol_van over GF(2^8), 1 MiB chunks.
+We measure device-resident codec throughput (data bytes processed per
+second, GiB/s) for an encode pass plus a 2-erasure decode pass, and compare
+against the CPU reference implementation measured on this host
+(BASELINE.md "Populated-numbers policy": reference numbers are produced
+locally; the native C++ kernels are used when built, else the numpy oracle).
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
+plus a detail line on stderr.
+"""
+
+from __future__ import annotations
+
+import functools
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def _time_chained(step, d, iters=20):
+    """Dependency-chained, donated-buffer timing: each iteration consumes the
+    previous one's output, so overlap/elision cannot inflate the number."""
+    import jax
+
+    d = step(d)
+    jax.block_until_ready(d)  # warmup + compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        d = step(d)
+    jax.block_until_ready(d)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> int:
+    import jax
+    import jax.numpy as jnp
+
+    from ceph_tpu.matrices import reed_sol
+    from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
+    from ceph_tpu.ops import cpu_engine
+    from ceph_tpu.ops.gf import gf
+    from ceph_tpu.ops.xla_gf import _encode_words_kernel
+
+    k, m, w = 8, 4, 8
+    chunk = 1 << 20  # 1 MiB
+    batch = 8  # stripes fused along the matmul N axis
+    F = gf(w)
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    B = jnp.asarray(matrix_to_bitmatrix(M, w))
+
+    rng = np.random.RandomState(0)
+    data_np = rng.randint(0, 256, size=(k, batch * chunk)).astype(np.uint8)
+    data = jax.device_put(jnp.asarray(data_np))
+
+    # ---- encode (chained: parity XORed back into one data row) ----
+    @functools.partial(jax.jit, donate_argnums=0)
+    def enc_step(d):
+        p = _encode_words_kernel(B, d, w)
+        return d.at[0, :].set(p[0, :] ^ d[0, :])
+
+    t_enc = _time_chained(enc_step, data)
+    data_bytes = k * batch * chunk
+    enc_gibps = data_bytes / t_enc / (1 << 30)
+
+    # ---- decode (2 erasures: reconstruct rows applied to k survivors) ----
+    erased = [1, 6]
+    sel = [i for i in range(k + m) if i not in erased][:k]
+    A = np.zeros((k, k), dtype=np.uint32)
+    for r, cid in enumerate(sel):
+        A[r, :] = M[cid - k, :] if cid >= k else 0
+        if cid < k:
+            A[r, cid] = 1
+    rows_bits = jnp.asarray(
+        matrix_to_bitmatrix(F.mat_invert(A)[erased, :], w)
+    )
+
+    @functools.partial(jax.jit, donate_argnums=0)
+    def dec_step(d):
+        r = _encode_words_kernel(rows_bits, d, w)
+        return d.at[0, :].set(r[0, :] ^ d[0, :])
+
+    data2 = jax.device_put(jnp.asarray(data_np))
+    t_dec = _time_chained(dec_step, data2)
+    dec_gibps = data_bytes / t_dec / (1 << 30)
+
+    combined = 2 * data_bytes / (t_enc + t_dec) / (1 << 30)
+
+    # ---- CPU baseline (scaled-down run, same semantics) ----
+    cpu_slice = data_np[:, : chunk // 4]
+    t0 = time.perf_counter()
+    cpu_engine.matrix_encode(M, cpu_slice, w)
+    t_cpu = time.perf_counter() - t0
+    cpu_gibps = cpu_slice.size / t_cpu / (1 << 30)
+    try:
+        from ceph_tpu.native import gf_native  # C++ fast path when built
+
+        t0 = time.perf_counter()
+        gf_native.matrix_encode(M, cpu_slice, w)
+        t_native = time.perf_counter() - t0
+        cpu_gibps = max(cpu_gibps, cpu_slice.size / t_native / (1 << 30))
+    except Exception:
+        pass
+
+    result = {
+        "metric": "ec_encode_decode_k8m4_1MiB_GiB_s",
+        "value": round(combined, 3),
+        "unit": "GiB/s",
+        "vs_baseline": round(combined / cpu_gibps, 3) if cpu_gibps else None,
+    }
+    print(
+        f"encode {enc_gibps:.2f} GiB/s, decode {dec_gibps:.2f} GiB/s, "
+        f"cpu-ref {cpu_gibps:.2f} GiB/s on {jax.devices()[0].platform}",
+        file=sys.stderr,
+    )
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,71 @@
+"""Distributed (multi-chip) EC over an 8-device virtual mesh.
+
+Validates the SPMD encode/scrub/reconstruct contractions against the CPU
+oracle -- the sharded program must produce the same bytes as the
+single-device codec.
+"""
+
+import jax
+import numpy as np
+import pytest
+
+from ceph_tpu.matrices import reed_sol
+from ceph_tpu.ops import cpu_engine
+from ceph_tpu.parallel.distributed import DistributedCodec, make_mesh
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    assert len(jax.devices()) >= 8, "conftest must provide 8 virtual devices"
+    return make_mesh(n_data=2, n_shard=2, n_sub=2)
+
+
+def test_distributed_encode_matches_oracle(mesh):
+    k, m, w = 8, 4, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    codec = DistributedCodec(M, w, mesh)
+    rng = np.random.RandomState(0)
+    batch, n = 4, 256
+    data = rng.randint(0, 256, size=(batch, k, n)).astype(np.uint8)
+    parity = np.asarray(jax.device_get(codec.encode(data)))
+    for b in range(batch):
+        expect = cpu_engine.matrix_encode(M, data[b], w)
+        assert np.array_equal(parity[b], expect)
+
+
+def test_distributed_scrub_and_reconstruct(mesh):
+    from ceph_tpu.ops.gf import gf
+
+    k, m, w = 8, 4, 8
+    F = gf(w)
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    codec = DistributedCodec(M, w, mesh)
+    rng = np.random.RandomState(1)
+    batch, n = 2, 128
+    data = rng.randint(0, 256, size=(batch, k, n)).astype(np.uint8)
+    parity = np.asarray(jax.device_get(codec.encode(data)))
+
+    ok = np.asarray(jax.device_get(codec.verify(data, parity)))
+    assert ok.all()
+    corrupted = parity.copy()
+    corrupted[1, 0, 5] ^= 0xFF
+    ok = np.asarray(jax.device_get(codec.verify(data, corrupted)))
+    assert ok[0] and not ok[1]
+
+    # degraded read: lose data chunks 2 and 5, read k survivors 0,1,3,4,6,7,8,9
+    erased = [2, 5]
+    sel = [i for i in range(k + m) if i not in erased][:k]
+    A = np.zeros((k, k), dtype=np.uint32)
+    for r, cid in enumerate(sel):
+        if cid < k:
+            A[r, cid] = 1
+        else:
+            A[r, :] = M[cid - k, :]
+    inv = F.mat_invert(A)
+    rows = inv[erased, :]
+    full = np.concatenate([data, parity], axis=1)
+    survivors = full[:, sel, :]
+    rec = np.asarray(jax.device_get(codec.reconstruct(rows, survivors)))
+    for b in range(batch):
+        for idx, e in enumerate(erased):
+            assert np.array_equal(rec[b, idx], data[b, e])
