@@ -1,0 +1,204 @@
+"""Fused Pallas TPU kernels for the GF(2) codec engine.
+
+Design (arrived at empirically on a v5e; see git history for the variants):
+
+* The naive XLA path materializes the 8x bit-plane unpack in HBM; fusing it
+  into a kernel is necessary but not sufficient -- elementwise VPU work and
+  dtype relayouts dominate next.
+* Production kernel = **packed-lane** form: the host reinterprets the byte
+  stream as int32 (4 bytes per lane; free view, no device relayout).  The
+  kernel extracts 16 shifted/masked plane-rows from the packed lanes
+  ((x >> s) & 0x00010001 covers byte positions 0&2 at bits 0/16;
+  (x >> (8+s)) covers 1&3), runs two f32 MXU dots with precision=HIGHEST
+  (values {0,1,65536,65537}; sums <= 64 per 8-bit field stay exact below
+  2^24), merges accumulators with z = accL + (accH << 8) -- fields don't
+  collide because 64 < 256 -- and masks z & 0x01010101 to read four parity
+  bits per lane.  Everything stays in (8,128)-tiled i32/f32 layouts: no
+  int8/bf16 relayouts, int32 in, int32 out.
+
+API mirrors the XLA engine: same jerasure bitmatrix in, same bytes out
+(validated bit-exact against ceph_tpu/ops/cpu_engine.py).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+
+def _cdiv(a: int, b: int) -> int:
+    return -(-a // b)
+
+
+# ---------------------------------------------------------------------------
+# matrix codes over GF(2^8) -- packed-lane kernel
+# ---------------------------------------------------------------------------
+
+
+def prep_matrix_w8(bitmatrix: np.ndarray, k: int) -> np.ndarray:
+    """Host prep: reorder bitmatrix columns to shift-major packed-lane order.
+
+    Kernel operand rows are ordered (s, j) for s in 0..7 (bit plane) and j in
+    0..k-1 (chunk); coefficient = bitmatrix[:, j*8 + s].
+    """
+    R = bitmatrix.shape[0]
+    out = np.zeros((R, 8 * k), dtype=np.float32)
+    for s in range(8):
+        for j in range(k):
+            out[:, s * k + j] = bitmatrix[:, j * 8 + s]
+    return out
+
+
+def _matrix_kernel(b_ref, x_ref, o_ref, *, k: int, m: int):
+    x = x_ref[:]  # [k, T] int32: 4 packed bytes per lane
+    mask = jnp.int32(0x00010001)
+    lo = jnp.concatenate(
+        [((x >> s) & mask).astype(jnp.float32) for s in range(8)], axis=0
+    )  # [8k, T] byte positions 0 & 2
+    hi = jnp.concatenate(
+        [((x >> (8 + s)) & mask).astype(jnp.float32) for s in range(8)], axis=0
+    )  # byte positions 1 & 3
+    dn = (((1,), (0,)), ((), ()))
+    accL = jax.lax.dot_general(
+        b_ref[:], lo, dn,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    accH = jax.lax.dot_general(
+        b_ref[:], hi, dn,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    z = accL + (accH << 8)  # four sums per lane at byte spacing (each <= 64)
+    pb = z & jnp.int32(0x01010101)  # four parity bits per lane
+    t = pb.shape[-1]
+    ob = pb.reshape(m, 8, t)
+    packed = ob[:, 0, :]
+    for l in range(1, 8):
+        packed = packed | (ob[:, l, :] << l)
+    o_ref[:] = packed
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "tile"))
+def _matrix_encode_call(Bp, d32, k: int, m: int, tile: int):
+    n4 = d32.shape[1]
+    return pl.pallas_call(
+        functools.partial(_matrix_kernel, k=k, m=m),
+        out_shape=jax.ShapeDtypeStruct((m, n4), jnp.int32),
+        grid=(_cdiv(n4, tile),),
+        in_specs=[
+            pl.BlockSpec((m * 8, k * 8), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(Bp, d32)
+
+
+def matrix_encode_w8(
+    bitmatrix: np.ndarray | jax.Array,
+    data: np.ndarray | jax.Array,
+    k: int,
+    m: int,
+    tile: int = 4096,
+) -> np.ndarray:
+    """bitmatrix [m*8, k*8] (jerasure layout) x data [k, N] uint8 -> [m, N].
+
+    N must be a multiple of 4 (always true for SIMD_ALIGN'd chunks).
+    """
+    if isinstance(bitmatrix, np.ndarray):
+        Bp = jnp.asarray(prep_matrix_w8(bitmatrix, k))
+    else:
+        Bp = bitmatrix
+    if isinstance(data, np.ndarray):
+        d32 = jnp.asarray(np.ascontiguousarray(data).view(np.int32))
+    else:
+        d32 = data
+    n4 = d32.shape[1]
+    tile = min(tile, max(_cdiv(n4, 128) * 128, 128))
+    out32 = _matrix_encode_call(Bp, d32, k, m, tile)
+    return np.ascontiguousarray(jax.device_get(out32)).view(np.uint8)
+
+
+# ---------------------------------------------------------------------------
+# packetized bitmatrix codes (cauchy / liberation family)
+# ---------------------------------------------------------------------------
+#
+# Packet rows are XOR-combined bytes; the same packed-lane trick applies
+# directly (the contraction runs over packet rows, byte positions ride the
+# lanes), with B used as-is (no column reorder: row c of the operand is
+# packet row c).
+
+
+def _packet_kernel(b_ref, x_ref, o_ref, *, r: int):
+    x = x_ref[:]  # [C, T] int32 packed bytes
+    mask = jnp.int32(0x00010001)
+    dn = (((1,), (0,)), ((), ()))
+    out = None
+    # two dots per 8-bit half: positions 0&2 via shift s, 1&3 via 8+s --
+    # but here the contraction dim is packet rows, so each bit plane of the
+    # packed lanes is its own GF(2) system: 8 planes x 2 halves collapse to
+    # 2 dots exactly like the matrix kernel, except B has no plane structure
+    # (XOR weights are per-row), so plane extraction folds into the z-merge.
+    lo = [((x >> s) & mask).astype(jnp.float32) for s in range(8)]
+    hi = [((x >> (8 + s)) & mask).astype(jnp.float32) for s in range(8)]
+    zs = []
+    for s in range(8):
+        aL = jax.lax.dot_general(
+            b_ref[:], lo[s], dn,
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        aH = jax.lax.dot_general(
+            b_ref[:], hi[s], dn,
+            precision=jax.lax.Precision.HIGHEST,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.int32)
+        z = (aL + (aH << 8)) & jnp.int32(0x01010101)
+        zs.append(z << s)
+    out = zs[0]
+    for z in zs[1:]:
+        out = out | z
+    o_ref[:] = out
+
+
+@functools.partial(jax.jit, static_argnames=("r", "tile"))
+def _packet_encode_call(B, rows32, r: int, tile: int):
+    n4 = rows32.shape[1]
+    c = rows32.shape[0]
+    return pl.pallas_call(
+        functools.partial(_packet_kernel, r=r),
+        out_shape=jax.ShapeDtypeStruct((r, n4), jnp.int32),
+        grid=(_cdiv(n4, tile),),
+        in_specs=[
+            pl.BlockSpec((r, c), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((c, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((r, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(B, rows32)
+
+
+def packet_encode(
+    bitmatrix: np.ndarray | jax.Array,
+    rows: np.ndarray | jax.Array,
+    tile: int = 2048,
+) -> np.ndarray:
+    """bitmatrix [R, C] x packet rows [C, Nb] uint8 -> [R, Nb] bytes."""
+    if isinstance(bitmatrix, np.ndarray):
+        B = jnp.asarray(bitmatrix.astype(np.float32))
+        r = bitmatrix.shape[0]
+    else:
+        B = bitmatrix
+        r = B.shape[0]
+    if isinstance(rows, np.ndarray):
+        rows32 = jnp.asarray(np.ascontiguousarray(rows).view(np.int32))
+    else:
+        rows32 = rows
+    n4 = rows32.shape[1]
+    tile = min(tile, max(_cdiv(n4, 128) * 128, 128))
+    out32 = _packet_encode_call(B, rows32, r, tile)
+    return np.ascontiguousarray(jax.device_get(out32)).view(np.uint8)

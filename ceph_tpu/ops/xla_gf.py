@@ -102,6 +102,14 @@ def _encode_packets_kernel(B: jax.Array, rows: jax.Array) -> jax.Array:
 _bitmatrix_cache: dict = {}
 
 
+def _pallas_ok() -> bool:
+    """Fused Pallas kernels require a real TPU backend."""
+    try:
+        return jax.default_backend() == "tpu"
+    except Exception:
+        return False
+
+
 def _bitmatrix_of(matrix: np.ndarray, w: int) -> np.ndarray:
     key = (matrix.tobytes(), matrix.shape, w)
     cached = _bitmatrix_cache.get(key)
@@ -117,6 +125,10 @@ def matrix_encode(matrix: np.ndarray, data: np.ndarray, w: int) -> np.ndarray:
     size = data.shape[1]
     assert size % (w // 8) == 0
     B = _bitmatrix_of(np.asarray(matrix, dtype=np.uint32), w)
+    if w == 8 and size % 4 == 0 and _pallas_ok():
+        from ceph_tpu.ops import pallas_gf
+
+        return pallas_gf.matrix_encode_w8(B, np.ascontiguousarray(data), k, m)
     words = np.ascontiguousarray(data).view(_WORD_DTYPE[w])
     out = _encode_words_kernel(jnp.asarray(B), jnp.asarray(words), w)
     return np.asarray(jax.device_get(out)).view(np.uint8)
@@ -193,12 +205,20 @@ def _from_packet_rows(rows: np.ndarray, w: int, packetsize: int) -> np.ndarray:
     )
 
 
+def _encode_packets(B: np.ndarray, rows: np.ndarray) -> np.ndarray:
+    if rows.shape[1] % 4 == 0 and _pallas_ok():
+        from ceph_tpu.ops import pallas_gf
+
+        return pallas_gf.packet_encode(B, rows)
+    out = _encode_packets_kernel(jnp.asarray(B), jnp.asarray(rows))
+    return np.asarray(jax.device_get(out))
+
+
 def bitmatrix_encode(
     bitmatrix: np.ndarray, data: np.ndarray, w: int, packetsize: int
 ) -> np.ndarray:
     rows = _to_packet_rows(np.ascontiguousarray(data), w, packetsize)
-    out = _encode_packets_kernel(jnp.asarray(bitmatrix), jnp.asarray(rows))
-    return _from_packet_rows(np.asarray(jax.device_get(out)), w, packetsize)
+    return _from_packet_rows(_encode_packets(bitmatrix, rows), w, packetsize)
 
 
 def bitmatrix_decode(
@@ -239,8 +259,9 @@ def bitmatrix_decode(
         )
         survivors = np.stack([out[cid] for cid in sel])
         srows = _to_packet_rows(survivors, w, packetsize)
-        rec = _encode_packets_kernel(jnp.asarray(rec_rows), jnp.asarray(srows))
-        rec = _from_packet_rows(np.asarray(jax.device_get(rec)), w, packetsize)
+        rec = _from_packet_rows(
+            _encode_packets(rec_rows.astype(np.uint8), srows), w, packetsize
+        )
         for idx, e in enumerate(erased_data):
             out[e] = rec[idx]
 
