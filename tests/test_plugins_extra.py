@@ -1,0 +1,181 @@
+"""ISA / SHEC / LRC plugin suites (reference: TestErasureCodeIsa.cc,
+TestErasureCodeShec*.cc, TestErasureCodeLrc.cc)."""
+
+import errno
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import registry as registry_mod
+from ceph_tpu.plugins.interface import ErasureCodeError
+
+
+@pytest.fixture
+def registry():
+    return registry_mod.ErasureCodePluginRegistry()
+
+
+# -- ISA --------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("technique", ["reed_sol_van", "cauchy"])
+@pytest.mark.parametrize("k,m", [(2, 1), (4, 2), (8, 4), (12, 4)])
+def test_isa_roundtrip(registry, technique, k, m):
+    if technique == "reed_sol_van" and m == 4 and k > 21:
+        pytest.skip("guard rail")
+    ec = registry.factory(
+        "isa", {"k": str(k), "m": str(m), "technique": technique}
+    )
+    km = k + m
+    payload = bytes(os.urandom(ec.get_chunk_size(1) * 2 + 13))
+    encoded = ec.encode(set(range(km)), payload)
+    assert ec.decode_concat(encoded)[: len(payload)] == payload
+    nerase = min(m, 2)
+    for erased in itertools.combinations(range(km), nerase):
+        have = {i: c for i, c in encoded.items() if i not in erased}
+        out = ec.decode(set(erased), have)
+        for e in erased:
+            assert np.array_equal(out[e], encoded[e]), (technique, k, m, erased)
+
+
+def test_isa_guard_rails(registry):
+    with pytest.raises(ErasureCodeError):
+        registry.factory("isa", {"k": "33", "m": "2"})
+    with pytest.raises(ErasureCodeError):
+        registry.factory("isa", {"k": "4", "m": "5"})
+    with pytest.raises(ErasureCodeError):
+        registry.factory("isa", {"k": "22", "m": "4"})
+    # cauchy has no vandermonde limits beyond table space
+    ec = registry.factory("isa", {"k": "22", "m": "4", "technique": "cauchy"})
+    assert ec.get_chunk_count() == 26
+
+
+def test_isa_chunk_size_alignment(registry):
+    ec = registry.factory("isa", {"k": "7", "m": "3"})
+    for size in (1, 31, 32, 1024, 12345):
+        cs = ec.get_chunk_size(size)
+        assert cs % 32 == 0
+        assert cs * 7 >= size
+
+
+def test_isa_m1_xor_path(registry):
+    ec = registry.factory("isa", {"k": "4", "m": "1"})
+    payload = bytes(os.urandom(4096))
+    encoded = ec.encode(set(range(5)), payload)
+    expect = np.bitwise_xor.reduce([encoded[i] for i in range(4)], axis=0)
+    assert np.array_equal(encoded[4], expect)
+    have = {i: c for i, c in encoded.items() if i != 2}
+    out = ec.decode({2}, have)
+    assert np.array_equal(out[2], encoded[2])
+
+
+def test_isa_matrix_matches_isal_semantics(registry):
+    """First RS coding row is all ones (generator 2^0)."""
+    ec = registry.factory("isa", {"k": "5", "m": "3"})
+    assert np.all(ec.matrix[0] == 1)
+
+
+# -- SHEC -------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("technique", ["single", "multiple"])
+@pytest.mark.parametrize("k,m,c", [(4, 3, 2), (6, 4, 2), (8, 4, 3)])
+def test_shec_roundtrip(registry, technique, k, m, c):
+    ec = registry.factory(
+        "shec",
+        {"k": str(k), "m": str(m), "c": str(c), "technique": technique},
+    )
+    km = k + m
+    payload = bytes(os.urandom(ec.get_chunk_size(1) * 2 + 7))
+    encoded = ec.encode(set(range(km)), payload)
+    assert ec.decode_concat(encoded)[: len(payload)] == payload
+    # c erasures are always recoverable for shec
+    for erased in itertools.combinations(range(km), c):
+        have = {i: ch for i, ch in encoded.items() if i not in erased}
+        out = ec.decode(set(erased), have)
+        for e in erased:
+            assert np.array_equal(out[e], encoded[e]), (technique, erased)
+
+
+def test_shec_locality(registry):
+    """Single-chunk recovery must read fewer than k chunks (the point of
+    shingling): k=8, m=4, c=3 -> locality ~ k*c/m = 6."""
+    ec = registry.factory("shec", {"k": "8", "m": "4", "c": "3"})
+    avail = set(range(12)) - {0}
+    minimum = ec.minimum_to_decode({0}, avail)
+    assert len(minimum) < 8, sorted(minimum)
+
+
+def test_shec_defaults_and_guards(registry):
+    ec = registry.factory("shec", {})
+    assert ec.get_data_chunk_count() == 4
+    assert ec.get_chunk_count() == 7
+    with pytest.raises(ErasureCodeError):
+        registry.factory("shec", {"k": "13", "m": "3", "c": "2"})
+    with pytest.raises(ErasureCodeError):
+        registry.factory("shec", {"k": "4", "m": "3", "c": "4"})
+    with pytest.raises(ErasureCodeError):
+        registry.factory("shec", {"k": "3", "m": "4", "c": "2"})
+
+
+# -- LRC --------------------------------------------------------------------
+
+
+def test_lrc_kml_generation(registry):
+    """k=4 m=2 l=3 -> 2 local groups; mapping gains one local-parity slot
+    per group: total chunks = k + m + (k+m)/l = 8 (parse_kml)."""
+    profile = {"k": "4", "m": "2", "l": "3"}
+    ec = registry.factory("lrc", profile)
+    assert profile["mapping"] == "DD__DD__"
+    assert ec.get_chunk_count() == 8
+    assert ec.get_data_chunk_count() == 4
+
+
+def test_lrc_kml_roundtrip(registry):
+    ec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    km = ec.get_chunk_count()
+    payload = bytes(os.urandom(ec.get_chunk_size(1) * 2 + 3))
+    encoded = ec.encode(set(range(km)), payload)
+    assert ec.decode_concat(encoded)[: len(payload)] == payload
+    for lost in range(km):
+        have = {i: c for i, c in encoded.items() if i != lost}
+        out = ec.decode({lost}, have)
+        assert np.array_equal(out[lost], encoded[lost])
+
+
+def test_lrc_local_repair_reads_fewer(registry):
+    """Losing one chunk must be repairable from its local group only."""
+    ec = registry.factory("lrc", {"k": "4", "m": "2", "l": "3"})
+    km = ec.get_chunk_count()
+    avail = set(range(km)) - {0}
+    minimum = ec.minimum_to_decode({0}, avail)
+    # local group is l=3 chunks: read the other l members, not all k
+    assert len(minimum) <= 3, sorted(minimum)
+
+
+def test_lrc_explicit_layers(registry):
+    profile = {
+        "mapping": "__DD__DD",
+        "layers": '[ [ "_cDD_cDD", "" ], [ "cDDD____", "" ], [ "____cDDD", "" ] ]',
+    }
+    ec = registry.factory("lrc", profile)
+    assert ec.get_chunk_count() == 8
+    assert ec.get_data_chunk_count() == 4
+    payload = bytes(os.urandom(ec.get_chunk_size(1) + 5))
+    encoded = ec.encode(set(range(8)), payload)
+    assert ec.decode_concat(encoded)[: len(payload)] == payload
+    for lost in range(8):
+        have = {i: c for i, c in encoded.items() if i != lost}
+        out = ec.decode({lost}, have)
+        assert np.array_equal(out[lost], encoded[lost])
+
+
+def test_lrc_errors(registry):
+    with pytest.raises(ErasureCodeError):
+        registry.factory("lrc", {"k": "4", "m": "2"})  # l missing
+    with pytest.raises(ErasureCodeError):
+        registry.factory("lrc", {"k": "4", "m": "2", "l": "5"})  # (k+m)%l
+    with pytest.raises(ErasureCodeError):
+        registry.factory("lrc", {"mapping": "DD_"})  # layers missing
