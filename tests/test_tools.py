@@ -1,0 +1,89 @@
+"""Tool-level tests: benchmark CLI output format, corpus non-regression."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+ENV = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=REPO)
+
+
+def run_tool(tool, *args):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", tool), *args],
+        capture_output=True,
+        text=True,
+        env=ENV,
+        timeout=300,
+    )
+
+
+def test_benchmark_encode_output_format():
+    r = run_tool(
+        "ec_benchmark.py",
+        "--plugin", "jerasure", "--workload", "encode",
+        "--size", "65536", "--iterations", "3",
+        "--parameter", "k=4", "--parameter", "m=2",
+    )
+    assert r.returncode == 0, r.stderr
+    seconds, kib = r.stdout.strip().split("\t")
+    assert float(seconds) > 0
+    assert int(kib) == 3 * 64  # iterations * size/1024
+
+
+def test_benchmark_decode_exhaustive():
+    r = run_tool(
+        "ec_benchmark.py",
+        "--workload", "decode", "--erasures", "2",
+        "--erasures-generation", "exhaustive",
+        "--size", "16384",
+        "--parameter", "k=4", "--parameter", "m=2",
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_benchmark_rejects_missing_k():
+    r = run_tool("ec_benchmark.py", "--workload", "encode")
+    assert r.returncode != 0
+
+
+def test_non_regression_create_then_check(tmp_path):
+    base = str(tmp_path)
+    args = [
+        "--plugin", "jerasure", "--base", base,
+        "--stripe-width", "8192",
+        "--parameter", "k=4", "--parameter", "m=2",
+        "--parameter", "technique=reed_sol_van",
+    ]
+    r = run_tool("ec_non_regression.py", "--create", *args)
+    assert r.returncode == 0, r.stderr
+    d = os.listdir(base)
+    assert len(d) == 1 and d[0].startswith("plugin=jerasure stripe-width=8192")
+    r = run_tool("ec_non_regression.py", "--check", *args)
+    assert r.returncode == 0, r.stderr
+    # corrupt a chunk -> check must fail
+    chunk0 = os.path.join(base, d[0], "0")
+    blob = bytearray(open(chunk0, "rb").read())
+    blob[0] ^= 0xFF
+    open(chunk0, "wb").write(bytes(blob))
+    r = run_tool("ec_non_regression.py", "--check", *args)
+    assert r.returncode != 0
+
+
+def test_info_tool():
+    r = run_tool("ec_info.py", "--plugin_exists", "jerasure")
+    assert r.returncode == 0
+    r = run_tool("ec_info.py", "--plugin_exists", "nonexistent_plugin")
+    assert r.returncode == 1
+    r = run_tool(
+        "ec_info.py", "--plugin", "lrc",
+        "--parameter", "k=4", "--parameter", "m=2", "--parameter", "l=3",
+    )
+    assert r.returncode == 0
+    import json
+
+    info = json.loads(r.stdout)
+    assert info["chunk_count"] == 8
+    assert info["data_chunk_count"] == 4

@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""ceph_erasure_code_benchmark-compatible CLI.
+
+Same flags and output format as the reference tool (reference:
+src/test/erasure-code/ceph_erasure_code_benchmark.cc:39-137 setup,
+:150-188 encode, :253-327 decode): prints ``<elapsed_seconds>\\t<KiB>`` where
+KiB = iterations * size / 1024, so throughput = KiB / seconds.
+
+Examples:
+    python tools/ec_benchmark.py --plugin tpu --workload encode \\
+        --size 4194304 --iterations 10 --parameter k=8 --parameter m=4
+    python tools/ec_benchmark.py --workload decode --erasures-generation \\
+        exhaustive --erasures 2 --parameter k=4 --parameter m=2
+"""
+
+from __future__ import annotations
+
+import argparse
+import random
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.plugins import registry as registry_mod  # noqa: E402
+
+
+def parse_args(argv):
+    p = argparse.ArgumentParser(description="erasure code benchmark")
+    p.add_argument("-v", "--verbose", action="store_true")
+    p.add_argument("-s", "--size", type=int, default=1024 * 1024,
+                   help="size of the buffer to be encoded")
+    p.add_argument("-i", "--iterations", type=int, default=1,
+                   help="number of encode/decode runs")
+    p.add_argument("-p", "--plugin", default="jerasure",
+                   help="erasure code plugin name")
+    p.add_argument("-w", "--workload", default="encode",
+                   choices=["encode", "decode"])
+    p.add_argument("-e", "--erasures", type=int, default=1,
+                   help="number of erasures when decoding")
+    p.add_argument("--erased", type=int, action="append", default=[],
+                   help="erased chunk (repeatable)")
+    p.add_argument("-E", "--erasures-generation", default="random",
+                   choices=["random", "exhaustive"])
+    p.add_argument("-P", "--parameter", action="append", default=[],
+                   help="add a parameter to the erasure code profile")
+    p.add_argument("--erasure-code-dir", default="",
+                   help="plugin directory (out-of-tree plugins)")
+    return p.parse_args(argv)
+
+
+def display_chunks(chunks, chunk_count):
+    out = "chunks "
+    for c in range(chunk_count):
+        out += f"({c})  " if c not in chunks else f" {c}  "
+    print(out + "(X) is an erased chunk")
+
+
+def decode_erasures(all_chunks, chunks, i, want_erasures, ec, verbose):
+    """Recursive exhaustive erasure enumeration (reference :205-252)."""
+    if want_erasures == 0:
+        if verbose:
+            display_chunks(chunks, ec.get_chunk_count())
+        want_to_read = set(range(ec.get_chunk_count())) - set(chunks.keys())
+        decoded = ec.decode(want_to_read, chunks)
+        for chunk in want_to_read:
+            if not np.array_equal(all_chunks[chunk], decoded[chunk]):
+                print(
+                    f"chunk {chunk} content and recovered content are different",
+                    file=sys.stderr,
+                )
+                return -1
+        return 0
+    for j in range(i, ec.get_chunk_count()):
+        one_less = dict(chunks)
+        one_less.pop(j, None)
+        code = decode_erasures(
+            all_chunks, one_less, j + 1, want_erasures - 1, ec, verbose
+        )
+        if code:
+            return code
+    return 0
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv if argv is not None else sys.argv[1:])
+    profile = {}
+    for param in args.parameter:
+        if param.count("=") != 1:
+            print(f"--parameter {param} ignored (needs exactly one =)",
+                  file=sys.stderr)
+            continue
+        key, val = param.split("=")
+        profile[key] = val
+
+    k = int(profile.get("k", "0"))
+    m = int(profile.get("m", "0"))
+    if k <= 0:
+        print(f"parameter k is {k}. But k needs to be > 0.")
+        return -22
+    if m < 0:
+        print(f"parameter m is {m}. But m needs to be >= 0.")
+        return -22
+
+    registry = registry_mod.instance()
+    registry.disable_dlclose = True
+    ec = registry.factory(args.plugin, profile, args.erasure_code_dir)
+
+    if (
+        ec.get_data_chunk_count() != k
+        or ec.get_chunk_count() - ec.get_data_chunk_count() != m
+    ):
+        print(
+            f"parameter k is {k}/m is {m}. But data chunk count is "
+            f"{ec.get_data_chunk_count()}/parity chunk count is "
+            f"{ec.get_chunk_count() - ec.get_data_chunk_count()}"
+        )
+        return -22
+
+    payload = np.full(args.size, ord("X"), dtype=np.uint8)
+    want = set(range(ec.get_chunk_count()))
+
+    if args.workload == "encode":
+        begin = time.perf_counter()
+        for _ in range(args.iterations):
+            ec.encode(want, payload)
+        elapsed = time.perf_counter() - begin
+    else:
+        encoded = ec.encode(want, payload)
+        if args.erased:
+            for e in args.erased:
+                encoded.pop(e, None)
+            display_chunks(encoded, ec.get_chunk_count())
+        begin = time.perf_counter()
+        for _ in range(args.iterations):
+            if args.erasures_generation == "exhaustive":
+                code = decode_erasures(
+                    encoded, encoded, 0, args.erasures, ec, args.verbose
+                )
+                if code:
+                    return code
+            elif args.erased:
+                ec.decode(want, encoded)
+            else:
+                chunks = dict(encoded)
+                for _ in range(args.erasures):
+                    while True:
+                        erasure = random.randrange(ec.get_chunk_count())
+                        if erasure in chunks:
+                            break
+                    del chunks[erasure]
+                ec.decode(want, chunks)
+        elapsed = time.perf_counter() - begin
+
+    print(f"{elapsed:.6f}\t{args.iterations * (args.size // 1024)}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

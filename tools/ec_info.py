@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Plugin existence / introspection CLI (ceph_erasure_code equivalent).
+
+Reference: src/test/erasure-code/ceph_erasure_code.cc:50-67 -- instantiates
+a plugin from --plugin_exists / --parameter flags and reports success, used
+by qa scripts to gate tests on plugin availability.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.plugins import registry as registry_mod  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description="erasure code plugin introspection")
+    p.add_argument("--plugin_exists", help="check whether the plugin loads")
+    p.add_argument("--plugin", help="instantiate and describe a codec")
+    p.add_argument("--parameter", action="append", default=[])
+    p.add_argument("--erasure-code-dir", default="")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    registry = registry_mod.instance()
+    if args.plugin_exists:
+        try:
+            registry.load(args.plugin_exists, args.erasure_code_dir)
+            return 0
+        except Exception as e:
+            print(e, file=sys.stderr)
+            return 1
+    if args.plugin:
+        profile = {}
+        for param in args.parameter:
+            if "=" in param:
+                key, val = param.split("=", 1)
+                profile[key] = val
+        ec = registry.factory(args.plugin, profile, args.erasure_code_dir)
+        print(
+            json.dumps(
+                {
+                    "plugin": args.plugin,
+                    "profile": ec.get_profile(),
+                    "chunk_count": ec.get_chunk_count(),
+                    "data_chunk_count": ec.get_data_chunk_count(),
+                    "coding_chunk_count": ec.get_coding_chunk_count(),
+                    "sub_chunk_count": ec.get_sub_chunk_count(),
+                    "chunk_size_4096": ec.get_chunk_size(4096),
+                    "chunk_mapping": ec.get_chunk_mapping(),
+                }
+            )
+        )
+        return 0
+    p.print_help()
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
