@@ -1,0 +1,150 @@
+"""ctypes bindings for the native CPU codec kernels (libec_kernels.so).
+
+The native library plays the role of jerasure/gf-complete/isa-l in the
+reference: the fast host-CPU path and the realistic CPU baseline that
+bench.py compares the TPU engine against.  Builds lazily via make on first
+import if the shared object is missing; API mirrors
+ceph_tpu/ops/cpu_engine.py (bit-exact, enforced by tests).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libec_kernels.so")
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_SO):
+        subprocess.run(
+            ["make", "-C", _DIR],
+            check=True,
+            capture_output=True,
+        )
+    lib = ctypes.CDLL(_SO)
+    lib.ec_gf8_mul_region.argtypes = [
+        ctypes.c_uint8,
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+        ctypes.c_int,
+    ]
+    lib.ec_region_xor.argtypes = [
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    lib.ec_gf8_matrix_encode.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_size_t,
+    ]
+    lib.ec_bitmatrix_packet_encode.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_int,
+        ctypes.c_int,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_size_t,
+    ]
+    lib.ec_crc32c.restype = ctypes.c_uint32
+    lib.ec_crc32c.argtypes = [
+        ctypes.c_uint32,
+        ctypes.c_void_p,
+        ctypes.c_size_t,
+    ]
+    return lib
+
+
+_lib = _load()
+
+
+def _ptr_array(arrays) -> "ctypes.Array":
+    ptrs = (ctypes.c_void_p * len(arrays))()
+    for i, a in enumerate(arrays):
+        ptrs[i] = a.ctypes.data_as(ctypes.c_void_p)
+    return ptrs
+
+
+def mul_region(c: int, region: np.ndarray, accum: np.ndarray | None = None) -> np.ndarray:
+    region = np.ascontiguousarray(region, dtype=np.uint8)
+    out = accum if accum is not None else np.zeros_like(region)
+    _lib.ec_gf8_mul_region(
+        c,
+        region.ctypes.data_as(ctypes.c_void_p),
+        out.ctypes.data_as(ctypes.c_void_p),
+        region.size,
+        1 if accum is not None else 0,
+    )
+    return out
+
+
+def region_xor(srcs: list[np.ndarray]) -> np.ndarray:
+    n = srcs[0].size
+    out = np.empty(n, dtype=np.uint8)
+    _lib.ec_region_xor(
+        _ptr_array(srcs), len(srcs), out.ctypes.data_as(ctypes.c_void_p), n
+    )
+    return out
+
+
+def matrix_encode(matrix: np.ndarray, data: np.ndarray, w: int = 8) -> np.ndarray:
+    """GF(2^8) only; mirrors cpu_engine.matrix_encode for w=8."""
+    if w != 8:
+        raise NotImplementedError("native path supports w=8")
+    matrix = np.ascontiguousarray(matrix, dtype=np.uint8)
+    data = np.ascontiguousarray(data, dtype=np.uint8)
+    m, k = matrix.shape
+    n = data.shape[1]
+    coding = np.zeros((m, n), dtype=np.uint8)
+    _lib.ec_gf8_matrix_encode(
+        matrix.ctypes.data_as(ctypes.c_void_p),
+        k,
+        m,
+        _ptr_array([data[j] for j in range(k)]),
+        _ptr_array([coding[i] for i in range(m)]),
+        n,
+    )
+    return coding
+
+
+def bitmatrix_packet_encode(
+    bitmatrix: np.ndarray, rows: np.ndarray
+) -> np.ndarray:
+    bitmatrix = np.ascontiguousarray(bitmatrix, dtype=np.uint8)
+    rows = np.ascontiguousarray(rows, dtype=np.uint8)
+    r, c = bitmatrix.shape
+    n = rows.shape[1]
+    out = np.zeros((r, n), dtype=np.uint8)
+    _lib.ec_bitmatrix_packet_encode(
+        bitmatrix.ctypes.data_as(ctypes.c_void_p),
+        r,
+        c,
+        _ptr_array([rows[j] for j in range(c)]),
+        _ptr_array([out[i] for i in range(r)]),
+        n,
+    )
+    return out
+
+
+def crc32c(data: bytes | np.ndarray, crc: int = 0xFFFFFFFF) -> int:
+    """crc32c-castagnoli with ceph's -1 initial value convention."""
+    arr = np.frombuffer(bytes(data), dtype=np.uint8) if isinstance(
+        data, (bytes, bytearray, memoryview)
+    ) else np.ascontiguousarray(data, dtype=np.uint8)
+    return int(
+        _lib.ec_crc32c(
+            ctypes.c_uint32(crc),
+            arr.ctypes.data_as(ctypes.c_void_p),
+            arr.size,
+        )
+    )

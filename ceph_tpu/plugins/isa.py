@@ -135,6 +135,10 @@ class ErasureCodeIsaDefault(ErasureCode):
             from ceph_tpu.ops import xla_gf
 
             return xla_gf
+        if self._backend == "native":
+            from ceph_tpu.ops import native_engine
+
+            return native_engine
         return cpu_engine
 
     def encode_chunks(

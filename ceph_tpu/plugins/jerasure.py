@@ -151,6 +151,10 @@ class ErasureCodeJerasure(ErasureCode):
             from ceph_tpu.ops import xla_gf
 
             return xla_gf
+        if self._backend == "native":
+            from ceph_tpu.ops import native_engine
+
+            return native_engine
         return None  # numpy/CPU path
 
 

@@ -183,6 +183,10 @@ class ErasureCodeShec(ErasureCode):
             from ceph_tpu.ops import xla_gf
 
             return xla_gf
+        if self._backend == "native":
+            from ceph_tpu.ops import native_engine
+
+            return native_engine
         return cpu_engine
 
     def encode_chunks(
