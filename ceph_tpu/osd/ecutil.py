@@ -1,0 +1,171 @@
+"""Stripe math + codec driver + per-shard hash info (ECUtil equivalent).
+
+Reference: src/osd/ECUtil.{h,cc}.
+
+* ``StripeInfo`` -- the logical<->chunk offset algebra (ECUtil.h:26-79).
+* ``encode``/``decode`` -- where the reference loops the codec one
+  stripe_width at a time (ECUtil.cc:136-148), we hand the codec ALL stripes
+  in one call: the chunk arrays are contiguous per shard, and every engine
+  (numpy, native C++, XLA/pallas) treats the byte axis as the matmul N
+  dimension, so the whole object is one device dispatch.  This is the
+  stripe-batching shim SURVEY.md section 6 calls for.
+* ``HashInfo`` -- per-shard cumulative crc32c + total size, persisted as a
+  shard xattr and checked on every shard read (ECUtil.h:100-158,
+  ECUtil.cc:161-235; read-side check ECBackend.cc:1054-1076).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List
+
+import numpy as np
+
+from ceph_tpu.native.gf_native import crc32c
+
+
+class StripeInfo:
+    """stripe_info_t: stripe_size = k data chunks per stripe."""
+
+    def __init__(self, stripe_size: int, stripe_width: int):
+        assert stripe_width % stripe_size == 0
+        self.stripe_width = stripe_width
+        self.chunk_size = stripe_width // stripe_size
+
+    def logical_offset_is_stripe_aligned(self, logical: int) -> bool:
+        return logical % self.stripe_width == 0
+
+    def logical_to_prev_chunk_offset(self, offset: int) -> int:
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def logical_to_next_chunk_offset(self, offset: int) -> int:
+        return (
+            (offset + self.stripe_width - 1) // self.stripe_width
+        ) * self.chunk_size
+
+    def logical_to_prev_stripe_offset(self, offset: int) -> int:
+        return offset - (offset % self.stripe_width)
+
+    def logical_to_next_stripe_offset(self, offset: int) -> int:
+        rem = offset % self.stripe_width
+        return offset - rem + self.stripe_width if rem else offset
+
+    def aligned_logical_offset_to_chunk_offset(self, offset: int) -> int:
+        assert offset % self.stripe_width == 0
+        return (offset // self.stripe_width) * self.chunk_size
+
+    def aligned_chunk_offset_to_logical_offset(self, offset: int) -> int:
+        assert offset % self.chunk_size == 0
+        return (offset // self.chunk_size) * self.stripe_width
+
+    def offset_len_to_stripe_bounds(self, off: int, length: int) -> tuple:
+        start = self.logical_to_prev_stripe_offset(off)
+        length = self.logical_to_next_stripe_offset((off - start) + length)
+        return start, length
+
+
+def encode(
+    sinfo: StripeInfo,
+    ec,
+    data: bytes | np.ndarray,
+    want: Iterable[int],
+) -> Dict[int, np.ndarray]:
+    """Encode a stripe-aligned buffer into per-shard chunk arrays.
+
+    One codec call covers every stripe: ec.encode pads/splits per its own
+    chunk-size algebra, which for a stripe_width-aligned buffer yields
+    chunk_size * n_stripes per shard -- the same bytes as the reference's
+    per-stripe loop concatenated (each stripe's chunk is contiguous within
+    its shard at offset stripe_index * chunk_size).
+    """
+    buf = np.frombuffer(bytes(data), dtype=np.uint8) if not isinstance(
+        data, np.ndarray
+    ) else data
+    assert len(buf) % sinfo.stripe_width == 0, "input must be stripe-aligned"
+    n_stripes = len(buf) // sinfo.stripe_width
+    k = ec.get_data_chunk_count()
+    km = ec.get_chunk_count()
+    # reshape so each shard's stripes are contiguous: [stripes, k, chunk]
+    per_stripe = buf.reshape(n_stripes, k, sinfo.stripe_width // k)
+    shard_major = np.ascontiguousarray(
+        per_stripe.transpose(1, 0, 2)
+    ).reshape(k, -1)
+    # encode the concatenated shard streams in a single codec call
+    encoded = ec.encode(set(range(km)), shard_major.reshape(-1))
+    return {i: encoded[i] for i in want}
+
+
+def decode_concat(
+    sinfo: StripeInfo,
+    ec,
+    to_decode: Dict[int, np.ndarray],
+) -> bytes:
+    """Rebuild the logical buffer from per-shard chunk streams."""
+    k = ec.get_data_chunk_count()
+    out = ec.decode(set(range(k)), to_decode)
+    shard_len = len(next(iter(out.values())))
+    n_stripes = shard_len // sinfo.chunk_size
+    stacked = np.stack([out[i] for i in range(k)])  # [k, shard_len]
+    per_stripe = stacked.reshape(k, n_stripes, sinfo.chunk_size).transpose(
+        1, 0, 2
+    )
+    return per_stripe.tobytes()
+
+
+def decode_shards(
+    ec,
+    available: Dict[int, np.ndarray],
+    want: Iterable[int],
+) -> Dict[int, np.ndarray]:
+    """Reconstruct specific shards (recovery path)."""
+    return ec.decode(set(want), available)
+
+
+class HashInfo:
+    """Per-shard cumulative crc32c + total per-shard size."""
+
+    def __init__(self, num_chunks: int):
+        self.total_chunk_size = 0
+        self.cumulative_shard_hashes: List[int] = [0xFFFFFFFF] * num_chunks
+
+    def append(self, old_size: int, to_append: Dict[int, np.ndarray]) -> None:
+        assert old_size == self.total_chunk_size
+        appended = 0
+        for shard, chunk in sorted(to_append.items()):
+            appended = len(chunk)
+            self.cumulative_shard_hashes[shard] = crc32c(
+                chunk, self.cumulative_shard_hashes[shard]
+            )
+        self.total_chunk_size += appended
+
+    def get_chunk_hash(self, shard: int) -> int:
+        return self.cumulative_shard_hashes[shard]
+
+    def get_total_chunk_size(self) -> int:
+        return self.total_chunk_size
+
+    def get_total_logical_size(self, sinfo: StripeInfo) -> int:
+        return self.total_chunk_size * (
+            sinfo.stripe_width // sinfo.chunk_size
+        )
+
+    # -- wire form (dict-based; the osd layer stores it as a shard xattr) --
+
+    def to_dict(self) -> dict:
+        return {
+            "total_chunk_size": self.total_chunk_size,
+            "cumulative_shard_hashes": list(self.cumulative_shard_hashes),
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "HashInfo":
+        h = cls(len(d["cumulative_shard_hashes"]))
+        h.total_chunk_size = d["total_chunk_size"]
+        h.cumulative_shard_hashes = list(d["cumulative_shard_hashes"])
+        return h
+
+
+HINFO_KEY = "hinfo_key"
+
+
+def is_hinfo_key_string(key: str) -> bool:
+    return key == HINFO_KEY

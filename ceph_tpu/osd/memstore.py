@@ -1,0 +1,105 @@
+"""In-memory ObjectStore (MemStore equivalent).
+
+Reference: src/os/memstore/MemStore.cc -- the in-RAM ObjectStore used by
+unit tests; transactions apply atomically (reference ObjectStore semantics:
+a queued transaction either fully commits or not at all).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.osd.types import Transaction
+
+
+class MemObject:
+    def __init__(self):
+        self.data = bytearray()
+        self.xattrs: Dict[str, object] = {}
+
+
+class MemStore:
+    def __init__(self):
+        self._objects: Dict[str, MemObject] = {}
+        self._lock = threading.Lock()
+
+    # -- transactions ------------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        """Apply atomically (all ops under one lock, staged then swapped)."""
+        with self._lock:
+            staged: Dict[str, Optional[MemObject]] = {}
+
+            def obj_for(oid: str) -> MemObject:
+                if oid not in staged:
+                    existing = self._objects.get(oid)
+                    clone = MemObject()
+                    if existing is not None:
+                        clone.data = bytearray(existing.data)
+                        clone.xattrs = dict(existing.xattrs)
+                    staged[oid] = clone
+                return staged[oid]  # type: ignore[return-value]
+
+            for op in txn.ops:
+                if op.op == "write":
+                    o = obj_for(op.oid)
+                    end = op.offset + len(op.data)
+                    if len(o.data) < end:
+                        o.data.extend(b"\0" * (end - len(o.data)))
+                    o.data[op.offset : end] = op.data
+                elif op.op == "setattr":
+                    obj_for(op.oid).xattrs[op.attr_name] = op.attr_value
+                elif op.op == "truncate":
+                    o = obj_for(op.oid)
+                    if op.offset < len(o.data):
+                        del o.data[op.offset :]
+                    else:
+                        o.data.extend(b"\0" * (op.offset - len(o.data)))
+                elif op.op == "remove":
+                    staged[op.oid] = None
+                else:
+                    raise ValueError(f"unknown op {op.op}")
+            for oid, obj in staged.items():
+                if obj is None:
+                    self._objects.pop(oid, None)
+                else:
+                    self._objects[oid] = obj
+
+    # -- reads -------------------------------------------------------------
+
+    def read(self, oid: str, offset: int = 0, length: int = -1) -> bytes:
+        with self._lock:
+            obj = self._objects.get(oid)
+            if obj is None:
+                raise FileNotFoundError(oid)
+            if length < 0:
+                return bytes(obj.data[offset:])
+            return bytes(obj.data[offset : offset + length])
+
+    def getattr(self, oid: str, name: str):
+        with self._lock:
+            obj = self._objects.get(oid)
+            if obj is None:
+                raise FileNotFoundError(oid)
+            return obj.xattrs.get(name)
+
+    def stat(self, oid: str) -> int:
+        with self._lock:
+            obj = self._objects.get(oid)
+            if obj is None:
+                raise FileNotFoundError(oid)
+            return len(obj.data)
+
+    def exists(self, oid: str) -> bool:
+        with self._lock:
+            return oid in self._objects
+
+    def list_objects(self) -> List[str]:
+        with self._lock:
+            return sorted(self._objects.keys())
+
+    # test hook: corrupt a byte (scrub/EIO-path tests)
+    def corrupt(self, oid: str, offset: int) -> None:
+        with self._lock:
+            self._objects[oid].data[offset] ^= 0xFF

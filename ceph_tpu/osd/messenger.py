@@ -1,0 +1,107 @@
+"""Async messenger-lite: typed message bus with fault injection.
+
+Plays the role of the reference's Messenger/Connection/Dispatcher stack
+(reference: src/msg/Messenger.h:40, AsyncMessenger event loops) for the
+in-process mini-cluster: entities register a dispatcher, connections carry
+ordered messages, and a config-driven fault injector can drop or delay
+messages (the ms_inject_socket_failures / ms_inject_delay analogue,
+reference: src/common/options.cc:735-756).
+
+asyncio-based: each entity's dispatch loop is a task; send_message is
+fire-and-forget like the reference's lossy client policy, with sequence
+numbers preserved per connection (lossless-peer ordering).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Awaitable, Callable, Dict, Optional
+
+
+class FaultInjector:
+    """ms_inject_* analogue; probabilities in [0, 1]."""
+
+    def __init__(self, drop_probability: float = 0.0, delay_probability: float = 0.0,
+                 max_delay: float = 0.0, seed: int = 0):
+        self.drop_probability = drop_probability
+        self.delay_probability = delay_probability
+        self.max_delay = max_delay
+        self._rng = random.Random(seed)
+        self.dropped = 0
+
+    def maybe_drop(self) -> bool:
+        if self.drop_probability and self._rng.random() < self.drop_probability:
+            self.dropped += 1
+            return True
+        return False
+
+    async def maybe_delay(self) -> None:
+        if self.delay_probability and self._rng.random() < self.delay_probability:
+            await asyncio.sleep(self._rng.random() * self.max_delay)
+
+
+class Messenger:
+    """Process-wide bus; entities are addressed by name ("osd.3", "client")."""
+
+    def __init__(self, fault: Optional[FaultInjector] = None):
+        self._queues: Dict[str, asyncio.Queue] = {}
+        self._dispatchers: Dict[str, Callable] = {}
+        self._tasks: Dict[str, asyncio.Task] = {}
+        self._down: set = set()
+        self.fault = fault or FaultInjector()
+        self._seq = 0
+
+    def register(self, name: str, dispatcher: Callable[[str, object], Awaitable[None]]):
+        """dispatcher(from_name, message) coroutine; starts the entity's
+        dispatch loop (the reference's ms_fast_dispatch role)."""
+        self._queues[name] = asyncio.Queue()
+        self._dispatchers[name] = dispatcher
+        self._tasks[name] = asyncio.get_event_loop().create_task(
+            self._dispatch_loop(name)
+        )
+
+    async def _dispatch_loop(self, name: str):
+        queue = self._queues[name]
+        while True:
+            src, msg = await queue.get()
+            if name in self._down:
+                continue  # dropped on the floor like a dead OSD
+            try:
+                await self._dispatchers[name](src, msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 -- a dispatcher crash
+                import sys, traceback
+
+                traceback.print_exc(file=sys.stderr)
+
+    async def send_message(self, src: str, dst: str, msg: object) -> None:
+        """Ordered, lossy-under-injection delivery."""
+        if dst in self._down or dst not in self._queues:
+            return  # lossy: messages to dead peers vanish
+        if self.fault.maybe_drop():
+            return
+        await self.fault.maybe_delay()
+        self._seq += 1
+        await self._queues[dst].put((src, msg))
+
+    # -- failure control (thrasher hooks) ----------------------------------
+
+    def mark_down(self, name: str) -> None:
+        self._down.add(name)
+
+    def mark_up(self, name: str) -> None:
+        self._down.discard(name)
+
+    def is_down(self, name: str) -> bool:
+        return name in self._down
+
+    async def shutdown(self) -> None:
+        for task in self._tasks.values():
+            task.cancel()
+        for task in self._tasks.values():
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass

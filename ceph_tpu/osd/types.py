@@ -1,0 +1,105 @@
+"""Wire/value types for the EC storage backend (ECMsgTypes equivalents).
+
+Reference: src/osd/ECMsgTypes.h -- ECSubWrite (:23-89), ECSubWriteReply
+(:91-103), ECSubRead (:105), ECSubReadReply (:118); ObjectStore::Transaction
+(src/os/Transaction.cc) reduced to the op set the EC path uses.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class TxnOp:
+    """A single ObjectStore transaction op (append/write/setattr/remove)."""
+
+    op: str  # "write" | "setattr" | "remove" | "truncate"
+    oid: str = ""
+    offset: int = 0
+    data: bytes = b""
+    attr_name: str = ""
+    attr_value: object = None
+
+
+@dataclasses.dataclass
+class Transaction:
+    ops: List[TxnOp] = dataclasses.field(default_factory=list)
+
+    def write(self, oid: str, offset: int, data: bytes) -> "Transaction":
+        self.ops.append(TxnOp("write", oid=oid, offset=offset, data=bytes(data)))
+        return self
+
+    def setattr(self, oid: str, name: str, value) -> "Transaction":
+        self.ops.append(
+            TxnOp("setattr", oid=oid, attr_name=name, attr_value=value)
+        )
+        return self
+
+    def remove(self, oid: str) -> "Transaction":
+        self.ops.append(TxnOp("remove", oid=oid))
+        return self
+
+    def truncate(self, oid: str, offset: int) -> "Transaction":
+        self.ops.append(TxnOp("truncate", oid=oid, offset=offset))
+        return self
+
+
+@dataclasses.dataclass
+class LogEntry:
+    """Minimal pg-log entry: enough for rollback-aware appends
+    (reference: ECSubWrite carries log entries + rollback versions,
+    doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27)."""
+
+    version: int
+    oid: str
+    op: str  # "append" | "touch" | "delete"
+    prior_size: int = 0  # for append rollback
+
+
+@dataclasses.dataclass
+class ECSubWrite:
+    from_shard: int
+    tid: int
+    oid: str
+    transaction: Transaction
+    at_version: int
+    log_entries: List[LogEntry] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class ECSubWriteReply:
+    from_shard: int
+    tid: int
+    committed: bool = False
+    applied: bool = False
+
+
+@dataclasses.dataclass
+class ECSubRead:
+    from_shard: int
+    tid: int
+    # oid -> list of (offset, length) chunk-space extents
+    to_read: Dict[str, List[Tuple[int, int]]] = dataclasses.field(
+        default_factory=dict
+    )
+    attrs_to_read: List[str] = dataclasses.field(default_factory=list)
+    subchunks: Dict[str, List[Tuple[int, int]]] = dataclasses.field(
+        default_factory=dict
+    )
+
+
+@dataclasses.dataclass
+class ECSubReadReply:
+    from_shard: int
+    tid: int
+    buffers_read: Dict[str, List[Tuple[int, bytes]]] = dataclasses.field(
+        default_factory=dict
+    )
+    attrs_read: Dict[str, Dict[str, object]] = dataclasses.field(
+        default_factory=dict
+    )
+    errors: Dict[str, int] = dataclasses.field(default_factory=dict)

@@ -1,0 +1,80 @@
+"""Perf counters + admin-socket-style dump (PerfCounters equivalent).
+
+Reference: src/common/perf_counters.h:53 PerfCountersBuilder and the
+admin-socket ``perf dump`` command (src/common/admin_socket.cc).  Counters
+are typed (counts, sums, time averages); every subsystem instance registers
+in a process-wide collection that ``dump()`` serializes like
+``ceph daemon <sock> perf dump``.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import defaultdict
+from typing import Dict
+
+
+class PerfCounters:
+    _collection: Dict[str, "PerfCounters"] = {}
+    _collection_lock = threading.Lock()
+
+    def __init__(self, name: str):
+        self.name = name
+        self._lock = threading.Lock()
+        self._counters: Dict[str, int] = defaultdict(int)
+        self._sums: Dict[str, float] = defaultdict(float)
+        self._counts: Dict[str, int] = defaultdict(int)
+        with PerfCounters._collection_lock:
+            PerfCounters._collection[name] = self
+
+    def inc(self, key: str, amount: int = 1) -> None:
+        with self._lock:
+            self._counters[key] += amount
+
+    def tinc(self, key: str, seconds: float) -> None:
+        """Time/average counter (latency style)."""
+        with self._lock:
+            self._sums[key] += seconds
+            self._counts[key] += 1
+
+    def time(self, key: str):
+        """Context manager measuring a code block into a tinc counter."""
+        outer = self
+
+        class _Timer:
+            def __enter__(self):
+                self.t0 = time.perf_counter()
+                return self
+
+            def __exit__(self, *exc):
+                outer.tinc(key, time.perf_counter() - self.t0)
+                return False
+
+        return _Timer()
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out: dict = dict(self._counters)
+            for key in self._sums:
+                out[key] = {
+                    "avgcount": self._counts[key],
+                    "sum": self._sums[key],
+                }
+            return out
+
+    @classmethod
+    def dump(cls) -> str:
+        """The `perf dump` admin-socket command."""
+        with cls._collection_lock:
+            return json.dumps(
+                {name: pc.snapshot() for name, pc in cls._collection.items()},
+                indent=2,
+                sort_keys=True,
+            )
+
+    @classmethod
+    def reset_all(cls) -> None:
+        with cls._collection_lock:
+            cls._collection.clear()
