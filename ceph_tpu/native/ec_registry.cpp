@@ -1,0 +1,113 @@
+// Native plugin registry implementation (see ec_plugin.h).
+//
+// Reference behavior being mirrored: ErasureCodePluginRegistry::load
+// (src/erasure-code/ErasureCodePlugin.cc:126-184): dlopen, version symbol
+// check (mismatch -> -EXDEV), init entry point (missing -> -ENOENT, error
+// propagates), registered-check (-EBADF), mutex-guarded singleton state.
+
+#include "ec_plugin.h"
+
+#include <dlfcn.h>
+#include <cerrno>
+#include <cstdio>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_lock;
+std::map<std::string, ec_plugin *> g_plugins;
+thread_local std::string g_last_error;
+
+void set_error(const std::string &msg) { g_last_error = msg; }
+
+}  // namespace
+
+extern "C" {
+
+const char *ec_registry_last_error(void) { return g_last_error.c_str(); }
+
+int ec_registry_add(const char *name, struct ec_plugin *plugin) {
+  std::lock_guard<std::mutex> l(g_lock);
+  if (g_plugins.count(name)) {
+    set_error(std::string(name) + " already registered");
+    return -EEXIST;
+  }
+  g_plugins[name] = plugin;
+  return 0;
+}
+
+struct ec_plugin *ec_registry_get(const char *name) {
+  std::lock_guard<std::mutex> l(g_lock);
+  auto it = g_plugins.find(name);
+  return it == g_plugins.end() ? nullptr : it->second;
+}
+
+int ec_registry_load(const char *name, const char *dir) {
+  {
+    std::lock_guard<std::mutex> l(g_lock);
+    if (g_plugins.count(name)) return 0;
+  }
+  std::string path = std::string(dir) + "/libec_" + name + ".so";
+  void *handle = dlopen(path.c_str(), RTLD_NOW);
+  if (!handle) {
+    set_error(std::string("dlopen(") + path + "): " + dlerror());
+    return -ENOENT;
+  }
+  using version_fn = const char *(*)();
+  auto version =
+      reinterpret_cast<version_fn>(dlsym(handle, "__erasure_code_version"));
+  if (!version) {
+    set_error(std::string(name) +
+              " plugin has no version (loaded from an older version?)");
+    dlclose(handle);
+    return -EXDEV;
+  }
+  if (std::strcmp(version(), CEPH_TPU_EC_VERSION) != 0) {
+    set_error(std::string(name) + " version " + version() +
+              " != expected " CEPH_TPU_EC_VERSION);
+    dlclose(handle);
+    return -EXDEV;
+  }
+  using init_fn = int (*)(const char *, const char *);
+  auto init =
+      reinterpret_cast<init_fn>(dlsym(handle, "__erasure_code_init"));
+  if (!init) {
+    set_error(std::string(name) + " plugin is missing the entry point");
+    dlclose(handle);
+    return -ENOENT;
+  }
+  int r = init(name, dir);
+  if (r < 0) {
+    set_error(std::string(name) + " init failed");
+    dlclose(handle);
+    return r;
+  }
+  {
+    std::lock_guard<std::mutex> l(g_lock);
+    if (!g_plugins.count(name)) {
+      set_error(std::string(name) +
+                " initialized but did not register itself");
+      dlclose(handle);
+      return -EBADF;
+    }
+  }
+  // handle intentionally kept open (disable_dlclose semantics: plugins
+  // stay mapped for the process lifetime, reference ErasureCodePlugin.h:49)
+  return 0;
+}
+
+struct ec_codec *ec_registry_factory(const char *name, const char *dir,
+                                     const char *const *profile) {
+  if (!ec_registry_get(name)) {
+    int r = ec_registry_load(name, dir);
+    if (r < 0) return nullptr;
+  }
+  ec_plugin *plugin = ec_registry_get(name);
+  if (!plugin) return nullptr;
+  return plugin->factory(profile);
+}
+
+}  // extern "C"

@@ -1,0 +1,139 @@
+"""ctypes harness for the native (dlopen) plugin registry.
+
+Drives libec_registry.so the way the reference's daemons drive
+ErasureCodePluginRegistry: load plugins by name from a directory, get a
+codec via a profile, run encode/decode through the C vtable.  Used by tests
+and available to the OSD layer as a native codec path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SO = os.path.join(_DIR, "libec_registry.so")
+
+
+class _CodecStruct(ctypes.Structure):
+    _fields_ = [
+        ("k", ctypes.c_int),
+        ("m", ctypes.c_int),
+        ("priv", ctypes.c_void_p),
+        ("encode", ctypes.c_void_p),
+        ("decode", ctypes.c_void_p),
+        ("destroy", ctypes.c_void_p),
+    ]
+
+
+_ENCODE_T = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.POINTER(_CodecStruct),
+    ctypes.POINTER(ctypes.c_void_p),
+    ctypes.POINTER(ctypes.c_void_p),
+    ctypes.c_size_t,
+)
+_DECODE_T = ctypes.CFUNCTYPE(
+    ctypes.c_int,
+    ctypes.POINTER(_CodecStruct),
+    ctypes.POINTER(ctypes.c_void_p),
+    ctypes.POINTER(ctypes.c_int),
+    ctypes.c_size_t,
+)
+
+
+def _build() -> None:
+    subprocess.run(["make", "-C", _DIR], check=True, capture_output=True)
+
+
+def _load() -> ctypes.CDLL:
+    if not os.path.exists(_SO):
+        _build()
+    # RTLD_GLOBAL so plugin .so's resolve ec_registry_add from us
+    lib = ctypes.CDLL(_SO, mode=ctypes.RTLD_GLOBAL)
+    lib.ec_registry_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
+    lib.ec_registry_load.restype = ctypes.c_int
+    lib.ec_registry_factory.argtypes = [
+        ctypes.c_char_p,
+        ctypes.c_char_p,
+        ctypes.POINTER(ctypes.c_char_p),
+    ]
+    lib.ec_registry_factory.restype = ctypes.POINTER(_CodecStruct)
+    lib.ec_registry_last_error.restype = ctypes.c_char_p
+    return lib
+
+
+_lib: Optional[ctypes.CDLL] = None
+
+
+def lib() -> ctypes.CDLL:
+    global _lib
+    if _lib is None:
+        _lib = _load()
+    return _lib
+
+
+def load(name: str, directory: str = _DIR) -> int:
+    """Returns 0 or -errno (mirrors ErasureCodePluginRegistry::load)."""
+    return lib().ec_registry_load(name.encode(), directory.encode())
+
+
+def last_error() -> str:
+    return lib().ec_registry_last_error().decode()
+
+
+class NativeCodec:
+    def __init__(self, struct_ptr):
+        self._ptr = struct_ptr
+        self.k = struct_ptr.contents.k
+        self.m = struct_ptr.contents.m
+        self._encode = ctypes.cast(struct_ptr.contents.encode, _ENCODE_T)
+        self._decode = ctypes.cast(struct_ptr.contents.decode, _DECODE_T)
+
+    def encode(self, data: Sequence[np.ndarray]) -> List[np.ndarray]:
+        n = len(data[0])
+        coding = [np.zeros(n, dtype=np.uint8) for _ in range(self.m)]
+        dptr = (ctypes.c_void_p * self.k)(
+            *[d.ctypes.data_as(ctypes.c_void_p) for d in data]
+        )
+        cptr = (ctypes.c_void_p * self.m)(
+            *[c.ctypes.data_as(ctypes.c_void_p) for c in coding]
+        )
+        rc = self._encode(self._ptr, dptr, cptr, n)
+        if rc:
+            raise RuntimeError(f"native encode failed: {rc}")
+        return coding
+
+    def decode(
+        self, chunks: Dict[int, np.ndarray], erased: Sequence[int], n: int
+    ) -> Dict[int, np.ndarray]:
+        km = self.k + self.m
+        bufs = []
+        for i in range(km):
+            if i in chunks:
+                bufs.append(np.ascontiguousarray(chunks[i], dtype=np.uint8))
+            else:
+                bufs.append(np.zeros(n, dtype=np.uint8))
+        cptr = (ctypes.c_void_p * km)(
+            *[b.ctypes.data_as(ctypes.c_void_p) for b in bufs]
+        )
+        earr = (ctypes.c_int * (len(erased) + 1))(*erased, -1)
+        rc = self._decode(self._ptr, cptr, earr, n)
+        if rc:
+            raise RuntimeError(f"native decode failed: {rc}")
+        return {i: bufs[i] for i in range(km)}
+
+
+def factory(
+    name: str, profile: Dict[str, str], directory: str = _DIR
+) -> NativeCodec:
+    items = [f"{k}={v}".encode() for k, v in profile.items()]
+    arr = (ctypes.c_char_p * (len(items) + 1))(*items, None)
+    ptr = lib().ec_registry_factory(name.encode(), directory.encode(), arr)
+    if not ptr:
+        raise RuntimeError(f"factory({name}) failed: {last_error()}")
+    return NativeCodec(ptr)

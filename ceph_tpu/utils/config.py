@@ -1,0 +1,127 @@
+"""Typed configuration schema + runtime config (options.cc / md_config_t
+equivalents).
+
+Reference: src/common/options.cc declares every option once with type,
+default, level and description; src/common/config.cc layers conf-file /
+env / CLI / runtime overrides with change observers.  Same shape here:
+a single OPTIONS schema, a Config that validates against it, observer
+callbacks on apply_changes, and typed get_val access.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import threading
+from typing import Any, Callable, Dict, List, Optional
+
+LEVEL_BASIC = "basic"
+LEVEL_ADVANCED = "advanced"
+LEVEL_DEV = "dev"
+
+
+@dataclasses.dataclass
+class Option:
+    name: str
+    type: type
+    default: Any
+    level: str = LEVEL_ADVANCED
+    description: str = ""
+    see_also: tuple = ()
+
+
+def _opt(name, typ, default, level=LEVEL_ADVANCED, desc="", see_also=()):
+    return Option(name, typ, default, level, desc, see_also)
+
+
+#: the schema (reference: src/common/options.cc; EC-relevant subset + ours)
+OPTIONS: Dict[str, Option] = {
+    o.name: o
+    for o in [
+        _opt("erasure_code_dir", str, "", LEVEL_ADVANCED,
+             "directory for out-of-tree erasure code plugins"),
+        _opt("osd_erasure_code_plugins", str, "jerasure lrc isa tpu",
+             LEVEL_ADVANCED, "plugins preloaded at daemon start"),
+        _opt("osd_pool_default_erasure_code_profile", str,
+             "plugin=jerasure technique=reed_sol_van k=2 m=1",
+             LEVEL_ADVANCED, "default EC profile for new pools"),
+        _opt("ec_backend", str, "auto", LEVEL_BASIC,
+             "codec compute backend: auto|cpu|native|tpu"),
+        _opt("ec_tpu_tile", int, 4096, LEVEL_DEV,
+             "pallas kernel lane tile (int32 lanes)"),
+        _opt("ec_batch_stripes", int, 64, LEVEL_ADVANCED,
+             "stripes fused per device dispatch in the batching shim"),
+        _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
+             "max bytes per recovery window"),
+        _opt("ms_inject_socket_failures", int, 0, LEVEL_DEV,
+             "inject a message drop roughly every N messages"),
+        _opt("ms_inject_internal_delays", float, 0.0, LEVEL_DEV,
+             "probability of injected message delay"),
+        _opt("debug_ec", int, 0, LEVEL_DEV, "EC subsystem log level 0..20"),
+        _opt("debug_osd", int, 0, LEVEL_DEV, "OSD subsystem log level 0..20"),
+        _opt("debug_ms", int, 0, LEVEL_DEV, "messenger log level 0..20"),
+    ]
+}
+
+
+class Config:
+    """Layered config with observers (md_config_t role)."""
+
+    def __init__(self, overrides: Optional[Dict[str, Any]] = None):
+        self._lock = threading.Lock()
+        self._values: Dict[str, Any] = {}
+        self._observers: List[Callable[[set], None]] = []
+        # env layer: CEPH_TPU_<NAME>
+        for name, opt in OPTIONS.items():
+            env = os.environ.get("CEPH_TPU_" + name.upper())
+            if env is not None:
+                self._values[name] = self._coerce(opt, env)
+        if overrides:
+            for key, val in overrides.items():
+                self.set_val(key, val)
+
+    @staticmethod
+    def _coerce(opt: Option, value: Any):
+        if opt.type is bool and isinstance(value, str):
+            return value.lower() in ("1", "true", "yes")
+        return opt.type(value)
+
+    def get_val(self, name: str):
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise KeyError(f"no such option: {name}")
+        with self._lock:
+            return self._values.get(name, opt.default)
+
+    def set_val(self, name: str, value) -> None:
+        opt = OPTIONS.get(name)
+        if opt is None:
+            raise KeyError(f"no such option: {name}")
+        with self._lock:
+            self._values[name] = self._coerce(opt, value)
+
+    def add_observer(self, fn: Callable[[set], None]) -> None:
+        self._observers.append(fn)
+
+    def apply_changes(self, changes: Dict[str, Any]) -> None:
+        changed = set()
+        for key, val in changes.items():
+            self.set_val(key, val)
+            changed.add(key)
+        for fn in self._observers:
+            fn(changed)
+
+    def show_config(self) -> Dict[str, Any]:
+        return {name: self.get_val(name) for name in sorted(OPTIONS)}
+
+
+_global: Optional[Config] = None
+_global_lock = threading.Lock()
+
+
+def get_config() -> Config:
+    global _global
+    with _global_lock:
+        if _global is None:
+            _global = Config()
+        return _global

@@ -1,0 +1,39 @@
+"""Native dlopen registry tests (C++ twin of test_plugins registry suite)."""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.native import registry_native as reg
+
+
+def test_load_and_factory_xor():
+    assert reg.load("xor_native") == 0
+    codec = reg.factory("xor_native", {"k": "4"})
+    assert codec.k == 4 and codec.m == 1
+    rng = np.random.RandomState(0)
+    data = [rng.randint(0, 256, 512).astype(np.uint8) for _ in range(4)]
+    coding = codec.encode(data)
+    expect = data[0] ^ data[1] ^ data[2] ^ data[3]
+    assert np.array_equal(coding[0], expect)
+    # recover an erased data chunk
+    chunks = {i: d for i, d in enumerate(data)}
+    chunks[4] = coding[0]
+    del chunks[2]
+    out = codec.decode(chunks, [2], 512)
+    assert np.array_equal(out[2], data[2])
+
+
+@pytest.mark.parametrize(
+    "name,errno_expected",
+    [
+        ("missing_version_native", -18),   # -EXDEV
+        ("wrong_version_native", -18),     # -EXDEV
+        ("missing_entry_point_native", -2),  # -ENOENT
+        ("fail_to_initialize_native", -3),   # -ESRCH
+        ("fail_to_register_native", -9),     # -EBADF
+        ("no_such_plugin_native", -2),       # -ENOENT (no file)
+    ],
+)
+def test_load_failures(name, errno_expected):
+    rc = reg.load(name)
+    assert rc == errno_expected, (name, rc, reg.last_error())

@@ -1,0 +1,96 @@
+"""Thrashing chaos test + config/log substrate tests.
+
+The thrash loop mirrors qa/tasks/ceph_manager.py:98 Thrasher (kill_osd :195,
+revive_osd :373) at mini scale: continuous writes/reads while OSDs bounce,
+never exceeding the code's m-failure tolerance, with message delay injection
+active.
+"""
+
+import asyncio
+import os
+import random
+
+import pytest
+
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osd.messenger import FaultInjector
+from ceph_tpu.utils.config import Config, get_config
+from ceph_tpu.utils.log import dout, recent_entries, should_gather
+from ceph_tpu.utils.perf import PerfCounters
+
+
+def test_config_schema():
+    cfg = Config()
+    assert cfg.get_val("osd_erasure_code_plugins") == "jerasure lrc isa tpu"
+    cfg.set_val("ec_backend", "tpu")
+    assert cfg.get_val("ec_backend") == "tpu"
+    with pytest.raises(KeyError):
+        cfg.get_val("no_such_option")
+    seen = []
+    cfg.add_observer(lambda changed: seen.append(changed))
+    cfg.apply_changes({"debug_ec": 10})
+    assert seen == [{"debug_ec"}]
+    assert cfg.get_val("debug_ec") == 10
+    assert "ec_batch_stripes" in cfg.show_config()
+
+
+def test_log_gating():
+    get_config().apply_changes({"debug_ec": 5})
+    dout("ec", 1, "gathered")
+    dout("ec", 10, "not gathered")
+    assert should_gather("ec", 5)
+    assert not should_gather("ec", 6)
+    msgs = [e[3] for e in recent_entries()]
+    assert "gathered" in msgs
+    assert "not gathered" not in msgs
+    get_config().apply_changes({"debug_ec": 0})
+
+
+def test_thrash_cluster():
+    PROFILE = {
+        "k": "4",
+        "m": "2",
+        "technique": "reed_sol_van",
+        "plugin": "jerasure",
+    }
+
+    async def main():
+        PerfCounters.reset_all()
+        fault = FaultInjector(
+            delay_probability=0.3, max_delay=0.002, seed=42
+        )
+        cluster = ECCluster(10, dict(PROFILE), fault=fault)
+        rng = random.Random(7)
+        objects = {}
+        down = []
+        for round_no in range(30):
+            # thrash: bounce OSDs but never exceed m=2 down
+            if down and rng.random() < 0.4:
+                cluster.revive_osd(down.pop())
+            elif len(down) < 2 and rng.random() < 0.5:
+                victim = rng.randrange(10)
+                if victim not in down:
+                    cluster.kill_osd(victim)
+                    down.append(victim)
+            oid = f"obj{rng.randrange(8)}"
+            # write only when every acting shard is reachable (the mini
+            # backend has no pg-log backfill yet; degraded WRITES are a
+            # known gap tracked in PARITY.md)
+            acting = cluster.backend.acting_set(oid)
+            acting_up = all(a not in down for a in acting)
+            if (oid not in objects or rng.random() < 0.4) and acting_up:
+                data = os.urandom(rng.randrange(1, 20000))
+                await cluster.write(oid, data)
+                objects[oid] = data
+            elif oid in objects:
+                n_down_shards = sum(a in down for a in acting)
+                if n_down_shards <= 2:
+                    got = await cluster.read(oid)
+                    assert got == objects[oid], f"round {round_no} {oid}"
+        for osd in list(down):
+            cluster.revive_osd(osd)
+        for oid, data in objects.items():
+            assert await cluster.read(oid) == data
+        await cluster.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())
