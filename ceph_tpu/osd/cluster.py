@@ -56,5 +56,8 @@ class ECCluster:
     ) -> None:
         await self.backend.recover_shard(oid, shard, target_osd)
 
+    async def deep_scrub(self, oid: str) -> dict:
+        return await self.backend.deep_scrub(oid)
+
     async def shutdown(self) -> None:
         await self.messenger.shutdown()

@@ -53,11 +53,14 @@ class OSDShard:
     """One OSD daemon holding one shard position per object it stores."""
 
     def __init__(self, osd_id: int, messenger: Messenger):
+        from ceph_tpu.osd.pglog import PGLog
+
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
         self.store = MemStore()
         self.messenger = messenger
         self.perf = PerfCounters(f"osd.{osd_id}")
+        self.pglog = PGLog()
         messenger.register(self.name, self.dispatch)
 
     async def dispatch(self, src: str, msg) -> None:
@@ -67,7 +70,25 @@ class OSDShard:
             await self.handle_sub_read(src, msg)
 
     async def handle_sub_write(self, src: str, msg: ECSubWrite) -> None:
-        """reference ECBackend::handle_sub_write (:922)."""
+        """reference ECBackend::handle_sub_write (:922): log the operation,
+        then apply the transaction (log_operation + queue_transactions)."""
+        from ceph_tpu.osd.pglog import PGLogEntry
+
+        soid = shard_oid(msg.oid, msg.from_shard)
+        try:
+            prior = self.store.stat(soid)
+        except FileNotFoundError:
+            prior = 0
+        if msg.at_version > self.pglog.head_version:
+            self.pglog.append(
+                PGLogEntry(
+                    version=msg.at_version,
+                    oid=soid,
+                    op="append",
+                    prior_size=prior,
+                )
+            )
+            self.pglog.maybe_trim()
         self.store.queue_transaction(msg.transaction)
         self.perf.inc("sub_write")
         reply = ECSubWriteReply(
@@ -185,14 +206,21 @@ class ECBackend:
 
     async def write(self, oid: str, data: bytes) -> None:
         """Append-only full-object write (create or replace)."""
-        version = self._versions.get(oid, 0) + 1
+        # pg-wide dense version (the eversion analogue): shards log every
+        # write in order so divergence is detectable and rollbackable
+        version = max(self._versions.values(), default=0) + 1
         self._versions[oid] = version
         logical = len(data)
         padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
         buf = np.zeros(padded_len, dtype=np.uint8)
         buf[:logical] = np.frombuffer(data, dtype=np.uint8)
 
+        from ceph_tpu.utils import trace
+
+        span = trace.new_trace("ec write")
+        span.event("start_rmw")
         encoded = ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+        span.event("encoded")
         hinfo = ecutil.HashInfo(self.km)
         hinfo.append(0, encoded)
 
@@ -224,11 +252,15 @@ class ECBackend:
                 at_version=version,
                 log_entries=[entry],
             )
-            await self.messenger.send_message(
-                self.name, f"osd.{acting[s]}", sub
-            )
+            with span.child("ec sub write") as sub_span:
+                sub_span.event(f"shard {s} -> osd.{acting[s]}")
+                await self.messenger.send_message(
+                    self.name, f"osd.{acting[s]}", sub
+                )
         self.perf.inc("write")
         await asyncio.wait_for(done, timeout=30)
+        span.event("all_commit")
+        span.finish()
         del self._pending[tid]
 
     # -- read path ---------------------------------------------------------
@@ -308,6 +340,51 @@ class ECBackend:
         data = ecutil.decode_concat(self.sinfo, self.ec, chunks)
         self.perf.inc("read")
         return data[:logical_size]
+
+    # -- scrub -------------------------------------------------------------
+
+    async def deep_scrub(self, oid: str) -> dict:
+        """Read every shard, verify per-shard crc32c and parity consistency
+        (re-encode data shards and compare coding) -- the EC deep-scrub role
+        (reference: PG scrub + ECBackend crc checks; inconsistency report
+        shape follows ScrubStore's per-object errors)."""
+        acting = self.acting_set(oid)
+        up = [
+            s
+            for s in range(self.km)
+            if not self.messenger.is_down(f"osd.{acting[s]}")
+        ]
+        replies = await self._read_shards(oid, up, acting)
+        report = {
+            "oid": oid,
+            "crc_errors": [],
+            "missing": [],
+            "parity_mismatch": [],
+            "ok": True,
+        }
+        chunks: Dict[int, np.ndarray] = {}
+        for s in up:
+            reply = replies.get(s)
+            if reply is None or oid in (reply.errors if reply else {}):
+                (report["crc_errors"] if reply else report["missing"]).append(s)
+                continue
+            bufs = reply.buffers_read.get(oid)
+            if bufs:
+                chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
+            else:
+                report["missing"].append(s)
+        data_shards = [s for s in range(self.k) if s in chunks]
+        if len(data_shards) == self.k:
+            data = np.stack([chunks[s] for s in range(self.k)])
+            fresh = self.ec.encode(set(range(self.km)), data.reshape(-1))
+            for s in range(self.k, self.km):
+                if s in chunks and not np.array_equal(fresh[s], chunks[s]):
+                    report["parity_mismatch"].append(s)
+        report["ok"] = not (
+            report["crc_errors"] or report["missing"] or report["parity_mismatch"]
+        )
+        self.perf.inc("deep_scrub")
+        return report
 
     # -- recovery ----------------------------------------------------------
 

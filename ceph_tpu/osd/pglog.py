@@ -1,0 +1,88 @@
+"""PG-log-lite: bounded per-object op log with append rollback.
+
+Reference: src/osd/PGLog.{h,cc} and the EC-specific rollback design
+(doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27, ECSubWrite
+trim_to/roll_forward_to ECMsgTypes.h:33-35): EC writes are logged with
+enough metadata (prior append sizes) that a divergent shard can ROLL BACK
+an uncommitted append by truncating, instead of needing the other shards.
+This is the storage-system checkpoint/resume mechanism: after a restart a
+shard replays/trims its log to converge with the authoritative log.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional
+
+from ceph_tpu.osd.memstore import MemStore
+from ceph_tpu.osd.types import Transaction
+
+
+@dataclasses.dataclass
+class PGLogEntry:
+    version: int
+    oid: str  # shard object id
+    op: str  # "append" | "touch" | "delete"
+    prior_size: int = 0  # rollback point for appends
+    rollbackable: bool = True
+
+
+class PGLog:
+    """Ordered log with head/tail, divergence trim, and rollback apply."""
+
+    def __init__(self, trim_target: int = 1000):
+        self.entries: List[PGLogEntry] = []
+        self.tail_version = 0
+        self.trim_target = trim_target
+
+    @property
+    def head_version(self) -> int:
+        return self.entries[-1].version if self.entries else self.tail_version
+
+    def append(self, entry: PGLogEntry) -> None:
+        # monotonic, not dense: a shard only logs writes it participates in
+        assert entry.version > self.head_version, "log must be ordered"
+        self.entries.append(entry)
+
+    def trim(self, to_version: int) -> None:
+        """Drop entries <= to_version (they are durable everywhere);
+        trimmed entries can no longer be rolled back
+        (reference ECSubWrite.trim_to)."""
+        keep = [e for e in self.entries if e.version > to_version]
+        if keep != self.entries:
+            self.tail_version = max(self.tail_version, to_version)
+            self.entries = keep
+
+    def maybe_trim(self) -> None:
+        if len(self.entries) > self.trim_target:
+            self.trim(self.entries[-(self.trim_target)].version)
+
+    def rollback_to(self, version: int, store: MemStore) -> List[PGLogEntry]:
+        """Undo entries with version > `version` (newest first), applying the
+        inverse operation to the local store. Returns the rolled-back
+        entries. Raises if any is non-rollbackable (would need backfill)."""
+        doomed = [e for e in self.entries if e.version > version]
+        for e in reversed(doomed):
+            if not e.rollbackable:
+                raise ValueError(
+                    f"entry v{e.version} not rollbackable; needs backfill"
+                )
+            if e.op == "append":
+                store.queue_transaction(
+                    Transaction().truncate(e.oid, e.prior_size)
+                )
+            elif e.op == "touch":
+                store.queue_transaction(Transaction().remove(e.oid))
+            elif e.op == "delete":
+                raise ValueError("delete rollback requires a backfill source")
+        self.entries = [e for e in self.entries if e.version <= version]
+        return doomed
+
+    def merge_authoritative(
+        self, auth_head: int, store: MemStore
+    ) -> List[PGLogEntry]:
+        """Converge on the authoritative head: roll back any local entries
+        beyond it (the divergent-shard path after a primary change)."""
+        if self.head_version <= auth_head:
+            return []
+        return self.rollback_to(auth_head, store)

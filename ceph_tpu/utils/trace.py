@@ -1,0 +1,82 @@
+"""Lightweight distributed-trace spans (ZTracer/blkin analogue).
+
+Reference: src/common/zipkin_trace.h:40 ZTracer::Trace -- the EC write path
+carries per-shard child spans (ECBackend.cc:2003-2008 trace.init("ec sub
+write"), :931 trace.event("handle_sub_write")).  Here: spans with parent
+links, timed events, and an in-memory collector that can dump a trace tree
+(the role of the zipkin collector for tests/debugging).
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from typing import Dict, List, Optional
+
+_ids = itertools.count(1)
+_collector_lock = threading.Lock()
+_finished: List["Span"] = []
+enabled = False
+
+
+def enable(on: bool = True) -> None:
+    global enabled
+    enabled = on
+    if not on:
+        with _collector_lock:
+            _finished.clear()
+
+
+class Span:
+    __slots__ = (
+        "name", "span_id", "parent_id", "trace_id", "start", "end", "events"
+    )
+
+    def __init__(self, name: str, parent: Optional["Span"] = None):
+        self.name = name
+        self.span_id = next(_ids)
+        self.parent_id = parent.span_id if parent else 0
+        self.trace_id = parent.trace_id if parent else self.span_id
+        self.start = time.time()
+        self.end = 0.0
+        self.events: List[tuple] = []
+
+    def event(self, name: str) -> None:
+        if enabled:
+            self.events.append((time.time(), name))
+
+    def child(self, name: str) -> "Span":
+        return Span(name, parent=self)
+
+    def finish(self) -> None:
+        self.end = time.time()
+        if enabled:
+            with _collector_lock:
+                _finished.append(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.finish()
+        return False
+
+
+def new_trace(name: str) -> Span:
+    return Span(name)
+
+
+def dump() -> List[dict]:
+    with _collector_lock:
+        return [
+            {
+                "trace_id": s.trace_id,
+                "span_id": s.span_id,
+                "parent_id": s.parent_id,
+                "name": s.name,
+                "duration_ms": (s.end - s.start) * 1000 if s.end else None,
+                "events": [name for _, name in s.events],
+            }
+            for s in _finished
+        ]

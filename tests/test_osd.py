@@ -207,3 +207,59 @@ def test_perf_dump():
         await cluster.shutdown()
 
     run(main())
+
+
+# -- pg-log rollback + deep scrub ------------------------------------------
+
+
+def test_pglog_rollback():
+    from ceph_tpu.osd.pglog import PGLog, PGLogEntry
+
+    st = MemStore()
+    log = PGLog()
+    st.queue_transaction(Transaction().write("o@0", 0, b"AAAA"))
+    log.append(PGLogEntry(version=1, oid="o@0", op="append", prior_size=0))
+    st.queue_transaction(Transaction().write("o@0", 4, b"BBBB"))
+    log.append(PGLogEntry(version=2, oid="o@0", op="append", prior_size=4))
+    assert st.read("o@0") == b"AAAABBBB"
+    # divergent second append: roll back to authoritative head v1
+    rolled = log.merge_authoritative(1, st)
+    assert [e.version for e in rolled] == [2]
+    assert st.read("o@0") == b"AAAA"
+    assert log.head_version == 1
+    # trim makes old entries non-rollbackable
+    log.trim(1)
+    assert log.entries == [] and log.tail_version == 1
+
+
+def test_shard_pglog_records_writes():
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(6, dict(PROFILE))
+        await cluster.write("a", b"x" * 1000)
+        await cluster.write("b", b"y" * 2000)
+        acting = cluster.backend.acting_set("a")
+        shard0 = cluster.osds[acting[0]]
+        assert shard0.pglog.head_version >= 1
+        assert any(e.oid == "a@0" for e in shard0.pglog.entries)
+        await cluster.shutdown()
+
+    run(main())
+
+
+def test_deep_scrub_detects_corruption():
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(8, dict(PROFILE))
+        data = os.urandom(20000)
+        await cluster.write("obj", data)
+        report = await cluster.deep_scrub("obj")
+        assert report["ok"], report
+        acting = cluster.backend.acting_set("obj")
+        cluster.osds[acting[4]].store.corrupt("obj@4", 3)
+        report = await cluster.deep_scrub("obj")
+        assert not report["ok"]
+        assert 4 in report["crc_errors"] or 4 in report["parity_mismatch"]
+        await cluster.shutdown()
+
+    run(main())

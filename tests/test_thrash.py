@@ -94,3 +94,32 @@ def test_thrash_cluster():
         await cluster.shutdown()
 
     asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_trace_spans():
+    from ceph_tpu.utils import trace
+
+    trace.enable(True)
+    try:
+
+        async def main():
+            PerfCounters.reset_all()
+            cluster = ECCluster(
+                6,
+                {"k": "4", "m": "2", "technique": "reed_sol_van",
+                 "plugin": "jerasure"},
+            )
+            await cluster.write("traced", b"z" * 5000)
+            await cluster.shutdown()
+
+        asyncio.new_event_loop().run_until_complete(main())
+        spans = trace.dump()
+        names = [s["name"] for s in spans]
+        assert "ec write" in names
+        assert names.count("ec sub write") == 6
+        root = next(s for s in spans if s["name"] == "ec write")
+        kids = [s for s in spans if s["parent_id"] == root["span_id"]]
+        assert len(kids) == 6
+        assert "encoded" in root["events"] and "all_commit" in root["events"]
+    finally:
+        trace.enable(False)

@@ -1,0 +1,91 @@
+#!/usr/bin/env python
+"""Parameter-sweep benchmark driver (qa/workunits/erasure-code/bench.sh
+analogue).
+
+Sweeps plugins x techniques x k/m like the reference harness
+(reference: qa/workunits/erasure-code/bench.sh:50-130: k in {2,3,4,6,10},
+m per k-map, vandermonde+cauchy for isa/jerasure, TOTAL_SIZE/SIZE
+iterations, cauchy packetsize heuristic) and emits one JSON line per cell:
+{"plugin":…, "technique":…, "k":…, "m":…, "workload":…, "gibps":…}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.plugins import registry as registry_mod  # noqa: E402
+
+KS = [2, 3, 4, 6, 10]
+M_MAP = {2: [1, 2], 3: [2], 4: [2, 3], 6: [3], 10: [4]}
+
+
+def packetsize_heuristic(size: int, k: int, w: int = 8, wordsize: int = 4) -> int:
+    """bench.sh:92-101 cauchy packetsize heuristic, capped at 3100."""
+    ps = (size // k // w // wordsize) * wordsize
+    return max(4, min(ps, 3100))
+
+
+def bench_cell(plugin, technique, k, m, size, total, backend):
+    profile = {"k": str(k), "m": str(m), "technique": technique}
+    if backend:
+        profile["backend"] = backend
+    if technique in ("cauchy_good", "cauchy_orig"):
+        profile["packetsize"] = str(packetsize_heuristic(size, k))
+    ec = registry_mod.instance().factory(plugin, profile)
+    payload = np.full(size, ord("X"), dtype=np.uint8)
+    want = set(range(ec.get_chunk_count()))
+    iterations = max(1, total // size)
+    ec.encode(want, payload)  # warmup (jit etc.)
+    t0 = time.perf_counter()
+    for _ in range(iterations):
+        ec.encode(want, payload)
+    dt = time.perf_counter() - t0
+    return iterations * size / dt / (1 << 30)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=1 << 20)
+    p.add_argument("--total-size", type=int, default=16 << 20)
+    p.add_argument("--plugins", default="jerasure,isa")
+    p.add_argument("--backend", default="", help="cpu|native|tpu")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+
+    techniques = {
+        "jerasure": ["reed_sol_van", "cauchy_good"],
+        "isa": ["reed_sol_van", "cauchy"],
+        "tpu": ["reed_sol_van", "cauchy_good"],
+    }
+    for plugin in args.plugins.split(","):
+        for technique in techniques.get(plugin, ["reed_sol_van"]):
+            for k in KS:
+                for m in M_MAP[k]:
+                    if plugin == "isa" and technique == "reed_sol_van" and m > 4:
+                        continue
+                    try:
+                        gibps = bench_cell(
+                            plugin, technique, k, m,
+                            args.size, args.total_size, args.backend,
+                        )
+                        print(json.dumps({
+                            "plugin": plugin, "technique": technique,
+                            "k": k, "m": m, "workload": "encode",
+                            "gibps": round(gibps, 3),
+                        }))
+                    except Exception as e:  # guard-railed combos
+                        print(json.dumps({
+                            "plugin": plugin, "technique": technique,
+                            "k": k, "m": m, "error": str(e)[:80],
+                        }))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
