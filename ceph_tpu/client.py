@@ -1,0 +1,141 @@
+"""librados-style client API (Rados / IoCtx surface).
+
+Reference: src/librados (Rados cluster handle, IoCtx per pool with
+write_full/read/remove/stat, pool create with an EC profile validated by
+instantiating the plugin -- the OSDMonitor::get_erasure_code role,
+reference src/mon/OSDMonitor.cc:5353).  Synchronous wrappers drive the
+async mini-cluster; aio_* variants return awaitables.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osd.ecbackend import SIZE_KEY, shard_oid
+from ceph_tpu.plugins import registry as registry_mod
+from ceph_tpu.utils.config import get_config
+
+
+class Rados:
+    """Cluster handle: owns the OSDs and the pools."""
+
+    def __init__(self, n_osds: int = 8):
+        self.n_osds = n_osds
+        self._pools: Dict[str, ECCluster] = {}
+        self._loop = asyncio.new_event_loop()
+
+    # -- pool ops (mon-role: profile validation at create time) ------------
+
+    def pool_create(self, name: str, profile: Optional[Dict[str, str]] = None):
+        if name in self._pools:
+            raise ValueError(f"pool {name} exists")
+        if profile is None:
+            text = get_config().get_val("osd_pool_default_erasure_code_profile")
+            profile = dict(kv.split("=", 1) for kv in text.split())
+        # validate the profile by instantiating the codec (monitor behavior)
+        check = dict(profile)
+        plugin = check.pop("plugin", "jerasure")
+        registry_mod.instance().factory(plugin, check)
+        self._pools[name] = self._run(self._make_pool(profile))
+        return self.open_ioctx(name)
+
+    async def _make_pool(self, profile):
+        return ECCluster(self.n_osds, dict(profile))
+
+    def pool_delete(self, name: str) -> None:
+        pool = self._pools.pop(name, None)
+        if pool is not None:
+            self._run(pool.shutdown())
+
+    def list_pools(self) -> List[str]:
+        return sorted(self._pools)
+
+    def open_ioctx(self, name: str) -> "IoCtx":
+        if name not in self._pools:
+            raise KeyError(f"no pool {name}")
+        return IoCtx(self, self._pools[name])
+
+    def shutdown(self) -> None:
+        for name in list(self._pools):
+            self.pool_delete(name)
+        self._loop.close()
+
+    def _run(self, coro):
+        return self._loop.run_until_complete(coro)
+
+
+class IoCtx:
+    """Per-pool I/O context (librados::IoCtx role)."""
+
+    def __init__(self, rados: Rados, cluster: ECCluster):
+        self._rados = rados
+        self._cluster = cluster
+
+    # -- sync surface ------------------------------------------------------
+
+    def write_full(self, oid: str, data: bytes) -> None:
+        self._rados._run(self._cluster.write(oid, data))
+
+    def read(self, oid: str) -> bytes:
+        return self._rados._run(self._cluster.read(oid))
+
+    def remove(self, oid: str) -> None:
+        async def _rm():
+            backend = self._cluster.backend
+            acting = backend.acting_set(oid)
+            from ceph_tpu.osd.types import ECSubWrite, Transaction
+
+            backend._tid += 1
+            tid = backend._tid
+            done = asyncio.get_event_loop().create_future()
+            backend._pending[tid] = {
+                "committed": set(),
+                "expected": {f"osd.{acting[s]}" for s in range(backend.km)},
+                "done": done,
+            }
+            version = max(backend._versions.values(), default=0) + 1
+            backend._versions[oid] = version
+            for s in range(backend.km):
+                txn = Transaction().remove(shard_oid(oid, s))
+                await backend.messenger.send_message(
+                    backend.name,
+                    f"osd.{acting[s]}",
+                    ECSubWrite(
+                        from_shard=s, tid=tid, oid=oid,
+                        transaction=txn, at_version=version,
+                    ),
+                )
+            await asyncio.wait_for(done, timeout=30)
+            del backend._pending[tid]
+
+        self._rados._run(_rm())
+
+    def stat(self, oid: str) -> int:
+        """Logical object size (from the shard-0 xattr)."""
+        backend = self._cluster.backend
+        acting = backend.acting_set(oid)
+        osd = self._cluster.osds[acting[0]]
+        size = osd.store.getattr(shard_oid(oid, 0), SIZE_KEY)
+        if size is None:
+            raise FileNotFoundError(oid)
+        return size
+
+    def list_objects(self) -> List[str]:
+        names = set()
+        for osd in self._cluster.osds:
+            for soid in osd.store.list_objects():
+                names.add(soid.rsplit("@", 1)[0])
+        return sorted(names)
+
+    def scrub(self, oid: str) -> dict:
+        return self._rados._run(self._cluster.deep_scrub(oid))
+
+    # -- async surface -----------------------------------------------------
+
+    def aio_write_full(self, oid: str, data: bytes):
+        return self._cluster.write(oid, data)
+
+    def aio_read(self, oid: str):
+        return self._cluster.read(oid)

@@ -301,7 +301,7 @@ class ECBackend:
             for s in range(self.km)
             if not self.messenger.is_down(f"osd.{acting[s]}")
         ]
-        want = [s for s in range(self.k)]
+        want = ecutil.data_positions(self.ec)
         minimum = self.ec.minimum_to_decode(want, up_shards)
         replies = await self._read_shards(oid, sorted(minimum.keys()), acting)
 
@@ -373,11 +373,13 @@ class ECBackend:
                 chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
             else:
                 report["missing"].append(s)
-        data_shards = [s for s in range(self.k) if s in chunks]
-        if len(data_shards) == self.k:
-            data = np.stack([chunks[s] for s in range(self.k)])
+        dpos = ecutil.data_positions(self.ec)
+        if all(p in chunks for p in dpos):
+            data = np.stack([chunks[p] for p in dpos])
             fresh = self.ec.encode(set(range(self.km)), data.reshape(-1))
-            for s in range(self.k, self.km):
+            for s in range(self.km):
+                if s in dpos:
+                    continue
                 if s in chunks and not np.array_equal(fresh[s], chunks[s]):
                     report["parity_mismatch"].append(s)
         report["ok"] = not (

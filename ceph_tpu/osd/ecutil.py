@@ -94,6 +94,15 @@ def encode(
     return {i: encoded[i] for i in want}
 
 
+def data_positions(ec) -> List[int]:
+    """Positions holding logical data chunks (honors the chunk mapping)."""
+    mapping = ec.get_chunk_mapping()
+    k = ec.get_data_chunk_count()
+    if mapping:
+        return list(mapping[:k])
+    return list(range(k))
+
+
 def decode_concat(
     sinfo: StripeInfo,
     ec,
@@ -101,10 +110,11 @@ def decode_concat(
 ) -> bytes:
     """Rebuild the logical buffer from per-shard chunk streams."""
     k = ec.get_data_chunk_count()
-    out = ec.decode(set(range(k)), to_decode)
+    pos = data_positions(ec)
+    out = ec.decode(set(pos), to_decode)
     shard_len = len(next(iter(out.values())))
     n_stripes = shard_len // sinfo.chunk_size
-    stacked = np.stack([out[i] for i in range(k)])  # [k, shard_len]
+    stacked = np.stack([out[p] for p in pos])  # [k, shard_len] logical order
     per_stripe = stacked.reshape(k, n_stripes, sinfo.chunk_size).transpose(
         1, 0, 2
     )

@@ -1,0 +1,59 @@
+"""librados-style client API tests (Rados/IoCtx surface)."""
+
+import os
+
+import pytest
+
+from ceph_tpu.client import Rados
+from ceph_tpu.plugins.interface import ErasureCodeError
+from ceph_tpu.utils.perf import PerfCounters
+
+
+@pytest.fixture
+def rados():
+    PerfCounters.reset_all()
+    r = Rados(n_osds=8)
+    yield r
+    r.shutdown()
+
+
+def test_pool_lifecycle(rados):
+    io = rados.pool_create(
+        "ecpool", {"plugin": "jerasure", "k": "4", "m": "2",
+                   "technique": "reed_sol_van"}
+    )
+    assert rados.list_pools() == ["ecpool"]
+    data = os.urandom(12345)
+    io.write_full("obj", data)
+    assert io.read("obj") == data
+    assert io.stat("obj") == 12345
+    assert io.list_objects() == ["obj"]
+    assert io.scrub("obj")["ok"]
+    io.remove("obj")
+    assert io.list_objects() == []
+    rados.pool_delete("ecpool")
+    assert rados.list_pools() == []
+
+
+def test_default_profile_pool(rados):
+    io = rados.pool_create("defaultpool")
+    io.write_full("a", b"hello world")
+    assert io.read("a") == b"hello world"
+
+
+def test_invalid_profile_rejected(rados):
+    with pytest.raises(ErasureCodeError):
+        rados.pool_create(
+            "bad", {"plugin": "jerasure", "k": "2", "m": "1",
+                    "technique": "reed_sol_van", "w": "9"}
+        )
+    assert rados.list_pools() == []
+
+
+def test_lrc_pool(rados):
+    io = rados.pool_create(
+        "lrcpool", {"plugin": "lrc", "k": "4", "m": "2", "l": "3"}
+    )
+    data = os.urandom(5000)
+    io.write_full("x", data)
+    assert io.read("x") == data
