@@ -59,5 +59,35 @@ class ECCluster:
     async def deep_scrub(self, oid: str) -> dict:
         return await self.backend.deep_scrub(oid)
 
+    # -- failure detection (OSD heartbeat / mon mark-down analogue) --------
+
+    async def heartbeat_round(self, timeout: float = 0.2) -> list:
+        """Ping every OSD; mark unresponsive ones down and return them
+        (the OSD↔OSD heartbeat + OSDMonitor mark-down roles, reference
+        src/osd/OSD.cc:4612 handle_osd_ping, failure reports to the mon)."""
+        import asyncio as _asyncio
+
+        name = "heartbeat-monitor"
+        self._hb_pongs: set = set()
+        if name not in self.messenger._queues:
+
+            async def collect(src, msg):
+                if isinstance(msg, tuple) and msg[0] == "pong":
+                    self._hb_pongs.add(msg[1])
+
+            self.messenger.register(name, collect)
+        for osd in self.osds:
+            await self.messenger.send_message(name, osd.name, "ping")
+        await _asyncio.sleep(timeout)
+        newly_down = []
+        for osd in self.osds:
+            if (
+                osd.name not in self._hb_pongs
+                and not self.messenger.is_down(osd.name)
+            ):
+                self.messenger.mark_down(osd.name)
+                newly_down.append(osd.osd_id)
+        return newly_down
+
     async def shutdown(self) -> None:
         await self.messenger.shutdown()

@@ -61,10 +61,18 @@ class OSDShard:
         self.messenger = messenger
         self.perf = PerfCounters(f"osd.{osd_id}")
         self.pglog = PGLog()
+        #: simulates a hung daemon: alive on the wire but never responding
+        #: (what OSD heartbeats exist to catch, reference OSD.cc:4612
+        #: handle_osd_ping / HeartbeatMap suicide timeouts)
+        self.frozen = False
         messenger.register(self.name, self.dispatch)
 
     async def dispatch(self, src: str, msg) -> None:
-        if isinstance(msg, ECSubWrite):
+        if self.frozen:
+            return
+        if msg == "ping":
+            await self.messenger.send_message(self.name, src, ("pong", self.name))
+        elif isinstance(msg, ECSubWrite):
             await self.handle_sub_write(src, msg)
         elif isinstance(msg, ECSubRead):
             await self.handle_sub_read(src, msg)
@@ -225,12 +233,20 @@ class ECBackend:
         hinfo.append(0, encoded)
 
         acting = self.acting_set(oid)
+        up = [
+            s
+            for s in range(self.km)
+            if not self.messenger.is_down(f"osd.{acting[s]}")
+        ]
+        # min_size: an EC pool needs at least k live shards to accept writes
+        if len(up) < self.k:
+            raise IOError(f"cannot write {oid}: only {len(up)} shards up")
         self._tid += 1
         tid = self._tid
         done = asyncio.get_event_loop().create_future()
         self._pending[tid] = {
             "committed": set(),
-            "expected": {f"osd.{acting[s]}" for s in range(self.km)},
+            "expected": {f"osd.{acting[s]}" for s in up},
             "done": done,
         }
         entry = LogEntry(version=version, oid=oid, op="append", prior_size=0)

@@ -1,0 +1,91 @@
+"""Control-plane tests: ceph-style CLI, compressor registry, heartbeats."""
+
+import asyncio
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def cli(tmp_state, *args):
+    env = dict(
+        os.environ,
+        JAX_PLATFORMS="cpu",
+        PYTHONPATH=REPO,
+        CEPH_TPU_CLI_STATE=tmp_state,
+    )
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "ceph_cli.py"), *args],
+        capture_output=True,
+        text=True,
+        env=env,
+        timeout=120,
+    )
+
+
+def test_cli_profile_and_pool(tmp_path):
+    state = str(tmp_path / "state.json")
+    r = cli(state, "osd", "erasure-code-profile", "set", "ec42",
+            "plugin=jerasure", "technique=reed_sol_van", "k=4", "m=2")
+    assert r.returncode == 0, r.stderr
+    assert json.loads(r.stdout)["chunk_count"] == 6
+    r = cli(state, "osd", "erasure-code-profile", "set", "ec42", "k=9", "m=9")
+    assert r.returncode == 1  # exists, no --force
+    r = cli(state, "osd", "erasure-code-profile", "ls")
+    assert "ec42" in json.loads(r.stdout)
+    r = cli(state, "osd", "pool", "create", "mypool", "erasure", "ec42")
+    assert r.returncode == 0, r.stderr
+    r = cli(state, "osd", "erasure-code-profile", "rm", "ec42")
+    assert r.returncode == 1  # in use
+    r = cli(state, "status")
+    assert json.loads(r.stdout)["pools"] == 1
+    # invalid profile rejected at set time (monitor behavior)
+    r = cli(state, "osd", "erasure-code-profile", "set", "bad",
+            "plugin=jerasure", "k=4", "m=2", "w=9")
+    assert r.returncode == 22
+
+
+def test_compressor_registry():
+    from ceph_tpu import compressor
+
+    payload = b"the quick brown fox " * 100
+    for alg in ("zlib", "bz2", "lzma", "none"):
+        c = compressor.create(alg)
+        blob = c.compress(payload)
+        assert c.decompress(blob) == payload
+        if alg != "none":
+            assert len(blob) < len(payload)
+    with pytest.raises(ModuleNotFoundError):
+        compressor.create("zstd")
+    with pytest.raises(ValueError):
+        compressor.create("whatever")
+
+
+def test_heartbeat_detects_frozen_osd():
+    from ceph_tpu.osd.cluster import ECCluster
+    from ceph_tpu.utils.perf import PerfCounters
+
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(
+            6,
+            {"k": "4", "m": "2", "technique": "reed_sol_van",
+             "plugin": "jerasure"},
+        )
+        down = await cluster.heartbeat_round()
+        assert down == []
+        cluster.osds[3].frozen = True  # hung daemon: on the wire, silent
+        down = await cluster.heartbeat_round()
+        assert down == [3]
+        assert cluster.messenger.is_down("osd.3")
+        # degraded operation continues after detection
+        data = os.urandom(9000)
+        await cluster.write("obj", data)
+        assert await cluster.read("obj") == data
+        await cluster.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())

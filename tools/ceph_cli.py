@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""`ceph`-style control-plane CLI (src/ceph.in analogue, EC subset).
+
+Manages erasure-code profiles and pools in a state file the way the
+monitor's paxos store holds them (reference control flow: ceph CLI ->
+OSDMonitor 'osd erasure-code-profile set' / 'osd pool create ... erasure'
+with profile validation by instantiating the plugin,
+src/mon/OSDMonitor.cc:5232-5380).
+
+Commands:
+    osd erasure-code-profile set <name> k=v [k=v ...] [--force]
+    osd erasure-code-profile get <name>
+    osd erasure-code-profile ls
+    osd erasure-code-profile rm <name>
+    osd pool create <pool> erasure [<profile>]
+    osd pool ls
+    status
+    compression ls
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.plugins import registry as registry_mod  # noqa: E402
+from ceph_tpu.plugins.interface import ErasureCodeError  # noqa: E402
+
+STATE_ENV = "CEPH_TPU_CLI_STATE"
+DEFAULT_STATE = os.path.expanduser("~/.ceph_tpu_cli.json")
+DEFAULT_PROFILE = {
+    "plugin": "jerasure",
+    "technique": "reed_sol_van",
+    "k": "2",
+    "m": "1",
+}
+
+
+def load_state(path):
+    if os.path.exists(path):
+        with open(path) as f:
+            return json.load(f)
+    return {"profiles": {"default": dict(DEFAULT_PROFILE)}, "pools": {}}
+
+
+def save_state(path, state):
+    with open(path, "w") as f:
+        json.dump(state, f, indent=2, sort_keys=True)
+
+
+def validate_profile(profile: dict) -> dict:
+    """Monitor-style validation: instantiate the codec."""
+    check = dict(profile)
+    plugin = check.pop("plugin", "jerasure")
+    ec = registry_mod.instance().factory(plugin, check)
+    return {
+        "chunk_count": ec.get_chunk_count(),
+        "data_chunk_count": ec.get_data_chunk_count(),
+    }
+
+
+def main(argv=None) -> int:
+    args = list(argv if argv is not None else sys.argv[1:])
+    state_path = os.environ.get(STATE_ENV, DEFAULT_STATE)
+    state = load_state(state_path)
+
+    def out(obj):
+        print(json.dumps(obj, indent=2, sort_keys=True))
+
+    try:
+        if args[:3] == ["osd", "erasure-code-profile", "set"]:
+            name = args[3]
+            force = "--force" in args
+            kvs = [a for a in args[4:] if a != "--force"]
+            if name in state["profiles"] and not force:
+                print(
+                    f"profile {name} exists, use --force to overwrite",
+                    file=sys.stderr,
+                )
+                return 1
+            profile = dict(kv.split("=", 1) for kv in kvs)
+            info = validate_profile(profile)
+            state["profiles"][name] = profile
+            save_state(state_path, state)
+            out({"profile": name, **info})
+            return 0
+        if args[:3] == ["osd", "erasure-code-profile", "get"]:
+            out(state["profiles"][args[3]])
+            return 0
+        if args[:3] == ["osd", "erasure-code-profile", "ls"]:
+            out(sorted(state["profiles"]))
+            return 0
+        if args[:3] == ["osd", "erasure-code-profile", "rm"]:
+            name = args[3]
+            used = [p for p, meta in state["pools"].items() if meta["profile"] == name]
+            if used:
+                print(f"profile {name} is in use by pools {used}", file=sys.stderr)
+                return 1
+            state["profiles"].pop(name, None)
+            save_state(state_path, state)
+            return 0
+        if args[:3] == ["osd", "pool", "create"]:
+            pool = args[3]
+            assert args[4] == "erasure", "only erasure pools supported"
+            prof_name = args[5] if len(args) > 5 else "default"
+            profile = state["profiles"][prof_name]
+            info = validate_profile(profile)
+            state["pools"][pool] = {"profile": prof_name, **info}
+            save_state(state_path, state)
+            out({"pool": pool, "profile": prof_name, **info})
+            return 0
+        if args[:3] == ["osd", "pool", "ls"]:
+            out(state["pools"])
+            return 0
+        if args[:1] == ["status"]:
+            out(
+                {
+                    "profiles": len(state["profiles"]),
+                    "pools": len(state["pools"]),
+                    "health": "HEALTH_OK",
+                }
+            )
+            return 0
+        if args[:2] == ["compression", "ls"]:
+            from ceph_tpu import compressor
+
+            out(compressor.get_supported())
+            return 0
+    except ErasureCodeError as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 22
+    except (KeyError, IndexError) as e:
+        print(f"Error: {e}", file=sys.stderr)
+        return 2
+    print(__doc__, file=sys.stderr)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
