@@ -115,6 +115,51 @@ class DistributedCodec:
         """words [batch, k, n] -> parity [batch, m, n] (replicated on shard)."""
         return self._encode(jnp.asarray(self.B), words)
 
+    # -- scatter variant: each device ends up owning its parity slice ------
+
+    def _build_encode_scatter(self):
+        w = self.w
+        mesh = self.mesh
+        n_shard = mesh.shape["shard"]
+        if self.m % n_shard:
+            return None
+
+        def local(B_blk, words):  # [m*w, kw_loc], [b, k/s, n]
+            bits = _unpack_bits(words, w)
+            part = jnp.einsum(
+                "rc,bcn->brn",
+                B_blk.astype(jnp.bfloat16),
+                bits,
+                preferred_element_type=jnp.float32,
+            )  # [b, m*w, n]
+            # reduce_scatter over ICI: integer partial sums land sliced on
+            # their owner device (the write-fan-out-to-owner analogue);
+            # mod-2 commutes with the sum so it runs post-scatter, locally
+            total = jax.lax.psum_scatter(
+                part, "shard", scatter_dimension=1, tiled=True
+            )  # [b, (m/s)*w, n]
+            obits = total.astype(jnp.int32) & 1
+            return _pack_bits(obits, w, words.dtype)  # [b, m/s, n]
+
+        f = shard_map(
+            local,
+            mesh=mesh,
+            in_specs=(P(None, "shard"), P("data", "shard", "sub")),
+            out_specs=P("data", "shard", "sub"),
+        )
+        return jax.jit(f)
+
+    def encode_scatter(self, words: jax.Array) -> jax.Array:
+        """words [batch, k, n] -> parity [batch, m, n] with the m axis
+        SHARDED over 'shard' (each device owns its parity shards), using
+        reduce_scatter instead of all-reduce -- half the ICI traffic and
+        the natural layout when parity shards live on distinct devices."""
+        if not hasattr(self, "_encode_scatter_fn"):
+            self._encode_scatter_fn = self._build_encode_scatter()
+        if self._encode_scatter_fn is None:
+            raise ValueError("m must divide the shard axis size")
+        return self._encode_scatter_fn(jnp.asarray(self.B), words)
+
     # -- scrub: recompute parity, compare against stored (deep-scrub role) --
 
     def _build_verify(self):
