@@ -125,6 +125,93 @@ def matrix_encode_w8(
 
 
 # ---------------------------------------------------------------------------
+# w=16 matrix codes: two 16-bit words per int32 lane, same field scheme
+# ---------------------------------------------------------------------------
+
+
+def prep_matrix_w16(bitmatrix: np.ndarray, k: int) -> np.ndarray:
+    """Columns to shift-major order for w=16: row (s, j) has coefficient
+    bitmatrix[:, j*16 + s] for s in 0..15."""
+    R = bitmatrix.shape[0]
+    out = np.zeros((R, 16 * k), dtype=np.float32)
+    for s in range(16):
+        for j in range(k):
+            out[:, s * k + j] = bitmatrix[:, j * 16 + s]
+    return out
+
+
+def _matrix_kernel_w16(b_ref, x_ref, o_ref, *, k: int, m: int):
+    x = x_ref[:]  # [k, T] int32: 2 packed LE uint16 words per lane
+    mask = jnp.int32(0x00000001)
+    # lo word (bits 0-15): shifts s; hi word (bits 16-31): shifts 16+s --
+    # one 1-bit field each; packed pairwise via <<16 after the dots
+    lo = jnp.concatenate(
+        [((x >> s) & mask).astype(jnp.float32) for s in range(16)], axis=0
+    )  # [16k, T] word position 0
+    hi = jnp.concatenate(
+        [((x >> (16 + s)) & mask).astype(jnp.float32) for s in range(16)],
+        axis=0,
+    )  # word position 1
+    dn = (((1,), (0,)), ((), ()))
+    accL = jax.lax.dot_general(
+        b_ref[:], lo, dn,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    accH = jax.lax.dot_general(
+        b_ref[:], hi, dn,
+        precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    # sums <= k*16 <= 512 < 2^16: fields don't collide
+    z = accL + (accH << 16)
+    pb = z & jnp.int32(0x00010001)  # one parity bit per word per lane
+    t = pb.shape[-1]
+    ob = pb.reshape(m, 16, t)
+    packed = ob[:, 0, :]
+    for l in range(1, 16):
+        packed = packed | (ob[:, l, :] << l)
+    o_ref[:] = packed
+
+
+@functools.partial(jax.jit, static_argnames=("k", "m", "tile"))
+def _matrix_encode_w16_call(Bp, d32, k: int, m: int, tile: int):
+    n4 = d32.shape[1]
+    return pl.pallas_call(
+        functools.partial(_matrix_kernel_w16, k=k, m=m),
+        out_shape=jax.ShapeDtypeStruct((m, n4), jnp.int32),
+        grid=(_cdiv(n4, tile),),
+        in_specs=[
+            pl.BlockSpec((m * 16, k * 16), lambda i: (0, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((k, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
+    )(Bp, d32)
+
+
+def matrix_encode_w16(
+    bitmatrix: np.ndarray | jax.Array,
+    data: np.ndarray | jax.Array,
+    k: int,
+    m: int,
+    tile: int = 4096,
+) -> np.ndarray:
+    """bitmatrix [m*16, k*16] x data [k, N] uint8 (LE uint16 words) -> [m, N]."""
+    if isinstance(bitmatrix, np.ndarray):
+        Bp = jnp.asarray(prep_matrix_w16(bitmatrix, k))
+    else:
+        Bp = bitmatrix
+    if isinstance(data, np.ndarray):
+        d32 = jnp.asarray(np.ascontiguousarray(data).view(np.int32))
+    else:
+        d32 = data
+    n4 = d32.shape[1]
+    tile = min(tile, max(_cdiv(n4, 128) * 128, 128))
+    out32 = _matrix_encode_w16_call(Bp, d32, k, m, tile)
+    return np.ascontiguousarray(jax.device_get(out32)).view(np.uint8)
+
+
+# ---------------------------------------------------------------------------
 # packetized bitmatrix codes (cauchy / liberation family)
 # ---------------------------------------------------------------------------
 #

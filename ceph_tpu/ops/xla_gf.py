@@ -129,6 +129,10 @@ def matrix_encode(matrix: np.ndarray, data: np.ndarray, w: int) -> np.ndarray:
         from ceph_tpu.ops import pallas_gf
 
         return pallas_gf.matrix_encode_w8(B, np.ascontiguousarray(data), k, m)
+    if w == 16 and size % 4 == 0 and _pallas_ok():
+        from ceph_tpu.ops import pallas_gf
+
+        return pallas_gf.matrix_encode_w16(B, np.ascontiguousarray(data), k, m)
     words = np.ascontiguousarray(data).view(_WORD_DTYPE[w])
     out = _encode_words_kernel(jnp.asarray(B), jnp.asarray(words), w)
     return np.asarray(jax.device_get(out)).view(np.uint8)
