@@ -1,0 +1,130 @@
+"""The five BASELINE.json configs, exercised end to end.
+
+1. jerasure k=2 m=1, 4KiB chunks, single stripe
+2. reed_sol_van k=4 m=2, 64KiB chunks, 1K-stripe batch encode
+3. ISA cauchy k=8 m=4, 1MiB chunks, encode + single-erasure decode,
+   parity vs the oracle corpus
+4. SHEC k=8 m=4 c=3, locality decode, mixed erasure patterns
+5. LRC k=10 m=4, 4MiB stripes, multi-OSD cluster write on an EC pool
+"""
+
+import asyncio
+import itertools
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import registry as registry_mod
+from ceph_tpu.utils.perf import PerfCounters
+
+
+@pytest.fixture
+def registry():
+    return registry_mod.ErasureCodePluginRegistry()
+
+
+def test_config1_jerasure_k2m1_4k(registry):
+    ec = registry.factory(
+        "jerasure", {"k": "2", "m": "1", "technique": "reed_sol_van"}
+    )
+    payload = os.urandom(2 * 4096)
+    encoded = ec.encode({0, 1, 2}, payload)
+    assert len(encoded[0]) == 4096
+    # m=1 parity is the XOR of the data chunks
+    assert np.array_equal(encoded[2], encoded[0] ^ encoded[1])
+    for lost in range(3):
+        have = {i: c for i, c in encoded.items() if i != lost}
+        out = ec.decode({lost}, have)
+        assert np.array_equal(out[lost], encoded[lost])
+
+
+def test_config2_batch_1k_stripes(registry):
+    """1000-stripe batch through the TPU plugin's batched entry point."""
+    tpu = registry.factory(
+        "tpu", {"k": "4", "m": "2", "technique": "reed_sol_van"}
+    )
+    stripe_bytes = 4 * 64 * 1024
+    rng = np.random.RandomState(0)
+    stripes = [rng.bytes(stripe_bytes) for _ in range(1000)]
+    batch = tpu.encode_batch(stripes)
+    assert len(batch) == 1000
+    # spot-check stripes against single encodes
+    for idx in (0, 499, 999):
+        single = tpu.encode(set(range(6)), stripes[idx])
+        for s in range(6):
+            assert np.array_equal(batch[idx][s], single[s])
+
+
+def test_config3_isa_cauchy_k8m4_1m(registry, tmp_path):
+    """ISA cauchy k=8 m=4 1MiB: encode + single-erasure decode, and chunk
+    parity against a corpus written by the non-regression tool."""
+    import subprocess
+    import sys
+
+    ec = registry.factory(
+        "isa", {"k": "8", "m": "4", "technique": "cauchy"}
+    )
+    payload = os.urandom(1 << 20)
+    encoded = ec.encode(set(range(12)), payload)
+    for lost in range(12):
+        have = {i: c for i, c in encoded.items() if i != lost}
+        out = ec.decode({lost}, have)
+        assert np.array_equal(out[lost], encoded[lost])
+    # corpus round-trip via the tool
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", PYTHONPATH=repo)
+    args = [
+        sys.executable, os.path.join(repo, "tools", "ec_non_regression.py"),
+        "--plugin", "isa", "--base", str(tmp_path),
+        "--stripe-width", str(1 << 20),
+        "--parameter", "k=8", "--parameter", "m=4",
+        "--parameter", "technique=cauchy",
+    ]
+    assert subprocess.run(args + ["--create"], env=env, timeout=300).returncode == 0
+    assert subprocess.run(args + ["--check"], env=env, timeout=300).returncode == 0
+
+
+def test_config4_shec_k8m4c3_mixed_erasures(registry):
+    ec = registry.factory(
+        "shec", {"k": "8", "m": "4", "c": "3", "technique": "multiple"}
+    )
+    payload = os.urandom(ec.get_chunk_size(1) * 8 + 1234)
+    encoded = ec.encode(set(range(12)), payload)
+    assert ec.decode_concat(encoded)[: len(payload)] == payload
+    # mixed data/parity erasure patterns up to c=3
+    rng = np.random.RandomState(5)
+    patterns = [
+        (0,), (9,), (0, 9), (1, 2), (10, 11),
+        (0, 4, 8), (1, 5, 10), (2, 3, 11),
+    ]
+    for erased in patterns:
+        have = {i: c for i, c in encoded.items() if i not in erased}
+        out = ec.decode(set(erased), have)
+        for e in erased:
+            assert np.array_equal(out[e], encoded[e]), erased
+    # locality: single-chunk repair reads fewer than k chunks
+    minimum = ec.minimum_to_decode({0}, set(range(12)) - {0})
+    assert len(minimum) < 8
+
+
+def test_config5_lrc_k10m4_4m_cluster():
+    """LRC k=10 m=4 (l=7 -> 2 local groups), 4MiB objects on the
+    multi-OSD mini-cluster (the vstart rados-bench role)."""
+
+    async def main():
+        PerfCounters.reset_all()
+        from ceph_tpu.osd.cluster import ECCluster
+
+        cluster = ECCluster(
+            20, {"plugin": "lrc", "k": "10", "m": "4", "l": "7"}
+        )
+        payload = os.urandom(4 << 20)
+        await cluster.write("bench-obj", payload)
+        assert await cluster.read("bench-obj") == payload
+        acting = cluster.backend.acting_set("bench-obj")
+        cluster.kill_osd(acting[0])
+        assert await cluster.read("bench-obj") == payload
+        await cluster.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())

@@ -1,0 +1,62 @@
+#!/usr/bin/env python
+"""rados bench analogue: object write/read throughput on the mini-cluster.
+
+Reference role: `rados bench -p <pool> write` against a vstart EC pool
+(the BASELINE config-5 measurement path).  Boots an in-process cluster with
+the given profile, writes/reads N objects of --size bytes, prints one JSON
+line per phase: {"phase": "write", "mb_s": ..., "objects": ..., "size": ...}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.osd.cluster import ECCluster  # noqa: E402
+from ceph_tpu.utils.perf import PerfCounters  # noqa: E402
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--size", type=int, default=4 << 20)
+    p.add_argument("--objects", type=int, default=16)
+    p.add_argument("--osds", type=int, default=20)
+    p.add_argument("--profile", default="plugin=lrc k=10 m=4 l=7",
+                   help="space-separated k=v EC profile")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+    profile = dict(kv.split("=", 1) for kv in args.profile.split())
+
+    async def run():
+        PerfCounters.reset_all()
+        cluster = ECCluster(args.osds, dict(profile))
+        payloads = {
+            f"bench_{i}": os.urandom(args.size) for i in range(args.objects)
+        }
+        t0 = time.perf_counter()
+        for oid, data in payloads.items():
+            await cluster.write(oid, data)
+        t_write = time.perf_counter() - t0
+        t0 = time.perf_counter()
+        for oid, data in payloads.items():
+            got = await cluster.read(oid)
+            assert got == data
+        t_read = time.perf_counter() - t0
+        total_mb = args.objects * args.size / 1e6
+        print(json.dumps({"phase": "write", "mb_s": round(total_mb / t_write, 2),
+                          "objects": args.objects, "size": args.size}))
+        print(json.dumps({"phase": "read", "mb_s": round(total_mb / t_read, 2),
+                          "objects": args.objects, "size": args.size}))
+        await cluster.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(run())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
