@@ -43,6 +43,12 @@ class ECCluster:
     async def read(self, oid: str) -> bytes:
         return await self.backend.read(oid)
 
+    async def write_range(self, oid: str, offset: int, data: bytes) -> None:
+        await self.backend.write_range(oid, offset, data)
+
+    async def read_range(self, oid: str, offset: int, length: int) -> bytes:
+        return await self.backend.read_range(oid, offset, length)
+
     # -- failure control (thrasher surface) --------------------------------
 
     def kill_osd(self, osd_id: int) -> None:

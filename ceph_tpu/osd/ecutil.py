@@ -150,6 +150,9 @@ class HashInfo:
     def get_chunk_hash(self, shard: int) -> int:
         return self.cumulative_shard_hashes[shard]
 
+    def has_chunk_hash(self) -> bool:
+        return bool(self.cumulative_shard_hashes)
+
     def get_total_chunk_size(self) -> int:
         return self.total_chunk_size
 

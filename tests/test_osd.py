@@ -263,3 +263,75 @@ def test_deep_scrub_detects_corruption():
         await cluster.shutdown()
 
     run(main())
+
+
+# -- partial I/O: range reads + RMW writes ----------------------------------
+
+
+def test_write_plan():
+    from ceph_tpu.osd.ectransaction import get_write_plan
+
+    si = ecutil.StripeInfo(4, 4096)
+    # pure append from empty
+    p = get_write_plan(si, 0, 0, 10000)
+    assert p.is_append and p.to_read is None
+    assert p.will_write == (0, 12288)
+    # append at aligned end
+    p = get_write_plan(si, 8192, 8192, 4096)
+    assert p.is_append and p.to_read is None
+    # mid-object partial overwrite: must read the touched stripes
+    p = get_write_plan(si, 16384, 5000, 2000)
+    assert not p.is_append
+    assert p.to_read == (4096, 4096)
+    assert p.will_write == (4096, 4096)
+    assert p.new_size == 16384
+    # fully-covering aligned overwrite: no read needed
+    p = get_write_plan(si, 16384, 4096, 4096)
+    assert p.to_read is None
+
+
+def test_range_read_and_rmw_write():
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(8, dict(PROFILE))
+        data = bytearray(os.urandom(100000))
+        await cluster.write("obj", bytes(data))
+        # range reads at awkward offsets
+        for off, ln in [(0, 10), (4096, 4096), (33333, 12345), (99990, 100)]:
+            got = await cluster.read_range("obj", off, ln)
+            assert got == bytes(data[off : off + ln]), (off, ln)
+        # read past EOF clips
+        assert await cluster.read_range("obj", 99000, 5000) == bytes(
+            data[99000:]
+        )
+        # RMW overwrite in the middle
+        patch = os.urandom(7777)
+        await cluster.write_range("obj", 12345, patch)
+        data[12345 : 12345 + 7777] = patch
+        assert await cluster.read("obj") == bytes(data)
+        # append via write_range past the end
+        tail = os.urandom(5000)
+        size = len(data)
+        await cluster.write_range("obj", size, tail)
+        data.extend(tail)
+        assert await cluster.read("obj") == bytes(data)
+        # degraded range read
+        acting = cluster.backend.acting_set("obj")
+        cluster.kill_osd(acting[1])
+        got = await cluster.read_range("obj", 50000, 20000)
+        assert got == bytes(data[50000:70000])
+        await cluster.shutdown()
+
+    run(main())
+
+
+def test_write_range_from_scratch():
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(8, dict(PROFILE))
+        blob = os.urandom(30000)
+        await cluster.write_range("fresh", 0, blob)
+        assert await cluster.read("fresh") == blob
+        await cluster.shutdown()
+
+    run(main())
