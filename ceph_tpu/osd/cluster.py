@@ -25,6 +25,8 @@ class ECCluster:
         profile: Dict[str, str],
         plugin: Optional[str] = None,
         fault: Optional[FaultInjector] = None,
+        use_crush: bool = True,
+        hosts=None,
     ):
         self.messenger = Messenger(fault)
         self.osds: List[OSDShard] = [
@@ -33,7 +35,17 @@ class ECCluster:
         plugin = plugin or profile.pop("plugin", "jerasure")
         registry = registry_mod.instance()
         self.ec = registry.factory(plugin, profile)
-        self.backend = ECBackend(self.ec, self.osds, self.messenger)
+        placement = None
+        if use_crush:
+            from ceph_tpu.osd.placement import CrushPlacement
+
+            placement = CrushPlacement(
+                n_osds, self.ec.get_chunk_count(), hosts=hosts
+            )
+        self.placement = placement
+        self.backend = ECBackend(
+            self.ec, self.osds, self.messenger, placement=placement
+        )
 
     # -- client surface ----------------------------------------------------
 
@@ -56,6 +68,15 @@ class ECCluster:
 
     def revive_osd(self, osd_id: int) -> None:
         self.messenger.mark_up(f"osd.{osd_id}")
+
+    def out_osd(self, osd_id: int) -> None:
+        """Mark an OSD out: CRUSH remaps its shards (weight -> 0)."""
+        if self.placement is not None:
+            self.placement.mark_out(osd_id)
+
+    def in_osd(self, osd_id: int, weight: float = 1.0) -> None:
+        if self.placement is not None:
+            self.placement.mark_in(osd_id, weight)
 
     async def recover_object_shard(
         self, oid: str, shard: int, target_osd: int

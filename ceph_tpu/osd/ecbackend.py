@@ -155,6 +155,7 @@ class ECBackend:
         osds: List[OSDShard],
         messenger: Messenger,
         name: str = "client",
+        placement=None,
     ):
         self.ec = ec
         self.k = ec.get_data_chunk_count()
@@ -172,17 +173,22 @@ class ECBackend:
         # per-object version counter (pg-log-lite)
         self._versions: Dict[str, int] = {}
         self.log: List[LogEntry] = []
+        # CRUSH placement engine (ceph_tpu.osd.placement.CrushPlacement);
+        # None falls back to the seeded-permutation CRUSH-lite below.
+        self.placement = placement
 
     # -- placement (CRUSH-lite) --------------------------------------------
 
     def acting_set(self, oid: str) -> List[int]:
         """Stable pseudorandom placement of the km shards over OSDs.
 
-        The reference maps pg -> up/acting via CRUSH (src/crush/mapper.c:441
-        crush_choose_firstn with 'indep' mode for EC); here: a deterministic
-        permutation seeded by the object name, skipping down OSDs the way
-        CRUSH reselects on map changes.
+        With a CrushPlacement attached this is the real thing: oid -> pg ->
+        crush indep rule over the map (src/crush/mapper.c crush_choose_indep;
+        src/osd/OSDMap.cc _pg_to_raw_osds).  The fallback is a deterministic
+        permutation seeded by the object name.
         """
+        if self.placement is not None:
+            return self.placement.acting(oid)
         import hashlib
 
         n = len(self.osds)
@@ -195,6 +201,13 @@ class ECBackend:
         # stable: down OSDs keep their slot (degraded) until recovery moves
         # the shard, mirroring up/acting set semantics
         return order[: self.km]
+
+    def _shard_up(self, acting, s: int) -> bool:
+        """A shard position is usable iff it mapped (no CRUSH hole) and its
+        OSD is not down."""
+        return acting[s] is not None and not self.messenger.is_down(
+            f"osd.{acting[s]}"
+        )
 
     # -- write path --------------------------------------------------------
 
@@ -241,7 +254,7 @@ class ECBackend:
         up = [
             s
             for s in range(self.km)
-            if not self.messenger.is_down(f"osd.{acting[s]}")
+            if self._shard_up(acting, s)
         ]
         # min_size: an EC pool needs at least k live shards to accept writes
         if len(up) < self.k:
@@ -257,6 +270,8 @@ class ECBackend:
         entry = LogEntry(version=version, oid=oid, op="append", prior_size=0)
         self.log.append(entry)
         for s in range(self.km):
+            if acting[s] is None:
+                continue  # CRUSH hole: no device for this position
             soid = shard_oid(oid, s)
             txn = (
                 Transaction()
@@ -293,6 +308,7 @@ class ECBackend:
         acting: List[int],
         extents: Optional[List[Tuple[int, int]]] = None,
     ) -> Dict[int, ECSubReadReply]:
+        shards = [s for s in shards if acting[s] is not None]
         self._tid += 1
         tid = self._tid
         done = asyncio.get_event_loop().create_future()
@@ -324,7 +340,7 @@ class ECBackend:
         up_shards = [
             s
             for s in range(self.km)
-            if not self.messenger.is_down(f"osd.{acting[s]}")
+            if self._shard_up(acting, s)
         ]
         want = ecutil.data_positions(self.ec)
         minimum = self.ec.minimum_to_decode(want, up_shards)
@@ -374,13 +390,25 @@ class ECBackend:
         up = [
             s
             for s in range(self.km)
-            if not self.messenger.is_down(f"osd.{acting[s]}")
+            if self._shard_up(acting, s)
         ]
         replies = await self._read_shards(oid, up[:1], acting, extents=[(0, 0)])
         for r in replies.values():
             attrs = r.attrs_read.get(oid) or {}
             if attrs.get(SIZE_KEY) is not None:
                 return attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY)
+        # first shard had no attrs (e.g. freshly remapped, shard not yet
+        # recovered): fall back to the remaining up shards before concluding
+        # the object does not exist — reporting size 0 for an existing
+        # object would misclassify overwrites as appends downstream.
+        if len(up) > 1:
+            replies = await self._read_shards(
+                oid, up[1:], acting, extents=[(0, 0)]
+            )
+            for r in replies.values():
+                attrs = r.attrs_read.get(oid) or {}
+                if attrs.get(SIZE_KEY) is not None:
+                    return attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY)
         return 0, None
 
     async def read_range(self, oid: str, offset: int, length: int) -> bytes:
@@ -399,7 +427,7 @@ class ECBackend:
         up = [
             s
             for s in range(self.km)
-            if not self.messenger.is_down(f"osd.{acting[s]}")
+            if self._shard_up(acting, s)
         ]
         want = ecutil.data_positions(self.ec)
         minimum = self.ec.minimum_to_decode(want, up)
@@ -483,7 +511,7 @@ class ECBackend:
         up = [
             s
             for s in range(self.km)
-            if not self.messenger.is_down(f"osd.{acting[s]}")
+            if self._shard_up(acting, s)
         ]
         if len(up) < self.k:
             raise IOError(f"cannot write {oid}: only {len(up)} shards up")
@@ -528,7 +556,7 @@ class ECBackend:
         up = [
             s
             for s in range(self.km)
-            if not self.messenger.is_down(f"osd.{acting[s]}")
+            if self._shard_up(acting, s)
         ]
         replies = await self._read_shards(oid, up, acting)
         report = {
@@ -576,7 +604,7 @@ class ECBackend:
             s
             for s in range(self.km)
             if s != shard
-            and not self.messenger.is_down(f"osd.{acting[s]}")
+            and self._shard_up(acting, s)
         ]
         minimum = self.ec.minimum_to_decode([shard], up_shards)
         replies = await self._read_shards(oid, sorted(minimum.keys()), acting)

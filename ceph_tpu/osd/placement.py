@@ -1,0 +1,101 @@
+"""Object -> PG -> acting-set placement via CRUSH.
+
+Reference: the OSD maps hobject_t -> pg (ceph_str_hash + pg_num mask,
+src/osd/osd_types.h raw_pg_to_pg) and pg -> up/acting osds via
+OSDMap::pg_to_up_acting_osds -> crush->do_rule with the pool's rule in
+'indep' mode for EC pools (src/osd/OSDMap.cc:_pg_to_raw_osds).  Devices
+marked *out* get weight 0 and are remapped; *down* devices keep their
+acting position (degraded) until marked out — the same up/acting split the
+reference has.  Unmappable indep positions come back as ``None`` (the
+CRUSH_ITEM_NONE hole): the pg stays usable as long as >= k positions map.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from ceph_tpu.crush import CrushMap, Tunables, build_flat_map, build_hierarchy, do_rule
+from ceph_tpu.crush.hash import crush_hash32
+from ceph_tpu.crush.map import ITEM_NONE, erasure_rule, weight_fp
+
+
+class CrushPlacement:
+    """CRUSH-backed acting-set computation for an EC pool."""
+
+    def __init__(
+        self,
+        n_osds: int,
+        km: int,
+        pg_num: int = 128,
+        hosts: Optional[Sequence[Sequence[int]]] = None,
+    ):
+        if hosts is not None:
+            all_osds = sorted(o for h in hosts for o in h)
+            if all_osds != list(range(n_osds)):
+                raise ValueError(
+                    f"hosts layout covers osds {all_osds}, "
+                    f"expected exactly 0..{n_osds - 1}"
+                )
+            self.map, root = build_hierarchy(hosts)
+            domain = 2  # host
+        else:
+            self.map, root = build_flat_map(n_osds)
+            domain = 0
+        self.ruleno = self.map.add_rule(
+            erasure_rule(root, failure_domain_type=domain)
+        )
+        self.km = km
+        self.pg_num = pg_num
+        self.weights = [0x10000] * n_osds
+        self.tunables = Tunables()
+        self.epoch = 1  # bumped on every weight/map mutation
+        # pg -> acting, valid for the current epoch only (the reference
+        # equivalent is OSDMapMapping's precomputed pg->osds cache).
+        self._cache: Dict[int, List[Optional[int]]] = {}
+        self._cache_epoch = self.epoch
+
+    def pg_of(self, oid: str) -> int:
+        h = crush_hash32(
+            int.from_bytes(
+                hashlib.blake2b(oid.encode(), digest_size=4).digest(), "big"
+            )
+        )
+        return int(h) % self.pg_num
+
+    def acting_for_pg(self, pg: int) -> List[Optional[int]]:
+        """km entries; ``None`` marks an unmappable position (hole).
+        Raises only when fewer positions map than the caller can ever
+        decode from is *not* known here — callers enforce k/min_size."""
+        if self._cache_epoch != self.epoch:
+            self._cache.clear()
+            self._cache_epoch = self.epoch
+        cached = self._cache.get(pg)
+        if cached is not None:
+            return cached
+        out = do_rule(
+            self.map, self.ruleno, pg, self.km, self.weights, self.tunables
+        )
+        acting: List[Optional[int]] = [
+            None if v == ITEM_NONE else v for v in out
+        ]
+        acting += [None] * (self.km - len(acting))
+        self._cache[pg] = acting
+        return acting
+
+    def acting(self, oid: str) -> List[Optional[int]]:
+        return self.acting_for_pg(self.pg_of(oid))
+
+    # -- osdmap mutations --------------------------------------------------
+
+    def mark_out(self, osd_id: int) -> None:
+        self.weights[osd_id] = 0
+        self.epoch += 1
+
+    def mark_in(self, osd_id: int, weight: float = 1.0) -> None:
+        self.weights[osd_id] = weight_fp(weight)
+        self.epoch += 1
+
+    def reweight(self, osd_id: int, weight: float) -> None:
+        self.weights[osd_id] = weight_fp(weight)
+        self.epoch += 1
