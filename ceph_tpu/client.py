@@ -87,17 +87,20 @@ class IoCtx:
             acting = backend.acting_set(oid)
             from ceph_tpu.osd.types import ECSubWrite, Transaction
 
+            # only shards with a mapped, live OSD can ack (CRUSH holes are
+            # None; down OSDs never reply — waiting on either stalls)
+            up = [s for s in range(backend.km) if backend._shard_up(acting, s)]
             backend._tid += 1
             tid = backend._tid
             done = asyncio.get_event_loop().create_future()
             backend._pending[tid] = {
                 "committed": set(),
-                "expected": {f"osd.{acting[s]}" for s in range(backend.km)},
+                "expected": {f"osd.{acting[s]}" for s in up},
                 "done": done,
             }
             version = max(backend._versions.values(), default=0) + 1
             backend._versions[oid] = version
-            for s in range(backend.km):
+            for s in up:
                 txn = Transaction().remove(shard_oid(oid, s))
                 await backend.messenger.send_message(
                     backend.name,
@@ -113,14 +116,21 @@ class IoCtx:
         self._rados._run(_rm())
 
     def stat(self, oid: str) -> int:
-        """Logical object size (from the shard-0 xattr)."""
+        """Logical object size (from the first reachable shard's xattr)."""
         backend = self._cluster.backend
         acting = backend.acting_set(oid)
-        osd = self._cluster.osds[acting[0]]
-        size = osd.store.getattr(shard_oid(oid, 0), SIZE_KEY)
-        if size is None:
-            raise FileNotFoundError(oid)
-        return size
+        for s in range(backend.km):
+            if acting[s] is None:
+                continue
+            try:
+                size = self._cluster.osds[acting[s]].store.getattr(
+                    shard_oid(oid, s), SIZE_KEY
+                )
+            except FileNotFoundError:
+                continue
+            if size is not None:
+                return size
+        raise FileNotFoundError(oid)
 
     def list_objects(self) -> List[str]:
         names = set()

@@ -1,0 +1,419 @@
+"""Monitor daemon: election, paxos-replicated OSDMap, command handling.
+
+Reference: src/mon/Monitor.cc (daemon + command dispatch),
+src/mon/Elector.cc (rank-based election: lowest reachable rank wins),
+src/mon/OSDMonitor.cc (profile set :5232, pool create :5529, get_erasure_code
+:5353 — profiles validated by instantiating the plugin), map broadcast to
+subscribers (Monitor::send_latest).  Clients may address any monitor;
+non-leaders forward to the leader the way peons forward proposals.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ceph_tpu.mon.osdmap import OSDMap
+from ceph_tpu.mon.paxos import Paxos
+from ceph_tpu.osd.messenger import Messenger
+from ceph_tpu.utils.log import dout
+
+
+class Monitor:
+    def __init__(self, rank: int, n_mons: int, messenger: Messenger):
+        self.rank = rank
+        self.n_mons = n_mons
+        self.name = f"mon.{rank}"
+        self.messenger = messenger
+        self.paxos = Paxos(rank, n_mons, self._send_to_rank, self._on_commit)
+        self.osdmap = OSDMap()
+        self.leader: Optional[int] = None
+        self.quorum: List[int] = []
+        self.election_epoch = 0
+        self._election_acks: set = set()
+        self._election_done: Optional[asyncio.Future] = None
+        self._subscribers: set = set()
+        self._cmd_lock = asyncio.Lock()
+        self._last_lease = 0.0
+        messenger.register(self.name, self.dispatch)
+
+    def start_tick(self, interval: float = 0.1, miss_factor: float = 4.0):
+        """Lease probing (reference: Paxos lease extend/ack + Elector
+        timers): peons probe the leader; on miss_factor*interval of
+        silence they call an election."""
+        loop = asyncio.get_event_loop()
+        self._last_lease = loop.time()
+
+        async def tick():
+            while True:
+                await asyncio.sleep(interval)
+                if self.is_leader() or self.leader is None:
+                    continue
+                await self._send_to_rank(self.leader, {"type": "mon_lease_probe"})
+                if loop.time() - self._last_lease > interval * miss_factor:
+                    self._last_lease = loop.time()  # back off before retry
+                    await self.start_election()
+
+        self.messenger.adopt_task(f"{self.name}.tick", loop.create_task(tick()))
+
+    # -- plumbing ----------------------------------------------------------
+
+    async def _send_to_rank(self, rank: int, msg: dict) -> None:
+        await self.messenger.send_message(self.name, f"mon.{rank}", msg)
+
+    def is_leader(self) -> bool:
+        return self.leader == self.rank
+
+    @property
+    def majority(self) -> int:
+        return self.n_mons // 2 + 1
+
+    # -- election (Elector.cc analogue) ------------------------------------
+
+    async def start_election(self, timeout: float = 0.5) -> bool:
+        """Propose self; lower-rank live mons preempt (they nack and run
+        their own).  Victory on majority of defer-acks."""
+        self.election_epoch += 1
+        self.leader = None
+        self._election_acks = {self.rank}
+        self._election_done = asyncio.get_event_loop().create_future()
+        for r in range(self.n_mons):
+            if r != self.rank:
+                await self._send_to_rank(
+                    r, {"type": "election_propose", "epoch": self.election_epoch}
+                )
+        if len(self._election_acks) < self.majority:
+            try:
+                await asyncio.wait_for(self._election_done, timeout)
+            except asyncio.TimeoutError:
+                return False
+        if self.leader is not None and self.leader != self.rank:
+            return False  # preempted by a lower rank mid-election
+        quorum = sorted(self._election_acks)
+        for r in range(self.n_mons):
+            if r != self.rank:
+                await self._send_to_rank(
+                    r,
+                    {
+                        "type": "election_victory",
+                        "epoch": self.election_epoch,
+                        "leader": self.rank,
+                        "quorum": quorum,
+                    },
+                )
+        self.leader = self.rank
+        self.quorum = quorum
+        # recovery: bring the quorum's stores into agreement
+        await self.paxos.collect(quorum)
+        dout("mon", 5, f"{self.name} won election epoch {self.election_epoch}")
+        return True
+
+    async def _handle_election(self, src: str, msg: dict) -> None:
+        src_rank = int(src.split(".")[1])
+        t = msg["type"]
+        if t == "election_propose":
+            if msg["epoch"] > self.election_epoch:
+                self.election_epoch = msg["epoch"]
+            if src_rank < self.rank:
+                # defer to the lower rank
+                await self._send_to_rank(
+                    src_rank,
+                    {"type": "election_ack", "epoch": msg["epoch"]},
+                )
+            else:
+                # I outrank them: run my own election
+                asyncio.get_event_loop().create_task(self.start_election())
+        elif t == "election_ack":
+            if msg["epoch"] == self.election_epoch:
+                self._election_acks.add(src_rank)
+                if (
+                    len(self._election_acks) >= self.majority
+                    and self._election_done
+                    and not self._election_done.done()
+                ):
+                    self._election_done.set_result(True)
+        elif t == "election_victory":
+            if msg["epoch"] >= self.election_epoch:
+                self.election_epoch = msg["epoch"]
+                self.leader = msg["leader"]
+                self.quorum = msg["quorum"]
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def dispatch(self, src: str, msg) -> None:
+        if not isinstance(msg, dict):
+            return
+        t = msg.get("type", "")
+        if t.startswith("election_"):
+            await self._handle_election(src, msg)
+        elif t == "paxos_collect":
+            for rank, reply in self.paxos.handle_collect(
+                int(src.split(".")[1]), msg
+            ):
+                await self._send_to_rank(rank, reply)
+        elif t == "paxos_last":
+            self.paxos.handle_last(int(src.split(".")[1]), msg)
+        elif t == "paxos_begin":
+            for rank, reply in self.paxos.handle_begin(
+                int(src.split(".")[1]), msg
+            ):
+                await self._send_to_rank(rank, reply)
+        elif t == "paxos_accept":
+            self.paxos.handle_accept(int(src.split(".")[1]), msg)
+        elif t == "paxos_commit":
+            self.paxos.handle_commit(int(src.split(".")[1]), msg)
+        elif t == "mon_lease_probe":
+            if self.is_leader():
+                await self.messenger.send_message(
+                    self.name, src, {"type": "mon_lease"}
+                )
+        elif t == "mon_lease":
+            if src == f"mon.{self.leader}":
+                self._last_lease = asyncio.get_event_loop().time()
+        elif t == "mon_subscribe":
+            self._subscribers.add(src)
+            await self.messenger.send_message(
+                self.name,
+                src,
+                {"type": "osdmap", "map": self.osdmap.to_dict()},
+            )
+        elif t == "mon_command":
+            # spawn: a proposal awaits peer accepts, which arrive through
+            # this same dispatch loop — handling inline would deadlock
+            asyncio.get_event_loop().create_task(
+                self._handle_command(src, msg)
+            )
+
+    # -- committed-state application ---------------------------------------
+
+    def _on_commit(self, v: int, value: dict) -> None:
+        self.osdmap.apply(value["inc"])
+        # every mon pushes to its own subscribers (clients subscribe to all
+        # mons and dedup by epoch) — gating on is_leader() here would drop
+        # broadcasts when leadership flickers mid-commit during elections
+        for sub in list(self._subscribers):
+            asyncio.get_event_loop().create_task(
+                self.messenger.send_message(
+                    self.name,
+                    sub,
+                    {"type": "osdmap", "map": self.osdmap.to_dict()},
+                )
+            )
+
+    # -- commands (OSDMonitor analogue) ------------------------------------
+
+    async def _handle_command(self, src: str, msg: dict) -> None:
+        cmd = msg["cmd"]
+        if not self.is_leader():
+            if self.leader is None:
+                await self.messenger.send_message(
+                    self.name,
+                    src,
+                    {
+                        "type": "mon_command_reply",
+                        "id": msg["id"],
+                        "rc": -11,  # EAGAIN: no quorum
+                        "out": "no leader",
+                    },
+                )
+            else:
+                # forward to the leader (Monitor.cc forward_request_leader)
+                fwd = dict(msg)
+                fwd["reply_to"] = src
+                await self._send_to_rank(self.leader, fwd)
+            return
+        rc, out = await self.do_command(cmd)
+        await self.messenger.send_message(
+            self.name,
+            msg.get("reply_to", src),
+            {"type": "mon_command_reply", "id": msg["id"], "rc": rc, "out": out},
+        )
+
+    _pid_counter = 0
+
+    async def _propose(self, inc: dict) -> bool:
+        async with self._cmd_lock:  # one in-flight proposal (paxos updating)
+            Monitor._pid_counter += 1
+            value = {"inc": inc, "pid": f"{self.rank}:{Monitor._pid_counter}"}
+            for _ in range(3):
+                if await self.paxos.propose(value, self.quorum):
+                    return True
+                # stale pn (a competing election promised newer): recover
+                if not await self.paxos.collect(self.quorum):
+                    return False
+                # recovery may have re-proposed and committed our value
+                if any(
+                    v.get("pid") == value["pid"]
+                    for v in self.paxos.store.values.values()
+                ):
+                    return True
+            return False
+
+    async def do_command(self, cmd: dict):
+        """Returns (rc, out).  Command names follow the ceph CLI."""
+        prefix = cmd.get("prefix", "")
+        if prefix == "status":
+            return 0, {
+                "quorum": self.quorum,
+                "leader": self.leader,
+                "election_epoch": self.election_epoch,
+                "osdmap_epoch": self.osdmap.epoch,
+                "pools": sorted(self.osdmap.pools),
+                "num_osds": self.osdmap.max_osd,
+            }
+        if prefix == "osd create":
+            ok = await self._propose({"op": "create_osds", "n": cmd["n"]})
+            return (0, f"created {cmd['n']} osds") if ok else (-11, "no quorum")
+        if prefix == "osd erasure-code-profile set":
+            name, profile = cmd["name"], dict(cmd["profile"])
+            # validate by instantiating the codec (OSDMonitor.cc:5353)
+            from ceph_tpu.plugins import registry as registry_mod
+
+            plugin = profile.get("plugin", "jerasure")
+            try:
+                registry_mod.instance().factory(
+                    plugin, {k: v for k, v in profile.items() if k != "plugin"}
+                )
+            except Exception as e:  # noqa: BLE001 -- validation surface
+                return -22, f"invalid profile: {e}"
+            ok = await self._propose(
+                {"op": "profile_set", "name": name, "profile": profile}
+            )
+            return (0, name) if ok else (-11, "no quorum")
+        if prefix == "osd erasure-code-profile ls":
+            return 0, sorted(self.osdmap.ec_profiles)
+        if prefix == "osd erasure-code-profile get":
+            p = self.osdmap.ec_profiles.get(cmd["name"])
+            return (0, p) if p is not None else (-2, "not found")
+        if prefix == "osd erasure-code-profile rm":
+            for pool in self.osdmap.pools.values():
+                if pool.profile_name == cmd["name"]:
+                    return -16, f"profile in use by pool {pool.name}"  # EBUSY
+            ok = await self._propose({"op": "profile_rm", "name": cmd["name"]})
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "osd pool create":
+            name = cmd["name"]
+            if name in self.osdmap.pools:
+                return -17, "pool exists"  # EEXIST
+            pname = cmd["profile"]
+            profile = self.osdmap.ec_profiles.get(pname)
+            if profile is None:
+                return -2, f"no profile {pname}"
+            from ceph_tpu.plugins import registry as registry_mod
+
+            plugin = profile.get("plugin", "jerasure")
+            ec = registry_mod.instance().factory(
+                plugin, {k: v for k, v in profile.items() if k != "plugin"}
+            )
+            pool = {
+                "name": name,
+                "profile_name": pname,
+                "k": ec.get_data_chunk_count(),
+                "m": ec.get_chunk_count() - ec.get_data_chunk_count(),
+                "pg_num": cmd.get("pg_num", 128),
+                "hosts": cmd.get("hosts"),
+            }
+            ok = await self._propose({"op": "pool_create", "pool": pool})
+            return (0, pool) if ok else (-11, "no quorum")
+        if prefix in ("osd out", "osd in", "osd down", "osd up"):
+            inc = {"op": f"osd_{prefix.split()[1]}", "osd": cmd["osd"]}
+            if prefix == "osd in" and "weight" in cmd:
+                from ceph_tpu.crush.map import weight_fp
+
+                inc["weight"] = weight_fp(cmd["weight"])  # float -> 16.16
+            ok = await self._propose(inc)
+            return (0, "") if ok else (-11, "no quorum")
+        return -38, f"unknown command {prefix}"  # ENOSYS
+
+
+class MonClient:
+    """Client-side handle: send commands to any live monitor, subscribe to
+    map updates (reference: src/mon/MonClient.cc hunting + subscriptions)."""
+
+    def __init__(self, messenger: Messenger, n_mons: int, name: str):
+        self.messenger = messenger
+        self.n_mons = n_mons
+        self.name = name
+        self._id = 0
+        self._replies: Dict[int, asyncio.Future] = {}
+        self._active = 0  # last monitor that answered (hunting state)
+
+    async def handle_reply(self, msg: dict) -> bool:
+        """Feed mon_command_reply dicts here from the owner's dispatcher."""
+        if msg.get("type") != "mon_command_reply":
+            return False
+        fut = self._replies.pop(msg["id"], None)
+        if fut and not fut.done():
+            fut.set_result((msg["rc"], msg["out"]))
+        return True
+
+    async def command(self, cmd: dict, timeout: float = 2.0):
+        """Try each monitor until one answers (hunting)."""
+        last = (-110, "timeout")  # ETIMEDOUT
+        for attempt in range(self.n_mons):
+            rank = (self._active + attempt) % self.n_mons
+            if self.messenger.is_down(f"mon.{rank}"):
+                continue  # don't burn a timeout on a known-dead mon
+            self._id += 1
+            mid = self._id
+            fut = asyncio.get_event_loop().create_future()
+            self._replies[mid] = fut
+            await self.messenger.send_message(
+                self.name,
+                f"mon.{rank}",
+                {"type": "mon_command", "cmd": cmd, "id": mid},
+            )
+            try:
+                rc, out = await asyncio.wait_for(fut, timeout)
+            except asyncio.TimeoutError:
+                self._replies.pop(mid, None)
+                continue
+            if rc == -11:  # EAGAIN: that mon has no leader yet; try next
+                last = (rc, out)
+                continue
+            self._active = rank  # stick with the mon that answered
+            return rc, out
+        return last
+
+    async def subscribe(self) -> None:
+        for r in range(self.n_mons):
+            await self.messenger.send_message(
+                self.name, f"mon.{r}", {"type": "mon_subscribe"}
+            )
+
+
+class MonCluster:
+    """n monitors on one messenger (the mon side of a vstart cluster)."""
+
+    def __init__(self, n_mons: int, messenger: Messenger, tick: bool = True):
+        self.messenger = messenger
+        self.mons = [Monitor(r, n_mons, messenger) for r in range(n_mons)]
+        self._tick = tick
+
+    async def form_quorum(self, timeout: float = 3.0) -> Monitor:
+        """Kick an election from the lowest live rank and wait for quorum."""
+        for mon in self.mons:
+            if not self.messenger.is_down(mon.name):
+                asyncio.get_event_loop().create_task(mon.start_election())
+                break
+        leader = await self.wait_for_leader(timeout)
+        if self._tick:
+            for mon in self.mons:
+                if f"{mon.name}.tick" not in self.messenger._tasks:
+                    mon.start_tick()
+        return leader
+
+    async def wait_for_leader(self, timeout: float = 3.0) -> Monitor:
+        deadline = asyncio.get_event_loop().time() + timeout
+        while asyncio.get_event_loop().time() < deadline:
+            for mon in self.mons:
+                if mon.is_leader() and not self.messenger.is_down(mon.name):
+                    return mon
+            await asyncio.sleep(0.02)
+        raise TimeoutError("no monitor quorum")
+
+    def kill(self, rank: int) -> None:
+        self.messenger.mark_down(f"mon.{rank}")
+
+    def revive(self, rank: int) -> None:
+        self.messenger.mark_up(f"mon.{rank}")

@@ -1,0 +1,102 @@
+"""OSDMap: the epoch-versioned cluster map the monitors replicate.
+
+Reference: src/osd/OSDMap.{h,cc} — epoch, per-osd up/down + in/out
+(weight) state, pools with their erasure-code profiles and crush rules;
+src/mon/OSDMonitor.cc applies incrementals under paxos.  Here the map is a
+plain dict-serializable object; incrementals are shallow command dicts
+applied in `apply`.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+
+@dataclass
+class PoolInfo:
+    name: str
+    profile_name: str
+    k: int
+    m: int
+    pg_num: int = 128
+    # crush failure-domain spec: None -> flat over osds
+    hosts: Optional[List[List[int]]] = None
+
+
+@dataclass
+class OSDMap:
+    epoch: int = 0
+    max_osd: int = 0
+    # osd id -> up? (down osds keep acting positions; CRUSH ignores this)
+    up: Dict[int, bool] = field(default_factory=dict)
+    # osd id -> 16.16 in/out weight (0 == out); drives CRUSH placement
+    weights: Dict[int, int] = field(default_factory=dict)
+    ec_profiles: Dict[str, Dict[str, str]] = field(default_factory=dict)
+    pools: Dict[str, PoolInfo] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "max_osd": self.max_osd,
+            "up": {str(k): v for k, v in self.up.items()},
+            "weights": {str(k): v for k, v in self.weights.items()},
+            "ec_profiles": copy.deepcopy(self.ec_profiles),
+            "pools": {
+                name: {
+                    "name": p.name,
+                    "profile_name": p.profile_name,
+                    "k": p.k,
+                    "m": p.m,
+                    "pg_num": p.pg_num,
+                    "hosts": p.hosts,
+                }
+                for name, p in self.pools.items()
+            },
+        }
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "OSDMap":
+        m = cls(
+            epoch=d["epoch"],
+            max_osd=d["max_osd"],
+            up={int(k): v for k, v in d["up"].items()},
+            weights={int(k): v for k, v in d["weights"].items()},
+            ec_profiles=copy.deepcopy(d["ec_profiles"]),
+        )
+        for name, p in d["pools"].items():
+            m.pools[name] = PoolInfo(**p)
+        return m
+
+    # -- incremental application (OSDMonitor::update_from_paxos analogue) --
+
+    def apply(self, inc: dict) -> None:
+        """Apply one committed incremental; bumps epoch."""
+        op = inc["op"]
+        if op == "create_osds":
+            n = inc["n"]
+            for i in range(n):
+                self.up.setdefault(i, True)
+                self.weights.setdefault(i, 0x10000)
+            self.max_osd = max(self.max_osd, n)
+        elif op == "osd_down":
+            self.up[inc["osd"]] = False
+        elif op == "osd_up":
+            self.up[inc["osd"]] = True
+        elif op == "osd_out":
+            self.weights[inc["osd"]] = 0
+        elif op == "osd_in":
+            self.weights[inc["osd"]] = inc.get("weight", 0x10000)
+        elif op == "profile_set":
+            self.ec_profiles[inc["name"]] = dict(inc["profile"])
+        elif op == "profile_rm":
+            self.ec_profiles.pop(inc["name"], None)
+        elif op == "pool_create":
+            p = inc["pool"]
+            self.pools[p["name"]] = PoolInfo(**p)
+        elif op == "pool_rm":
+            self.pools.pop(inc["name"], None)
+        else:
+            raise ValueError(f"unknown incremental op {op}")
+        self.epoch += 1

@@ -78,6 +78,79 @@ class ECCluster:
         if self.placement is not None:
             self.placement.mark_in(osd_id, weight)
 
+    # -- monitor-backed cluster (mon quorum owns the osdmap) ---------------
+
+    @classmethod
+    async def create_with_mons(
+        cls,
+        n_osds: int,
+        profile: Dict[str, str],
+        n_mons: int = 3,
+        pool: str = "ecpool",
+        plugin: Optional[str] = None,
+        fault: Optional[FaultInjector] = None,
+        hosts=None,
+    ) -> "ECCluster":
+        """Full control-plane bring-up: elect a mon quorum, register OSDs,
+        validate + store the EC profile, create the pool — all through
+        paxos-committed osdmap epochs — then attach the data path with
+        placement driven by mon map broadcasts.
+
+        Reference flow: vstart.sh boots mons before osds; pools/profiles
+        are created via `ceph osd ...` commands that OSDMonitor validates
+        and commits (SURVEY.md §3.5)."""
+        from ceph_tpu.mon.monitor import MonClient, MonCluster
+
+        plugin = plugin or dict(profile).pop("plugin", "jerasure")
+        profile = {k: v for k, v in profile.items() if k != "plugin"}
+        self = cls(
+            n_osds, dict(profile), plugin=plugin, fault=fault,
+            use_crush=True, hosts=hosts,
+        )
+        self.mons = MonCluster(n_mons, self.messenger)
+        await self.mons.form_quorum()
+        self.monc = MonClient(self.messenger, n_mons, self.backend.name)
+        # route mon replies and map broadcasts through the client dispatcher
+        backend = self.backend
+
+        async def mon_hook(msg: dict) -> None:
+            if await self.monc.handle_reply(msg):
+                return
+            if msg.get("type") == "osdmap" and backend.placement is not None:
+                m = msg["map"]
+                if m["epoch"] > self._osdmap_epoch:
+                    self._osdmap_epoch = m["epoch"]
+                    for osd_s, w in m["weights"].items():
+                        backend.placement.weights[int(osd_s)] = w
+                    backend.placement.epoch += 1  # invalidate pg cache
+
+        self._osdmap_epoch = 0
+        backend.mon_hook = mon_hook
+        full_profile = dict(profile)
+        full_profile["plugin"] = plugin
+        for cmd in (
+            {"prefix": "osd create", "n": n_osds},
+            {
+                "prefix": "osd erasure-code-profile set",
+                "name": f"{pool}-profile",
+                "profile": full_profile,
+            },
+            {
+                "prefix": "osd pool create",
+                "name": pool,
+                "profile": f"{pool}-profile",
+                "hosts": hosts,
+            },
+        ):
+            rc, out = await self.monc.command(cmd)
+            if rc != 0:
+                raise RuntimeError(f"bootstrap {cmd['prefix']}: {out}")
+        await self.monc.subscribe()
+        return self
+
+    async def mon_command(self, cmd: Dict) -> tuple:
+        return await self.monc.command(cmd)
+
     async def recover_object_shard(
         self, oid: str, shard: int, target_osd: int
     ) -> None:

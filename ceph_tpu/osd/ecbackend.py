@@ -212,6 +212,12 @@ class ECBackend:
     # -- write path --------------------------------------------------------
 
     async def dispatch(self, src: str, msg) -> None:
+        if isinstance(msg, dict):
+            # monitor traffic (command replies, osdmap broadcasts)
+            hook = getattr(self, "mon_hook", None)
+            if hook is not None:
+                await hook(msg)
+            return
         if isinstance(msg, ECSubWriteReply):
             state = self._pending.get(msg.tid)
             if state is None:

@@ -78,6 +78,8 @@ class Messenger:
 
     async def send_message(self, src: str, dst: str, msg: object) -> None:
         """Ordered, lossy-under-injection delivery."""
+        if src in self._down:
+            return  # a dead entity cannot send either
         if dst in self._down or dst not in self._queues:
             return  # lossy: messages to dead peers vanish
         if self.fault.maybe_drop():
@@ -85,6 +87,11 @@ class Messenger:
         await self.fault.maybe_delay()
         self._seq += 1
         await self._queues[dst].put((src, msg))
+
+    def adopt_task(self, name: str, task: "asyncio.Task") -> None:
+        """Track an auxiliary task (e.g. a daemon's tick loop) so shutdown
+        cancels it with the dispatch loops."""
+        self._tasks[name] = task
 
     # -- failure control (thrasher hooks) ----------------------------------
 

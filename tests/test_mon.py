@@ -1,0 +1,243 @@
+"""Monitor cluster tests: election, paxos agreement, leader failover,
+minority-partition safety, OSDMonitor command flows, map-broadcast re-peer.
+
+Reference analogues: src/test/mon/*, qa mon_thrash.py scenarios, and the
+§3.5 control-plane call stack (profile set / pool create validation).
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mon.monitor import MonClient, MonCluster
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osd.messenger import Messenger
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def test_election_lowest_rank_wins():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        leader = await mc.form_quorum()
+        # transient dual-leader windows converge to the lowest live rank
+        await asyncio.sleep(0.2)
+        leader = await mc.wait_for_leader()
+        assert leader.rank == 0
+        assert 0 in leader.quorum
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_paxos_replicates_commits_to_all():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        leader = await mc.form_quorum()
+        for i in range(5):
+            ok = await leader._propose({"op": "create_osds", "n": i + 1})
+            assert ok
+        await asyncio.sleep(0.1)
+        for mon in mc.mons:
+            assert mon.paxos.store.last_committed == 5
+            assert mon.osdmap.epoch == 5
+            assert mon.osdmap.max_osd == 5
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_leader_failover_and_state_carryover():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        leader = await mc.form_quorum()
+        assert await leader._propose({"op": "create_osds", "n": 4})
+        mc.kill(leader.rank)
+        new_leader = await mc.form_quorum()
+        assert new_leader.rank != leader.rank
+        # committed state survived the failover
+        assert new_leader.osdmap.max_osd == 4
+        assert await new_leader._propose(
+            {"op": "profile_set", "name": "p", "profile": {"k": "2", "m": "1"}}
+        )
+        await asyncio.sleep(0.1)
+        for mon in mc.mons:
+            if mon.rank != leader.rank:
+                assert mon.osdmap.ec_profiles.get("p") == {"k": "2", "m": "1"}
+        # old leader revived: catches up at the next election's collect
+        mc.revive(leader.rank)
+        relead = await mc.form_quorum()
+        assert relead.rank == leader.rank  # lowest rank reclaims leadership
+        await asyncio.sleep(0.2)
+        assert relead.osdmap.ec_profiles.get("p") == {"k": "2", "m": "1"}
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_minority_cannot_commit():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        leader = await mc.form_quorum()
+        # partition the leader away from both peers: no majority
+        mc.kill(1)
+        mc.kill(2)
+        ok = await leader.paxos.propose(
+            {"inc": {"op": "create_osds", "n": 9}}, leader.quorum, timeout=0.3
+        )
+        assert not ok
+        assert leader.osdmap.max_osd == 0  # nothing committed
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_command_validation_and_flows():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl = MonClient(ms, 3, "client0")
+
+        got = {}
+
+        async def dispatch(src, msg):
+            if isinstance(msg, dict):
+                if not await cl.handle_reply(msg):
+                    got.setdefault("maps", []).append(msg["map"]["epoch"])
+
+        ms.register("client0", dispatch)
+        rc, _ = await cl.command({"prefix": "osd create", "n": 6})
+        assert rc == 0
+        # invalid profile rejected by plugin validation (k=0)
+        rc, out = await cl.command(
+            {
+                "prefix": "osd erasure-code-profile set",
+                "name": "bad",
+                "profile": {"plugin": "jerasure", "k": "0", "m": "1"},
+            }
+        )
+        assert rc == -22 and "invalid" in str(out)
+        rc, _ = await cl.command(
+            {
+                "prefix": "osd erasure-code-profile set",
+                "name": "good",
+                "profile": {"plugin": "jerasure", "k": "2", "m": "1"},
+            }
+        )
+        assert rc == 0
+        rc, pool = await cl.command(
+            {"prefix": "osd pool create", "name": "pl", "profile": "good"}
+        )
+        assert rc == 0 and pool["k"] == 2 and pool["m"] == 1
+        # duplicate pool -> EEXIST; unknown profile -> ENOENT; busy profile rm
+        rc, _ = await cl.command(
+            {"prefix": "osd pool create", "name": "pl", "profile": "good"}
+        )
+        assert rc == -17
+        rc, _ = await cl.command(
+            {"prefix": "osd pool create", "name": "p2", "profile": "nope"}
+        )
+        assert rc == -2
+        rc, _ = await cl.command(
+            {"prefix": "osd erasure-code-profile rm", "name": "good"}
+        )
+        assert rc == -16
+        rc, st = await cl.command({"prefix": "status"})
+        assert rc == 0 and st["pools"] == ["pl"] and st["num_osds"] == 6
+        # subscription delivers the current map
+        await cl.subscribe()
+        await asyncio.sleep(0.1)
+        assert got["maps"] and max(got["maps"]) == st["osdmap_epoch"]
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_commands_via_non_leader_are_forwarded():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl = MonClient(ms, 3, "client1")
+
+        async def dispatch(src, msg):
+            if isinstance(msg, dict):
+                await cl.handle_reply(msg)
+
+        ms.register("client1", dispatch)
+        # address mon.2 (a peon) directly: it forwards to the leader
+        cl._id += 1
+        fut = asyncio.get_event_loop().create_future()
+        cl._replies[cl._id] = fut
+        await ms.send_message(
+            "client1",
+            "mon.2",
+            {"type": "mon_command", "cmd": {"prefix": "status"}, "id": cl._id},
+        )
+        rc, st = await asyncio.wait_for(fut, 2)
+        assert rc == 0 and st["leader"] == 0
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_cluster_with_mons_end_to_end():
+    """Bring-up through the mon control plane, then: write, mon 'osd out'
+    command -> paxos commit -> map broadcast -> client re-peers (CRUSH
+    remap) -> object still readable."""
+
+    async def main():
+        c = await ECCluster.create_with_mons(
+            8, {"k": "3", "m": "2", "plugin": "jerasure"}, n_mons=3
+        )
+        payload = bytes(range(256)) * 64
+        await c.write("obj", payload)
+        acting = c.backend.acting_set("obj")
+        victim = acting[2]
+        rc, _ = await c.mon_command({"prefix": "osd out", "osd": victim})
+        assert rc == 0
+        await asyncio.sleep(0.2)  # map broadcast propagation
+        after = c.backend.acting_set("obj")
+        assert victim not in after
+        assert await c.read("obj") == payload
+        # a mon dying does not affect the data path; quorum survives
+        c.mons.kill(2)
+        rc, st = await c.mon_command({"prefix": "status"})
+        assert rc == 0
+        assert await c.read("obj") == payload
+        await c.shutdown()
+
+    run(main())
+
+
+def test_cluster_mons_leader_death_lease_failover():
+    """Killing the *leader* mon: lease probes time out, a surviving mon
+    elects itself, commands and map broadcasts keep flowing."""
+
+    async def main():
+        c = await ECCluster.create_with_mons(
+            8, {"k": "3", "m": "2", "plugin": "jerasure"}, n_mons=3
+        )
+        payload = b"failover" * 999
+        await c.write("obj", payload)
+        c.mons.kill(0)  # the leader
+        rc, st = await c.mon_command({"prefix": "status"})
+        assert rc == 0 and st["leader"] in (1, 2), st
+        victim = c.backend.acting_set("obj")[1]
+        rc, _ = await c.mon_command({"prefix": "osd out", "osd": victim})
+        assert rc == 0
+        await asyncio.sleep(0.3)
+        assert victim not in c.backend.acting_set("obj")
+        assert await c.read("obj") == payload
+        await c.shutdown()
+
+    run(main())
