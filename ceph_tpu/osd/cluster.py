@@ -27,10 +27,12 @@ class ECCluster:
         fault: Optional[FaultInjector] = None,
         use_crush: bool = True,
         hosts=None,
+        op_queue: str = "wpq",
     ):
         self.messenger = Messenger(fault)
         self.osds: List[OSDShard] = [
-            OSDShard(i, self.messenger) for i in range(n_osds)
+            OSDShard(i, self.messenger, op_queue=op_queue)
+            for i in range(n_osds)
         ]
         plugin = plugin or profile.pop("plugin", "jerasure")
         registry = registry_mod.instance()

@@ -49,11 +49,33 @@ def shard_oid(oid: str, shard: int) -> str:
     return f"{oid}@{shard}"
 
 
-class OSDShard:
-    """One OSD daemon holding one shard position per object it stores."""
+#: osd_client_op_priority / osd_recovery_op_priority defaults
+OP_PRIORITY = {"client": 63, "recovery": 10, "scrub": 5}
 
-    def __init__(self, osd_id: int, messenger: Messenger):
+#: mclock_opclass-style defaults: (reservation, weight, limit) items/sec;
+#: clients get a floor and most of the weight, background work is capped
+MCLOCK_DEFAULTS = {
+    "client": (1000.0, 100.0, 0.0),
+    "recovery": (100.0, 10.0, 2000.0),
+    "scrub": (50.0, 5.0, 1000.0),
+}
+
+
+class OSDShard:
+    """One OSD daemon holding one shard position per object it stores.
+
+    Incoming EC sub-ops pass through a QoS op queue served by a worker
+    loop — the ShardedOpWQ role (reference src/osd/OSD.h:1566), with the
+    queue discipline selected like ``osd_op_queue``: ``wpq`` (default) or
+    ``mclock`` (src/osd/mClockOpClassQueue).  Heartbeat pings bypass the
+    queue (the reference's fast-dispatch path).
+    """
+
+    def __init__(self, osd_id: int, messenger: Messenger,
+                 op_queue: str = "wpq"):
+        from ceph_tpu.osd.opqueue import MClockQueue, WeightedPriorityQueue
         from ceph_tpu.osd.pglog import PGLog
+        from ceph_tpu.utils.optracker import OpTracker
 
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
@@ -61,21 +83,111 @@ class OSDShard:
         self.messenger = messenger
         self.perf = PerfCounters(f"osd.{osd_id}")
         self.pglog = PGLog()
+        self.optracker = OpTracker()
+        self.op_queue_type = op_queue
+        if op_queue == "mclock":
+            self.opq = MClockQueue(dict(MCLOCK_DEFAULTS))
+        else:
+            self.opq = WeightedPriorityQueue()
+        self._op_event = asyncio.Event()
         #: simulates a hung daemon: alive on the wire but never responding
         #: (what OSD heartbeats exist to catch, reference OSD.cc:4612
         #: handle_osd_ping / HeartbeatMap suicide timeouts)
         self.frozen = False
         messenger.register(self.name, self.dispatch)
+        messenger.adopt_task(
+            f"{self.name}.opwq",
+            asyncio.get_event_loop().create_task(self._op_worker()),
+        )
+
+    def _op_cost(self, msg) -> int:
+        if isinstance(msg, ECSubWrite):
+            return max(
+                1,
+                sum(len(op.data) for op in msg.transaction.ops) // 4096,
+            )
+        return 1
 
     async def dispatch(self, src: str, msg) -> None:
         if self.frozen:
             return
         if msg == "ping":
+            # fast dispatch: heartbeats never sit behind the op queue
             await self.messenger.send_message(self.name, src, ("pong", self.name))
-        elif isinstance(msg, ECSubWrite):
-            await self.handle_sub_write(src, msg)
-        elif isinstance(msg, ECSubRead):
-            await self.handle_sub_read(src, msg)
+            return
+        if isinstance(msg, (ECSubWrite, ECSubRead)):
+            klass = getattr(msg, "op_class", "client")
+            cost = self._op_cost(msg)
+            if self.op_queue_type == "mclock":
+                self.opq.enqueue(
+                    klass, cost, (src, msg), asyncio.get_event_loop().time()
+                )
+            else:
+                self.opq.enqueue(OP_PRIORITY.get(klass, 63), cost, (src, msg))
+            self.perf.inc(f"queued_{klass}")
+            self._op_event.set()
+
+    async def _op_worker(self) -> None:
+        """Dequeue-and-execute loop (the osd_op_tp worker thread role)."""
+        loop = asyncio.get_event_loop()
+        while True:
+            await self._op_event.wait()
+            self._op_event.clear()
+            while True:
+                if self.op_queue_type == "mclock":
+                    now = loop.time()
+                    item = self.opq.dequeue(now)
+                    if item is None:
+                        nxt = self.opq.next_ready(now)
+                        if nxt is None:
+                            break
+                        # wait for the tag to come due OR a new arrival
+                        # (whose reservation may be eligible right away)
+                        try:
+                            await asyncio.wait_for(
+                                self._op_event.wait(),
+                                timeout=max(0.0, nxt - now),
+                            )
+                            self._op_event.clear()
+                        except asyncio.TimeoutError:
+                            pass
+                        continue
+                else:
+                    if self.opq.empty():
+                        break
+                    item = self.opq.dequeue()
+                # a daemon frozen or marked down after enqueue must not
+                # execute (a "hung" OSD mutating its store would defeat
+                # the fault model the flag simulates)
+                if self.frozen or self.messenger.is_down(self.name):
+                    continue
+                src, msg = item
+                try:
+                    await self._execute_op(src, msg)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 — op failure must not
+                    # kill the worker; log and keep serving (the reference
+                    # logs and drops misbehaving ops too)
+                    import sys
+                    import traceback
+
+                    traceback.print_exc(file=sys.stderr)
+
+    async def _execute_op(self, src: str, msg) -> None:
+        kind = "sub_write" if isinstance(msg, ECSubWrite) else "sub_read"
+        op = self.optracker.create_request(
+            f"{kind}(tid={msg.tid} oid={next(iter(msg.to_read), '?') if isinstance(msg, ECSubRead) else msg.oid} shard={msg.from_shard})"
+        )
+        op.mark_event("dequeued")
+        try:
+            if isinstance(msg, ECSubWrite):
+                await self.handle_sub_write(src, msg)
+            else:
+                await self.handle_sub_read(src, msg)
+            op.mark_event("replied")
+        finally:
+            op.finish()
 
     async def handle_sub_write(self, src: str, msg: ECSubWrite) -> None:
         """reference ECBackend::handle_sub_write (:922): log the operation,
@@ -173,6 +285,11 @@ class ECBackend:
         # per-object version counter (pg-log-lite)
         self._versions: Dict[str, int] = {}
         self.log: List[LogEntry] = []
+        # in-flight RMW extent pinning + read-through byte cache
+        # (reference src/osd/ExtentCache.h)
+        from ceph_tpu.osd.extent_cache import ExtentCache
+
+        self.extent_cache = ExtentCache()
         # CRUSH placement engine (ceph_tpu.osd.placement.CrushPlacement);
         # None falls back to the seeded-permutation CRUSH-lite below.
         self.placement = placement
@@ -238,6 +355,12 @@ class ECBackend:
 
     async def write(self, oid: str, data: bytes) -> None:
         """Append-only full-object write (create or replace)."""
+        # full-object replace conflicts with any in-flight RMW on the object
+        async with self.extent_cache.pin(oid, 0, 1 << 62):
+            await self._write_pinned(oid, data)
+            self.extent_cache.invalidate(oid)
+
+    async def _write_pinned(self, oid: str, data: bytes) -> None:
         # pg-wide dense version (the eversion analogue): shards log every
         # write in order so divergence is detectable and rollbackable
         version = max(self._versions.values(), default=0) + 1
@@ -313,6 +436,7 @@ class ECBackend:
         shards: List[int],
         acting: List[int],
         extents: Optional[List[Tuple[int, int]]] = None,
+        op_class: str = "client",
     ) -> Dict[int, ECSubReadReply]:
         shards = [s for s in shards if acting[s] is not None]
         self._tid += 1
@@ -329,6 +453,7 @@ class ECBackend:
                 tid=tid,
                 to_read={oid: list(extents) if extents else [(0, -1)]},
                 attrs_to_read=[oid],
+                op_class=op_class,
             )
             await self.messenger.send_message(
                 self.name, f"osd.{acting[s]}", sub
@@ -425,6 +550,10 @@ class ECBackend:
         if offset >= size:
             return b""
         length = min(length, size - offset)
+        cached = self.extent_cache.get(oid, offset, length)
+        if cached is not None:
+            self.perf.inc("read_cache_hit")
+            return cached
         start, span = self.sinfo.offset_len_to_stripe_bounds(offset, length)
         chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
         chunk_len = (span // self.sinfo.stripe_width) * self.sinfo.chunk_size
@@ -473,6 +602,22 @@ class ECBackend:
         Appends extend the cumulative hash info; overwrites clear the chunk
         hashes like the reference's ec_overwrites mode.
         """
+        # pin the whole write span: overlapping RMW ops must serialize or
+        # they would read each other's pre-commit bytes (ExtentCache role)
+        lo_pin, _ = self.sinfo.offset_len_to_stripe_bounds(offset, max(1, len(data)))
+        hi_pin = self.sinfo.logical_to_next_stripe_offset(offset + len(data))
+        async with self.extent_cache.pin(oid, lo_pin, hi_pin) as pin:
+            try:
+                await self._write_range_pinned(oid, offset, data, pin)
+            except Exception:
+                # a partially-acked write leaves shard state ahead of the
+                # cache: cached pre-write bytes would serve stale reads
+                self.extent_cache.invalidate(oid)
+                raise
+
+    async def _write_range_pinned(
+        self, oid: str, offset: int, data: bytes, pin
+    ) -> None:
         from ceph_tpu.osd.ectransaction import get_write_plan
 
         size, hinfo_d = await self._stat(oid)
@@ -550,6 +695,9 @@ class ECBackend:
         self.perf.inc("write_range")
         await asyncio.wait_for(done, timeout=30)
         del self._pending[tid]
+        # publish committed bytes for read-through (padding included: those
+        # bytes are logically zero up to new_size and real data below it)
+        pin.commit(start, buf.tobytes())
 
     # -- scrub -------------------------------------------------------------
 
@@ -564,7 +712,7 @@ class ECBackend:
             for s in range(self.km)
             if self._shard_up(acting, s)
         ]
-        replies = await self._read_shards(oid, up, acting)
+        replies = await self._read_shards(oid, up, acting, op_class="scrub")
         report = {
             "oid": oid,
             "crc_errors": [],
@@ -613,7 +761,9 @@ class ECBackend:
             and self._shard_up(acting, s)
         ]
         minimum = self.ec.minimum_to_decode([shard], up_shards)
-        replies = await self._read_shards(oid, sorted(minimum.keys()), acting)
+        replies = await self._read_shards(
+            oid, sorted(minimum.keys()), acting, op_class="recovery"
+        )
         chunks = {
             s: np.frombuffer(r.buffers_read[oid][0][1], dtype=np.uint8)
             for s, r in replies.items()
@@ -648,6 +798,7 @@ class ECBackend:
             oid=oid,
             transaction=txn,
             at_version=self._versions.get(oid, 1),
+            op_class="recovery",
         )
         await self.messenger.send_message(self.name, f"osd.{target_osd}", sub)
         await asyncio.wait_for(done, timeout=30)

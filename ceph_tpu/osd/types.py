@@ -68,6 +68,8 @@ class ECSubWrite:
     transaction: Transaction
     at_version: int
     log_entries: List[LogEntry] = dataclasses.field(default_factory=list)
+    #: QoS class for the OSD op queue ("client" | "recovery" | "scrub")
+    op_class: str = "client"
 
 
 @dataclasses.dataclass
@@ -90,6 +92,8 @@ class ECSubRead:
     subchunks: Dict[str, List[Tuple[int, int]]] = dataclasses.field(
         default_factory=dict
     )
+    #: QoS class for the OSD op queue ("client" | "recovery" | "scrub")
+    op_class: str = "client"
 
 
 @dataclasses.dataclass

@@ -1,0 +1,93 @@
+"""In-flight + historic op tracking (OpTracker/TrackedOp equivalent).
+
+Reference: src/common/TrackedOp.{h,cc} and the OSD admin-socket commands
+``dump_ops_in_flight`` / ``dump_historic_ops`` (src/osd/OSD.cc:2188-2222).
+Each tracked op records a timestamped event timeline (queued, dequeued,
+sub-op sent, commit...); completed ops roll into a bounded historic ring
+kept by slowest-first so the worst ops survive.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Dict, List, Optional
+
+
+class TrackedOp:
+    def __init__(self, tracker: "OpTracker", opid: int, desc: str):
+        self._tracker = tracker
+        self.opid = opid
+        self.desc = desc
+        self.initiated_at = time.time()
+        self.events: List[tuple] = [(self.initiated_at, "initiated")]
+        self.finished_at: Optional[float] = None
+
+    def mark_event(self, name: str) -> None:
+        self.events.append((time.time(), name))
+
+    def finish(self) -> None:
+        if self.finished_at is None:
+            self.finished_at = time.time()
+            self.events.append((self.finished_at, "done"))
+            self._tracker._finish(self)
+
+    @property
+    def duration(self) -> float:
+        end = self.finished_at if self.finished_at is not None else time.time()
+        return end - self.initiated_at
+
+    def to_dict(self) -> dict:
+        return {
+            "opid": self.opid,
+            "description": self.desc,
+            "initiated_at": self.initiated_at,
+            "age": self.duration,
+            "type_data": {
+                "events": [
+                    {"time": t, "event": name} for t, name in self.events
+                ]
+            },
+        }
+
+
+class OpTracker:
+    def __init__(self, history_size: int = 20, history_slow_size: int = 20):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._inflight: Dict[int, TrackedOp] = {}
+        self._historic: deque = deque(maxlen=history_size)
+        #: slowest completed ops, kept sorted by duration
+        self._slowest: List[TrackedOp] = []
+        self.history_slow_size = history_slow_size
+
+    def create_request(self, desc: str) -> TrackedOp:
+        with self._lock:
+            self._next_id += 1
+            op = TrackedOp(self, self._next_id, desc)
+            self._inflight[op.opid] = op
+            return op
+
+    def _finish(self, op: TrackedOp) -> None:
+        with self._lock:
+            self._inflight.pop(op.opid, None)
+            self._historic.append(op)
+            self._slowest.append(op)
+            self._slowest.sort(key=lambda o: -o.duration)
+            del self._slowest[self.history_slow_size :]
+
+    def dump_ops_in_flight(self) -> dict:
+        with self._lock:
+            ops = [op.to_dict() for op in self._inflight.values()]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_ops(self) -> dict:
+        with self._lock:
+            ops = [op.to_dict() for op in self._historic]
+        return {"num_ops": len(ops), "ops": ops}
+
+    def dump_historic_slow_ops(self) -> dict:
+        with self._lock:
+            ops = [op.to_dict() for op in self._slowest]
+        return {"num_ops": len(ops), "ops": ops}
