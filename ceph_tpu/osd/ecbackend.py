@@ -83,6 +83,11 @@ class OSDShard:
         self.messenger = messenger
         self.perf = PerfCounters(f"osd.{osd_id}")
         self.pglog = PGLog()
+        #: per-shard-object applied version: the QoS queue may legally
+        #: reorder a low-priority recovery push behind a newer client
+        #: write, so applies are version-gated (reference: recovery pushes
+        #: carry the object version and PG logic discards stale ones)
+        self._applied_version: Dict[str, int] = {}
         self.optracker = OpTracker()
         self.op_queue_type = op_queue
         if op_queue == "mclock":
@@ -195,6 +200,18 @@ class OSDShard:
         from ceph_tpu.osd.pglog import PGLogEntry
 
         soid = shard_oid(msg.oid, msg.from_shard)
+        if msg.at_version < self._applied_version.get(soid, 0):
+            # dequeued behind a newer write to the same object (priority
+            # reordering): applying would clobber newer bytes with stale
+            # ones.  Ack without applying -- the shard holds the newer data.
+            self.perf.inc("sub_write_stale")
+            reply = ECSubWriteReply(
+                from_shard=msg.from_shard, tid=msg.tid,
+                committed=True, applied=False,
+            )
+            await self.messenger.send_message(self.name, src, reply)
+            return
+        self._applied_version[soid] = msg.at_version
         try:
             prior = self.store.stat(soid)
         except FileNotFoundError:
@@ -357,8 +374,12 @@ class ECBackend:
         """Append-only full-object write (create or replace)."""
         # full-object replace conflicts with any in-flight RMW on the object
         async with self.extent_cache.pin(oid, 0, 1 << 62):
-            await self._write_pinned(oid, data)
-            self.extent_cache.invalidate(oid)
+            try:
+                await self._write_pinned(oid, data)
+            finally:
+                # invalidate even on a partial/failed replace: some shards
+                # may have applied, so cached pre-replace bytes are stale
+                self.extent_cache.invalidate(oid)
 
     async def _write_pinned(self, oid: str, data: bytes) -> None:
         # pg-wide dense version (the eversion analogue): shards log every

@@ -28,8 +28,15 @@ class _ObjectState:
         #: active pins as (start, end) half-open logical intervals
         self.pins: List[Tuple[int, int]] = []
         self.cond: Optional[asyncio.Condition] = None
+        #: coroutines blocked in cond.wait() — idle state (no pins, no
+        #: waiters, no cached bytes) is pruned so the per-oid dict does not
+        #: grow without bound over a cluster's lifetime
+        self.waiters = 0
         #: committed cache: sorted non-overlapping (start, bytes)
         self.extents: List[Tuple[int, bytes]] = []
+
+    def idle(self) -> bool:
+        return not self.pins and self.waiters == 0 and not self.extents
 
     def condition(self) -> asyncio.Condition:
         if self.cond is None:
@@ -82,10 +89,20 @@ class ExtentCache:
     async def _acquire(self, oid: str, span: Tuple[int, int]) -> None:
         st = self._state(oid)
         cond = st.condition()
-        async with cond:
-            while any(self._overlaps(span, p) for p in st.pins):
-                await cond.wait()
-            st.pins.append(span)
+        try:
+            async with cond:
+                while any(self._overlaps(span, p) for p in st.pins):
+                    st.waiters += 1
+                    try:
+                        await cond.wait()
+                    finally:
+                        st.waiters -= 1
+                st.pins.append(span)
+        except BaseException:
+            # a cancelled waiter may be the last reference to this state
+            if st.idle() and self._objects.get(oid) is st:
+                self._objects.pop(oid, None)
+            raise
 
     async def _release(self, oid: str, span: Tuple[int, int]) -> None:
         st = self._state(oid)
@@ -93,6 +110,10 @@ class ExtentCache:
         cond = st.condition()
         async with cond:
             cond.notify_all()
+        # woken waiters still count in st.waiters until they resume, so
+        # this only fires once the object is truly quiescent
+        if st.idle():
+            self._objects.pop(oid, None)
 
     # -- committed-byte cache ----------------------------------------------
 
@@ -122,7 +143,11 @@ class ExtentCache:
         # bytes LRU-ish (pin state is kept — only cache memory is freed)
         cached = [o for o, s in self._objects.items() if s.extents and o != oid]
         while len(cached) + 1 > self.max_cached_objects:
-            self._objects[cached.pop(0)].extents = []
+            victim = cached.pop(0)
+            vs = self._objects[victim]
+            vs.extents = []
+            if vs.idle():
+                self._objects.pop(victim, None)
 
     def get(self, oid: str, offset: int, length: int) -> Optional[bytes]:
         """The cached bytes for [offset, offset+length) iff fully covered
@@ -146,5 +171,5 @@ class ExtentCache:
         st = self._objects.get(oid)
         if st is not None:
             st.extents = []
-            if not st.pins and st.cond is None:
+            if st.idle():
                 self._objects.pop(oid, None)

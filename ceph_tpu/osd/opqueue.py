@@ -20,7 +20,6 @@ Both queues are cost-aware: an item's cost scales its tag spacing (a
 from __future__ import annotations
 
 import heapq
-import itertools
 from collections import deque
 from typing import Dict, Optional, Tuple
 
@@ -64,16 +63,20 @@ class WeightedPriorityQueue:
                 del self._strict[prio]
             self._len -= 1
             return item
-        # weighted: deficit round robin, quantum proportional to priority
+        # weighted: deficit round robin, quantum proportional to priority.
+        # A bucket serves while its credit lasts; when the head item costs
+        # more than the remaining credit, the bucket receives one quantum
+        # (= its priority) and the turn passes on, so each full rotation
+        # serves ~priority-proportional cost from every bucket.
         while self._rr:
             prio = self._rr[0]
             q = self._weighted.get(prio)
             if not q:
                 self._rr.popleft()
                 self._weighted.pop(prio, None)
+                self._credit.pop(prio, None)
                 continue
-            self._credit[prio] += prio
-            cost, _ = q[0]
+            cost, item = q[0]
             if self._credit[prio] >= cost:
                 self._credit[prio] -= cost
                 q.popleft()
@@ -82,7 +85,8 @@ class WeightedPriorityQueue:
                     self._rr.popleft()
                     del self._weighted[prio]
                     self._credit[prio] = 0.0
-                return _
+                return item
+            self._credit[prio] += max(1, prio)  # prio<=0 must still progress
             self._rr.rotate(-1)
         raise IndexError("dequeue from empty queue")
 
@@ -101,7 +105,6 @@ class MClockQueue:
         self._queues: Dict[str, deque] = {}
         #: per-class last-assigned tags (reservation, proportional, limit)
         self._tags: Dict[str, Tuple[float, float, float]] = {}
-        self._seq = itertools.count()
 
     def _params(self, klass: str) -> Tuple[float, float, float]:
         return self.classes.get(klass, (0.0, 1.0, 0.0))

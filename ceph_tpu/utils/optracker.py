@@ -20,23 +20,28 @@ class TrackedOp:
         self._tracker = tracker
         self.opid = opid
         self.desc = desc
+        #: wall clock for display only; durations/ranking use monotonic so
+        #: an NTP step cannot produce negative ages or mis-rank slow ops
         self.initiated_at = time.time()
-        self.events: List[tuple] = [(self.initiated_at, "initiated")]
+        self._t0 = time.monotonic()
+        self.events: List[tuple] = [(0.0, "initiated")]
         self.finished_at: Optional[float] = None
+        self._t_end: Optional[float] = None
 
     def mark_event(self, name: str) -> None:
-        self.events.append((time.time(), name))
+        self.events.append((time.monotonic() - self._t0, name))
 
     def finish(self) -> None:
         if self.finished_at is None:
             self.finished_at = time.time()
-            self.events.append((self.finished_at, "done"))
+            self._t_end = time.monotonic()
+            self.events.append((self._t_end - self._t0, "done"))
             self._tracker._finish(self)
 
     @property
     def duration(self) -> float:
-        end = self.finished_at if self.finished_at is not None else time.time()
-        return end - self.initiated_at
+        end = self._t_end if self._t_end is not None else time.monotonic()
+        return end - self._t0
 
     def to_dict(self) -> dict:
         return {
@@ -46,7 +51,8 @@ class TrackedOp:
             "age": self.duration,
             "type_data": {
                 "events": [
-                    {"time": t, "event": name} for t, name in self.events
+                    {"time": self.initiated_at + t, "event": name}
+                    for t, name in self.events
                 ]
             },
         }
