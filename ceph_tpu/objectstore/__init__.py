@@ -1,0 +1,36 @@
+"""Storage engines (reference: src/os -- the ObjectStore layer).
+
+``ObjectStore.create`` (src/os/ObjectStore.cc:63) selects a backend by
+name.  All backends share MemStore's API surface (queue_transaction /
+read / getattr / stat / exists / list_objects), which is the subset of
+ObjectStore the EC path uses (SURVEY.md L2):
+
+* ``memstore``  -- RAM, test-grade (src/os/memstore/MemStore.cc)
+* ``filestore`` -- files + crc-framed WAL journal, crash-safe
+  (src/os/filestore/FileStore.cc + FileJournal)
+* ``kstore``    -- everything in a KeyValueDB (src/os/kstore/KStore.cc);
+  pairs with the ``lsm`` KeyValueDB for persistence
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.osd.memstore import MemStore
+from ceph_tpu.objectstore.filestore import FileStore
+from ceph_tpu.objectstore.kstore import KStore
+
+
+def create(kind: str, path: str = ""):
+    if kind == "memstore":
+        return MemStore()
+    if kind == "filestore":
+        if not path:
+            raise ValueError("filestore needs a data path")
+        return FileStore(path)
+    if kind == "kstore":
+        if not path:
+            raise ValueError("kstore needs a data path")
+        return KStore(path)
+    raise ValueError(f"unknown objectstore backend {kind!r}")
+
+
+__all__ = ["create", "MemStore", "FileStore", "KStore"]

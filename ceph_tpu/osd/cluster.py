@@ -28,10 +28,13 @@ class ECCluster:
         use_crush: bool = True,
         hosts=None,
         op_queue: str = "wpq",
+        objectstore: str = "memstore",
+        data_path: str = "",
     ):
         self.messenger = Messenger(fault)
         self.osds: List[OSDShard] = [
-            OSDShard(i, self.messenger, op_queue=op_queue)
+            OSDShard(i, self.messenger, op_queue=op_queue,
+                     objectstore=objectstore, data_path=data_path)
             for i in range(n_osds)
         ]
         plugin = plugin or profile.pop("plugin", "jerasure")
@@ -92,6 +95,9 @@ class ECCluster:
         plugin: Optional[str] = None,
         fault: Optional[FaultInjector] = None,
         hosts=None,
+        op_queue: str = "wpq",
+        objectstore: str = "memstore",
+        data_path: str = "",
     ) -> "ECCluster":
         """Full control-plane bring-up: elect a mon quorum, register OSDs,
         validate + store the EC profile, create the pool — all through
@@ -107,7 +113,8 @@ class ECCluster:
         profile = {k: v for k, v in profile.items() if k != "plugin"}
         self = cls(
             n_osds, dict(profile), plugin=plugin, fault=fault,
-            use_crush=True, hosts=hosts,
+            use_crush=True, hosts=hosts, op_queue=op_queue,
+            objectstore=objectstore, data_path=data_path,
         )
         self.mons = MonCluster(n_mons, self.messenger)
         await self.mons.form_quorum()
@@ -193,3 +200,7 @@ class ECCluster:
 
     async def shutdown(self) -> None:
         await self.messenger.shutdown()
+        for osd in self.osds:
+            umount = getattr(osd.store, "umount", None)
+            if umount is not None:
+                umount()

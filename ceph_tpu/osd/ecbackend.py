@@ -29,7 +29,6 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from ceph_tpu.osd import ecutil
-from ceph_tpu.osd.memstore import MemStore
 from ceph_tpu.osd.messenger import Messenger
 from ceph_tpu.osd.types import (
     ECSubRead,
@@ -72,14 +71,23 @@ class OSDShard:
     """
 
     def __init__(self, osd_id: int, messenger: Messenger,
-                 op_queue: str = "wpq"):
+                 op_queue: str = "wpq", objectstore: str = "memstore",
+                 data_path: str = ""):
         from ceph_tpu.osd.opqueue import MClockQueue, WeightedPriorityQueue
         from ceph_tpu.osd.pglog import PGLog
         from ceph_tpu.utils.optracker import OpTracker
 
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
-        self.store = MemStore()
+        # reference ObjectStore::create (src/os/ObjectStore.cc:63): backend
+        # chosen by name, data under the osd's own dir.  An empty data_path
+        # propagates as-is so the factory rejects pathless persistent
+        # backends instead of writing under the filesystem root.
+        from ceph_tpu import objectstore as os_mod
+
+        self.store = os_mod.create(
+            objectstore, f"{data_path}/osd.{osd_id}" if data_path else ""
+        )
         self.messenger = messenger
         self.perf = PerfCounters(f"osd.{osd_id}")
         self.pglog = PGLog()

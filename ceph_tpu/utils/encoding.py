@@ -1,0 +1,197 @@
+"""Binary encode/decode framework (denc-lite).
+
+Reference: src/include/encoding.h (1364 LoC) / src/include/denc.h -- every
+persistent or wire struct in the reference serializes through one small
+framework with explicit little-endian integer widths, length-prefixed
+blobs, and crc-guarded envelopes.  This is the same idea reduced to what
+the TPU framework persists: journal records, KV log records and object
+metadata.
+
+Value model (self-describing, tagged):
+  None, bool, int (u64/zigzag-s64), bytes, str, list, dict[str, value].
+
+Framed records (``frame``/``unframe``) carry ``MAGIC | len | crc32c |
+payload`` so torn tail writes after a crash are detected and discarded --
+the role of the reference's per-entry crcs in the FileStore journal
+(src/os/filestore/FileJournal.cc) and the message envelope crcs
+(src/msg/Message.cc).
+"""
+
+from __future__ import annotations
+
+import struct
+from typing import Any, List, Optional, Tuple
+
+import numpy as np
+
+from ceph_tpu.native.gf_native import crc32c
+
+_MAGIC = 0xCE9B10C5
+
+# value tags
+_T_NONE, _T_FALSE, _T_TRUE, _T_INT, _T_NEGINT, _T_BYTES, _T_STR, _T_LIST, \
+    _T_DICT = range(9)
+
+
+class Encoder:
+    def __init__(self) -> None:
+        self._parts: List[bytes] = []
+
+    def u8(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<B", v))
+        return self
+
+    def u32(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<I", v))
+        return self
+
+    def u64(self, v: int) -> "Encoder":
+        self._parts.append(struct.pack("<Q", v))
+        return self
+
+    def varint(self, v: int) -> "Encoder":
+        """LEB128 unsigned varint (denc.h uses the same shape)."""
+        assert v >= 0
+        out = bytearray()
+        while True:
+            b = v & 0x7F
+            v >>= 7
+            if v:
+                out.append(b | 0x80)
+            else:
+                out.append(b)
+                break
+        self._parts.append(bytes(out))
+        return self
+
+    def blob(self, data: bytes) -> "Encoder":
+        self.varint(len(data))
+        self._parts.append(bytes(data))
+        return self
+
+    def string(self, s: str) -> "Encoder":
+        return self.blob(s.encode("utf-8"))
+
+    def value(self, v: Any) -> "Encoder":
+        """Tagged self-describing value (None/bool/int/bytes/str/list/dict)."""
+        if v is None:
+            self.u8(_T_NONE)
+        elif v is True:
+            self.u8(_T_TRUE)
+        elif v is False:
+            self.u8(_T_FALSE)
+        elif isinstance(v, np.integer):
+            self.value(int(v))
+        elif isinstance(v, int):
+            if v >= 0:
+                self.u8(_T_INT).varint(v)
+            else:
+                self.u8(_T_NEGINT).varint(-v)
+        elif isinstance(v, (bytes, bytearray, memoryview)):
+            self.u8(_T_BYTES).blob(bytes(v))
+        elif isinstance(v, str):
+            self.u8(_T_STR).string(v)
+        elif isinstance(v, (list, tuple)):
+            self.u8(_T_LIST).varint(len(v))
+            for item in v:
+                self.value(item)
+        elif isinstance(v, dict):
+            self.u8(_T_DICT).varint(len(v))
+            for k in v:
+                if not isinstance(k, str):
+                    raise TypeError(f"dict keys must be str, got {type(k)}")
+                self.string(k)
+                self.value(v[k])
+        else:
+            raise TypeError(f"unencodable type {type(v)}")
+        return self
+
+    def bytes(self) -> bytes:
+        return b"".join(self._parts)
+
+
+class Decoder:
+    def __init__(self, data: bytes, pos: int = 0) -> None:
+        self._data = data
+        self._pos = pos
+
+    def remaining(self) -> int:
+        return len(self._data) - self._pos
+
+    def _take(self, n: int) -> bytes:
+        if self._pos + n > len(self._data):
+            raise ValueError("decode past end of buffer")
+        out = self._data[self._pos : self._pos + n]
+        self._pos += n
+        return out
+
+    def u8(self) -> int:
+        return self._take(1)[0]
+
+    def u32(self) -> int:
+        return struct.unpack("<I", self._take(4))[0]
+
+    def u64(self) -> int:
+        return struct.unpack("<Q", self._take(8))[0]
+
+    def varint(self) -> int:
+        v = 0
+        shift = 0
+        while True:
+            b = self.u8()
+            v |= (b & 0x7F) << shift
+            if not b & 0x80:
+                return v
+            shift += 7
+            if shift > 63:
+                raise ValueError("varint too long")
+
+    def blob(self) -> bytes:
+        return self._take(self.varint())
+
+    def string(self) -> str:
+        return self.blob().decode("utf-8")
+
+    def value(self) -> Any:
+        tag = self.u8()
+        if tag == _T_NONE:
+            return None
+        if tag == _T_TRUE:
+            return True
+        if tag == _T_FALSE:
+            return False
+        if tag == _T_INT:
+            return self.varint()
+        if tag == _T_NEGINT:
+            return -self.varint()
+        if tag == _T_BYTES:
+            return self.blob()
+        if tag == _T_STR:
+            return self.string()
+        if tag == _T_LIST:
+            return [self.value() for _ in range(self.varint())]
+        if tag == _T_DICT:
+            return {self.string(): self.value() for _ in range(self.varint())}
+        raise ValueError(f"bad value tag {tag}")
+
+
+def frame(payload: bytes) -> bytes:
+    """MAGIC | u32 len | u32 crc32c(payload) | payload."""
+    return struct.pack("<III", _MAGIC, len(payload), crc32c(payload)) + payload
+
+
+def unframe(data: bytes, pos: int) -> Tuple[Optional[bytes], int]:
+    """Decode one framed record at ``pos``.
+
+    Returns (payload, next_pos); (None, pos) on a torn/corrupt/short record
+    -- the caller treats that as end-of-log (crash-recovery semantics).
+    """
+    if pos + 12 > len(data):
+        return None, pos
+    magic, length, crc = struct.unpack_from("<III", data, pos)
+    if magic != _MAGIC or pos + 12 + length > len(data):
+        return None, pos
+    payload = data[pos + 12 : pos + 12 + length]
+    if crc32c(payload) != crc:
+        return None, pos
+    return payload, pos + 12 + length
