@@ -1,0 +1,2 @@
+"""Daemon entry points (reference: src/ceph_osd.cc etc. -- one process
+per daemon, booted by vstart-style scripts)."""

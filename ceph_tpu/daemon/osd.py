@@ -1,0 +1,63 @@
+"""ceph-osd daemon: one OSD process serving EC sub-ops over TCP.
+
+Reference boot flow: src/ceph_osd.cc (SURVEY.md §3.4) -- global init,
+ObjectStore::create, messengers, OSD::init.  Here:
+
+  python -m ceph_tpu.daemon.osd --id N --addr-map map.json \
+      [--objectstore filestore --data-path DIR] [--op-queue wpq]
+
+``map.json`` is the cluster address book: {"osd.0": ["127.0.0.1", 7000],
+..., "client": [...]} (the vstart harness writes it).  The process prints
+``osd.N up`` once the socket is listening (the harness's readiness
+signal) and runs until SIGTERM/SIGINT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import signal
+import sys
+
+
+async def serve(args) -> None:
+    from ceph_tpu.msg.tcp import TCPMessenger
+    from ceph_tpu.osd.ecbackend import OSDShard
+
+    with open(args.addr_map) as f:
+        addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+    name = f"osd.{args.id}"
+    messenger = TCPMessenger(name, addr_map)
+    await messenger.start()
+    OSDShard(
+        args.id, messenger, op_queue=args.op_queue,
+        objectstore=args.objectstore, data_path=args.data_path,
+    )
+    print(f"{name} up", flush=True)
+
+    stop = asyncio.Event()
+    loop = asyncio.get_event_loop()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        loop.add_signal_handler(sig, stop.set)
+    await stop.wait()
+    await messenger.shutdown()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--id", type=int, required=True)
+    ap.add_argument("--addr-map", required=True)
+    ap.add_argument("--objectstore", default="memstore")
+    ap.add_argument("--data-path", default="")
+    ap.add_argument("--op-queue", default="wpq")
+    args = ap.parse_args(argv)
+    try:
+        asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+"""Communication layer (reference: src/msg -- Messenger/Connection stack).
+
+* in-process bus: ``ceph_tpu.osd.messenger.Messenger`` (asyncio queues)
+* real transport: ``ceph_tpu.msg.tcp.TCPMessenger`` (loopback/LAN TCP with
+  framed, crc-guarded typed messages -- the AsyncMessenger posix-stack role)
+* wire codecs: ``ceph_tpu.msg.wire``
+"""
+
+from ceph_tpu.msg.tcp import TCPMessenger
+from ceph_tpu.msg.wire import decode_message, encode_message
+
+__all__ = ["TCPMessenger", "encode_message", "decode_message"]

@@ -1,0 +1,284 @@
+"""TCP messenger: the in-process bus semantics over real sockets.
+
+Reference: src/msg/async/AsyncMessenger.{h,cc} with the posix NetworkStack
+(src/msg/async/Stack.h:287, PosixStack.h) -- a listening socket per
+daemon, cached outgoing connections, a banner handshake naming the peer
+node, framed messages.  Policy is the reference's "lossy client": a send
+to an unreachable peer is dropped and the peer marked unreachable; later
+sends retry the connect, so a restarted daemon becomes reachable again
+(the reconnect role of the lossless-peer policy, minus replay).
+
+One ``TCPMessenger`` per process ("node").  A node hosts one or more
+named entities (e.g. ``osd.3``); the address book maps every entity name
+in the cluster to its node's (host, port).  Entity names co-hosted on
+this node short-circuit delivery in process (the reference's local
+fast-dispatch for self-sends, ECBackend.cc:2025-2032).
+
+Frames on the socket are ``encoding.frame`` records (magic+len+crc32c)
+whose payload is ``string src | string dst | encode_message(msg)``; the
+first frame on every outgoing connection is a banner naming the sender
+node and protocol version (Pipe.cc banner exchange).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import struct
+from typing import Awaitable, Callable, Dict, Optional, Tuple
+
+from ceph_tpu.msg.wire import decode_message, encode_message
+from ceph_tpu.osd.messenger import FaultInjector
+from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
+
+_PROTOCOL_VERSION = 1
+_BANNER = "ceph-tpu-msgr"
+
+
+async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
+    """Read one framed record off the stream; None on EOF/corruption."""
+    try:
+        header = await reader.readexactly(12)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    magic, length, crc = struct.unpack("<III", header)
+    try:
+        payload = await reader.readexactly(length)
+    except (asyncio.IncompleteReadError, ConnectionError):
+        return None
+    rec, pos = unframe(header + payload, 0)
+    return rec  # None if magic/crc check failed
+
+
+class TCPMessenger:
+    """API-compatible with ``osd.messenger.Messenger`` so OSDShard /
+    ECBackend run unchanged over real sockets."""
+
+    def __init__(
+        self,
+        node: str,
+        addr_map: Dict[str, Tuple[str, int]],
+        fault: Optional[FaultInjector] = None,
+    ):
+        #: this process's node name; must appear in addr_map for serving
+        self.node = node
+        self.addr_map = dict(addr_map)
+        self.fault = fault or FaultInjector()
+        self._local_queues: Dict[str, asyncio.Queue] = {}
+        self._dispatchers: Dict[str, Callable] = {}
+        self._tasks: Dict[str, asyncio.Task] = {}
+        #: cached outgoing connections per peer node: (reader, writer, lock)
+        self._conns: Dict[str, Tuple] = {}
+        self._server: Optional[asyncio.AbstractServer] = None
+        #: administratively dead entities (mark_down -- the thrasher hook)
+        self._marked_down: set = set()
+        #: peers whose last connect/send failed; retried on next send
+        self._unreachable: set = set()
+        #: live incoming-connection handler tasks (cancelled on shutdown;
+        #: Server.wait_closed would otherwise block on them forever)
+        self._serve_tasks: set = set()
+
+    # -- lifecycle ---------------------------------------------------------
+
+    async def start(self) -> None:
+        host, port = self.addr_map[self.node]
+        self._server = await asyncio.start_server(
+            self._serve_connection, host, port
+        )
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+        for _, writer, _ in self._conns.values():
+            writer.close()
+        self._conns.clear()
+        pending = list(self._tasks.values()) + list(self._serve_tasks)
+        for task in pending:
+            task.cancel()
+        for task in pending:
+            try:
+                await task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._server is not None:
+            await self._server.wait_closed()
+
+    # -- entity registration (same surface as the in-process bus) ----------
+
+    def register(
+        self, name: str, dispatcher: Callable[[str, object], Awaitable[None]]
+    ) -> None:
+        self._local_queues[name] = asyncio.Queue()
+        self._dispatchers[name] = dispatcher
+        self._tasks[name] = asyncio.get_event_loop().create_task(
+            self._dispatch_loop(name)
+        )
+
+    def adopt_task(self, name: str, task: "asyncio.Task") -> None:
+        self._tasks[name] = task
+
+    async def _dispatch_loop(self, name: str) -> None:
+        queue = self._local_queues[name]
+        while True:
+            src, msg = await queue.get()
+            if name in self._marked_down:
+                continue
+            try:
+                await self._dispatchers[name](src, msg)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 -- a dispatcher crash must
+                # not kill the loop (reference logs and drops)
+                import sys
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+
+    # -- server side -------------------------------------------------------
+
+    async def _serve_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        task = asyncio.current_task()
+        self._serve_tasks.add(task)
+        try:
+            await self._serve_connection_inner(reader, writer)
+        finally:
+            self._serve_tasks.discard(task)
+            writer.close()
+
+    async def _serve_connection_inner(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        banner = await _read_frame(reader)
+        if banner is None:
+            writer.close()
+            return
+        dec = Decoder(banner)
+        if dec.string() != _BANNER or dec.varint() != _PROTOCOL_VERSION:
+            writer.close()  # protocol mismatch: refuse (reference -EXDEV)
+            return
+        peer_node = dec.string()
+        self._unreachable.discard(peer_node)
+        # the peer (re)connected: any cached outgoing connection to it may
+        # be a dead socket from its previous incarnation (writes into one
+        # are silently buffered by TCP, losing replies) -- drop it so the
+        # next send dials the live process (reference: lossy policy
+        # reconnect, Pipe.cc replaces the old session on accept)
+        stale = self._conns.pop(peer_node, None)
+        if stale is not None:
+            stale[1].close()
+        while True:
+            rec = await _read_frame(reader)
+            if rec is None:
+                break
+            dec = Decoder(rec)
+            src = dec.string()
+            dst = dec.string()
+            msg = decode_message(dec.blob())
+            queue = self._local_queues.get(dst)
+            if queue is not None and dst not in self._marked_down:
+                await queue.put((src, msg))
+        writer.close()
+
+    # -- client side -------------------------------------------------------
+
+    def _node_of(self, entity: str) -> Optional[str]:
+        """The node hosting an entity: itself if it has an address, else
+        its 'osd.N'-style name IS the node name in the default layout."""
+        return entity if entity in self.addr_map else None
+
+    async def _connect(self, node: str):
+        host, port = self.addr_map[node]
+        reader, writer = await asyncio.open_connection(host, port)
+        banner = (
+            Encoder().string(_BANNER).varint(_PROTOCOL_VERSION)
+            .string(self.node).bytes()
+        )
+        writer.write(frame(banner))
+        await writer.drain()
+        return reader, writer, asyncio.Lock()
+
+    async def send_message(self, src: str, dst: str, msg: object) -> None:
+        if src in self._marked_down or dst in self._marked_down:
+            return
+        # local short-circuit
+        queue = self._local_queues.get(dst)
+        if queue is not None:
+            if self.fault.maybe_drop():
+                return
+            await self.fault.maybe_delay()
+            await queue.put((src, msg))
+            return
+        node = self._node_of(dst)
+        if node is None:
+            return  # unknown peer: lossy
+        if self.fault.maybe_drop():
+            return
+        await self.fault.maybe_delay()
+        payload = (
+            Encoder().string(src).string(dst)
+            .blob(encode_message(msg)).bytes()
+        )
+        rec = frame(payload)
+        conn = self._conns.get(node)
+        if conn is None:
+            try:
+                conn = await self._connect(node)
+            except OSError:
+                self._unreachable.add(node)
+                return
+            self._conns[node] = conn
+            self._unreachable.discard(node)
+        _, writer, lock = conn
+        async with lock:
+            try:
+                writer.write(rec)
+                await writer.drain()
+                self._unreachable.discard(node)
+            except (ConnectionError, OSError):
+                self._conns.pop(node, None)
+                writer.close()
+                # one reconnect attempt (peer may have restarted)
+                try:
+                    conn = await self._connect(node)
+                    self._conns[node] = conn
+                    conn[1].write(rec)
+                    await conn[1].drain()
+                    self._unreachable.discard(node)
+                except OSError:
+                    self._unreachable.add(node)
+
+    async def probe(self, entity: str, timeout: float = 1.0) -> bool:
+        """Liveness probe: can we (re)connect to the entity's node?
+        Updates the unreachable set -- the heartbeat role."""
+        node = self._node_of(entity)
+        if node is None or entity in self._marked_down:
+            return False
+        # drop any cached connection: it may be a dead socket whose peer
+        # was SIGKILLed -- a probe must test the wire, not the cache
+        old = self._conns.pop(node, None)
+        if old is not None:
+            old[1].close()
+        try:
+            conn = await asyncio.wait_for(self._connect(node), timeout)
+        except (OSError, asyncio.TimeoutError):
+            self._unreachable.add(node)
+            return False
+        self._conns[node] = conn
+        self._unreachable.discard(node)
+        return True
+
+    # -- liveness view (thrasher + _shard_up hooks) ------------------------
+
+    def mark_down(self, name: str) -> None:
+        self._marked_down.add(name)
+
+    def mark_up(self, name: str) -> None:
+        self._marked_down.discard(name)
+        self._unreachable.discard(self._node_of(name) or name)
+
+    def is_down(self, name: str) -> bool:
+        if name in self._marked_down:
+            return True
+        node = self._node_of(name)
+        return node in self._unreachable if node is not None else False

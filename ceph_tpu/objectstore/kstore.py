@@ -109,17 +109,21 @@ class KStore:
             elif op.op == "setattr":
                 meta_for(op.oid)["xattrs"][op.attr_name] = op.attr_value
             elif op.op == "remove":
-                old = (
-                    metas[op.oid] if op.oid in metas
-                    else self._get_meta(op.oid)
+                # dead-stripe range must cover the ON-DISK size too: a
+                # shrink staged earlier in this txn would otherwise leave
+                # orphan stripes beyond the staged size, and their stale
+                # bytes could resurface in a later sparse write
+                staged = metas.get(op.oid)
+                disk = self._get_meta(op.oid)
+                max_size = max(
+                    (m["size"] for m in (staged, disk) if m), default=0
                 )
                 metas[op.oid] = None
                 stripes.pop(op.oid, None)
                 removed.add(op.oid)
                 batch.rmkey("M", op.oid)
-                if old is not None:
-                    for n in range(old["size"] // self.stripe_size + 1):
-                        batch.rmkey("D", self._stripe_key(op.oid, n))
+                for n in range(max_size // self.stripe_size + 1):
+                    batch.rmkey("D", self._stripe_key(op.oid, n))
             else:
                 raise ValueError(f"unknown op {op.op}")
 

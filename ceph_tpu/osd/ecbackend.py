@@ -42,6 +42,12 @@ from ceph_tpu.native.gf_native import crc32c
 from ceph_tpu.utils.perf import PerfCounters
 
 SIZE_KEY = "_size"
+#: per-shard object version xattr (the object_info_t version role): every
+#: write stamps it, reads drop shards whose version lags the newest seen,
+#: so a shard that missed updates while down can never contribute a stale
+#: chunk to a decode (the PG-log/peering consistency guarantee, reduced
+#: to a read-time check)
+VERSION_KEY = "_version"
 
 
 def shard_oid(oid: str, shard: int) -> str:
@@ -276,6 +282,7 @@ class OSDShard:
                 reply.attrs_read[oid] = {
                     ecutil.HINFO_KEY: self.store.getattr(soid, ecutil.HINFO_KEY),
                     SIZE_KEY: self.store.getattr(soid, SIZE_KEY),
+                    VERSION_KEY: self.store.getattr(soid, VERSION_KEY),
                 }
             except FileNotFoundError:
                 pass
@@ -390,6 +397,11 @@ class ECBackend:
                 self.extent_cache.invalidate(oid)
 
     async def _write_pinned(self, oid: str, data: bytes) -> None:
+        # a primary that has never touched this object must learn its
+        # current version first: overwriting with a regressed version
+        # would be silently discarded by the shards' stale-write gate
+        if oid not in self._versions:
+            await self._stat(oid)
         # pg-wide dense version (the eversion analogue): shards log every
         # write in order so divergence is detectable and rollbackable
         version = max(self._versions.values(), default=0) + 1
@@ -437,6 +449,7 @@ class ECBackend:
                 .truncate(soid, len(encoded[s]))
                 .setattr(soid, ecutil.HINFO_KEY, hinfo.to_dict())
                 .setattr(soid, SIZE_KEY, logical)
+                .setattr(soid, VERSION_KEY, version)
             )
             sub = ECSubWrite(
                 from_shard=s,
@@ -452,10 +465,40 @@ class ECBackend:
                     self.name, f"osd.{acting[s]}", sub
                 )
         self.perf.inc("write")
-        await asyncio.wait_for(done, timeout=30)
-        span.event("all_commit")
-        span.finish()
-        del self._pending[tid]
+        try:
+            await self._await_commits(oid, tid, done, min_acks=self.k)
+            span.event("all_commit")
+        finally:
+            span.finish()
+
+    async def _await_commits(
+        self, oid: str, tid: int, done: "asyncio.Future", min_acks: int
+    ) -> None:
+        """Wait for the fan-out's commit acks, pruning shards discovered
+        dead during the send (e.g. a TCP connect refused) so the op
+        completes on the surviving set.  Skipped shards hold stale bytes
+        until recovered -- the VERSION_KEY read-time cut keeps them out of
+        decodes.  If fewer than ``min_acks`` shard targets survive, the op
+        fails.  A write that already fully committed (done resolved) is
+        never failed by late deaths.  Shared by every fan-out path (full
+        write, RMW write, recovery push)."""
+        state = self._pending[tid]
+        try:
+            if not done.done():
+                state["expected"] = {
+                    n for n in state["expected"]
+                    if not self.messenger.is_down(n)
+                }
+                if len(state["expected"]) < min_acks:
+                    raise IOError(
+                        f"write {oid} lost shards mid-flight: "
+                        f"only {len(state['expected'])} up"
+                    )
+                if state["committed"] >= state["expected"]:
+                    done.set_result(True)
+            await asyncio.wait_for(done, timeout=30)
+        finally:
+            del self._pending[tid]
 
     # -- read path ---------------------------------------------------------
 
@@ -494,6 +537,94 @@ class ECBackend:
         state = self._pending.pop(tid)
         return state["replies"]
 
+    @staticmethod
+    def _collect_read(replies, oid, chunks, versions, sizes, failed,
+                      hinfos=None) -> None:
+        """Merge one _read_shards round into per-shard chunk/version/size
+        maps (absent VERSION_KEY decodes as 0: pre-versioning objects)."""
+        for s, reply in replies.items():
+            if oid in reply.errors:
+                failed.append(s)
+                continue
+            bufs = reply.buffers_read.get(oid)
+            if bufs:
+                chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
+            attrs = reply.attrs_read.get(oid) or {}
+            if attrs.get(SIZE_KEY) is not None:
+                sizes[s] = attrs[SIZE_KEY]
+            if hinfos is not None and attrs.get(ecutil.HINFO_KEY) is not None:
+                hinfos[s] = attrs[ecutil.HINFO_KEY]
+            versions[s] = attrs.get(VERSION_KEY) or 0
+
+    def _consistent_cut(self, chunks, versions, sizes):
+        """Keep only shards of one consistent version: the newest version
+        still held by >= k shards (a shard that was down during writes
+        holds stale bytes that must not enter a decode -- the peering /
+        PG-log missing-set role).  Falling back past a version with < k
+        shards is the log-rollback semantic: such a write died mid-flight
+        and was never acked to the client."""
+        counts: Dict[int, int] = {}
+        for s in chunks:
+            v = versions.get(s, 0)
+            counts[v] = counts.get(v, 0) + 1
+        if not counts:
+            return None
+        complete = [v for v, c in counts.items() if c >= self.k]
+        target = max(complete) if complete else max(counts)
+        stale = [s for s in chunks if versions.get(s, 0) != target]
+        for s in stale:
+            del chunks[s]
+        if stale:
+            self.perf.inc("stale_shards_dropped")
+        size = None
+        for s in chunks:
+            if sizes.get(s) is not None:
+                size = sizes[s]
+                break
+        return size
+
+    async def _gather_consistent(
+        self, oid, shards, acting, extents=None, op_class="client",
+        up_shards=None,
+    ):
+        """One read round over ``shards`` + an escalation round to every
+        remaining up shard when results are short or version-skewed,
+        ending in the consistent cut.  Shared by read / read_range /
+        recovery so the staleness rules cannot diverge between them.
+        Returns (chunks, sizes_hint, hinfo_hint)."""
+        if up_shards is None:
+            up_shards = [
+                s for s in range(self.km) if self._shard_up(acting, s)
+            ]
+        chunks: Dict[int, np.ndarray] = {}
+        versions: Dict[int, int] = {}
+        sizes: Dict[int, int] = {}
+        hinfos: Dict[int, dict] = {}
+        failed: List[int] = []
+        replies = await self._read_shards(
+            oid, shards, acting, extents=extents, op_class=op_class
+        )
+        self._collect_read(replies, oid, chunks, versions, sizes, failed,
+                           hinfos)
+        vmax = max((versions.get(s, 0) for s in chunks), default=0)
+        missing = [s for s in shards if s not in chunks]
+        skew = any(versions.get(s, 0) != vmax for s in chunks)
+        if missing or skew or len(chunks) < self.k:
+            self.perf.inc("degraded_read")
+            rest = [
+                s for s in up_shards if s not in chunks and s not in failed
+            ]
+            if rest:
+                more = await self._read_shards(
+                    oid, rest, acting, extents=extents, op_class=op_class
+                )
+                self._collect_read(more, oid, chunks, versions, sizes,
+                                   failed, hinfos)
+        size = self._consistent_cut(chunks, versions, sizes)
+        hinfo = next((hinfos[s] for s in chunks if s in hinfos), None)
+        vcut = max((versions.get(s, 0) for s in chunks), default=0)
+        return chunks, size, hinfo, vcut
+
     async def read(self, oid: str) -> bytes:
         """objects_read_and_reconstruct: minimum shards, degraded fallback."""
         acting = self.acting_set(oid)
@@ -504,36 +635,9 @@ class ECBackend:
         ]
         want = ecutil.data_positions(self.ec)
         minimum = self.ec.minimum_to_decode(want, up_shards)
-        replies = await self._read_shards(oid, sorted(minimum.keys()), acting)
-
-        chunks: Dict[int, np.ndarray] = {}
-        logical_size: Optional[int] = None
-        failed: List[int] = []
-        for s, reply in replies.items():
-            if oid in reply.errors:
-                failed.append(s)
-                continue
-            bufs = reply.buffers_read.get(oid)
-            if bufs:
-                chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
-            attrs = reply.attrs_read.get(oid) or {}
-            if attrs.get(SIZE_KEY) is not None:
-                logical_size = attrs[SIZE_KEY]
-        missing = [s for s in sorted(minimum.keys()) if s not in chunks]
-        if missing:
-            # shards errored or timed out: escalate to the remaining shards
-            self.perf.inc("degraded_read")
-            rest = [s for s in up_shards if s not in chunks and s not in failed]
-            more = await self._read_shards(oid, rest, acting)
-            for s, reply in more.items():
-                if oid in reply.errors:
-                    continue
-                bufs = reply.buffers_read.get(oid)
-                if bufs:
-                    chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
-                attrs = reply.attrs_read.get(oid) or {}
-                if attrs.get(SIZE_KEY) is not None:
-                    logical_size = attrs[SIZE_KEY]
+        chunks, logical_size, _, _ = await self._gather_consistent(
+            oid, sorted(minimum.keys()), acting, up_shards=up_shards
+        )
         if len(chunks) < self.k:
             raise IOError(f"cannot read {oid}: only {len(chunks)} shards")
         if logical_size is None:
@@ -545,31 +649,35 @@ class ECBackend:
     # -- partial I/O (ECTransaction write plan + sub-chunk range reads) ----
 
     async def _stat(self, oid: str) -> Tuple[int, Optional[dict]]:
-        """(logical size, hinfo dict) from shard attrs; size 0 if absent."""
+        """(logical size, hinfo dict) from shard attrs; size 0 if absent.
+
+        Queries every up shard's attrs in one parallel round and answers
+        from the highest-versioned reply: a shard that was down during
+        writes may hold stale size/hinfo, and planning an RMW from stale
+        metadata would corrupt the object.  Also teaches this primary the
+        object's current version (``self._versions``) so a fresh client
+        process continues the version sequence instead of restarting it
+        (which the shards' stale-write gate would silently discard)."""
         acting = self.acting_set(oid)
         up = [
             s
             for s in range(self.km)
             if self._shard_up(acting, s)
         ]
-        replies = await self._read_shards(oid, up[:1], acting, extents=[(0, 0)])
+        replies = await self._read_shards(oid, up, acting, extents=[(0, 0)])
+        best = None  # (version, size, hinfo)
         for r in replies.values():
             attrs = r.attrs_read.get(oid) or {}
-            if attrs.get(SIZE_KEY) is not None:
-                return attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY)
-        # first shard had no attrs (e.g. freshly remapped, shard not yet
-        # recovered): fall back to the remaining up shards before concluding
-        # the object does not exist — reporting size 0 for an existing
-        # object would misclassify overwrites as appends downstream.
-        if len(up) > 1:
-            replies = await self._read_shards(
-                oid, up[1:], acting, extents=[(0, 0)]
-            )
-            for r in replies.values():
-                attrs = r.attrs_read.get(oid) or {}
-                if attrs.get(SIZE_KEY) is not None:
-                    return attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY)
-        return 0, None
+            if attrs.get(SIZE_KEY) is None:
+                continue
+            ver = attrs.get(VERSION_KEY) or 0
+            if best is None or ver > best[0]:
+                best = (ver, attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY))
+        if best is None:
+            return 0, None
+        if best[0] > self._versions.get(oid, 0):
+            self._versions[oid] = best[0]
+        return best[1], best[2]
 
     async def read_range(self, oid: str, offset: int, length: int) -> bytes:
         """Read only the stripes covering [offset, offset+length)
@@ -595,29 +703,10 @@ class ECBackend:
         ]
         want = ecutil.data_positions(self.ec)
         minimum = self.ec.minimum_to_decode(want, up)
-        replies = await self._read_shards(
+        chunks, _, _, _ = await self._gather_consistent(
             oid, sorted(minimum.keys()), acting,
-            extents=[(chunk_off, chunk_len)],
+            extents=[(chunk_off, chunk_len)], up_shards=up,
         )
-        chunks: Dict[int, np.ndarray] = {}
-        for s, reply in replies.items():
-            if oid in reply.errors:
-                continue
-            bufs = reply.buffers_read.get(oid)
-            if bufs:
-                chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
-        if len(chunks) < self.k:
-            # degraded: pull the remaining shards' extents
-            rest = [s for s in up if s not in chunks]
-            more = await self._read_shards(
-                oid, rest, acting, extents=[(chunk_off, chunk_len)]
-            )
-            for s, reply in more.items():
-                if oid in reply.errors:
-                    continue
-                bufs = reply.buffers_read.get(oid)
-                if bufs:
-                    chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
         if len(chunks) < self.k:
             raise IOError(f"cannot range-read {oid}")
         data = ecutil.decode_concat(self.sinfo, self.ec, chunks)
@@ -713,6 +802,7 @@ class ECBackend:
                 .write(soid, chunk_off, encoded[s].tobytes())
                 .setattr(soid, ecutil.HINFO_KEY, hinfo.to_dict())
                 .setattr(soid, SIZE_KEY, plan.new_size)
+                .setattr(soid, VERSION_KEY, version)
             )
             sub = ECSubWrite(
                 from_shard=s, tid=tid, oid=oid, transaction=txn,
@@ -722,8 +812,7 @@ class ECBackend:
                 self.name, f"osd.{acting[s]}", sub
             )
         self.perf.inc("write_range")
-        await asyncio.wait_for(done, timeout=30)
-        del self._pending[tid]
+        await self._await_commits(oid, tid, done, min_acks=self.k)
         # publish committed bytes for read-through (padding included: those
         # bytes are logically zero up to new_size and real data below it)
         pin.commit(start, buf.tobytes())
@@ -790,28 +879,24 @@ class ECBackend:
             and self._shard_up(acting, s)
         ]
         minimum = self.ec.minimum_to_decode([shard], up_shards)
-        replies = await self._read_shards(
-            oid, sorted(minimum.keys()), acting, op_class="recovery"
+        chunks, logical_size, hinfo_d, vmax = await self._gather_consistent(
+            oid, sorted(minimum.keys()), acting, op_class="recovery",
+            up_shards=up_shards,
         )
-        chunks = {
-            s: np.frombuffer(r.buffers_read[oid][0][1], dtype=np.uint8)
-            for s, r in replies.items()
-            if oid in r.buffers_read
-        }
-        logical_size = None
-        hinfo_d = None
-        for r in replies.values():
-            attrs = r.attrs_read.get(oid) or {}
-            if attrs.get(SIZE_KEY) is not None:
-                logical_size = attrs[SIZE_KEY]
-                hinfo_d = attrs.get(ecutil.HINFO_KEY)
+        if len(chunks) < self.k:
+            raise IOError(f"cannot recover {oid}@{shard}: too few sources")
         rec = ecutil.decode_shards(self.ec, chunks, [shard])
         soid = shard_oid(oid, shard)
         txn = (
             Transaction()
             .write(soid, 0, rec[shard].tobytes())
+            # the target may hold a LONGER stale chunk (it missed a
+            # shrinking overwrite while down): writing without truncating
+            # would leave stale tail bytes under the new version stamp
+            .truncate(soid, len(rec[shard]))
             .setattr(soid, ecutil.HINFO_KEY, hinfo_d)
             .setattr(soid, SIZE_KEY, logical_size)
+            .setattr(soid, VERSION_KEY, vmax)
         )
         self._tid += 1
         tid = self._tid
@@ -826,10 +911,14 @@ class ECBackend:
             tid=tid,
             oid=oid,
             transaction=txn,
-            at_version=self._versions.get(oid, 1),
+            # the consistent sources' version, NOT this primary's possibly
+            # cold _versions map: a lower number would be silently no-op'd
+            # by the target's stale-write gate while acking success
+            at_version=vmax,
             op_class="recovery",
         )
         await self.messenger.send_message(self.name, f"osd.{target_osd}", sub)
-        await asyncio.wait_for(done, timeout=30)
-        del self._pending[tid]
+        # min_acks=1: the push has exactly one target; if it died, fail
+        # loudly instead of reporting a recovery that never happened
+        await self._await_commits(oid, tid, done, min_acks=1)
         self.perf.inc("recover")

@@ -8,7 +8,8 @@ the TPU framework persists: journal records, KV log records and object
 metadata.
 
 Value model (self-describing, tagged):
-  None, bool, int (u64/zigzag-s64), bytes, str, list, dict[str, value].
+  None, bool, int (u64/zigzag-s64), bytes, str, list, tuple,
+  dict[str, value].  Lists and tuples round-trip as distinct types.
 
 Framed records (``frame``/``unframe``) carry ``MAGIC | len | crc32c |
 payload`` so torn tail writes after a crash are detected and discarded --
@@ -30,7 +31,7 @@ _MAGIC = 0xCE9B10C5
 
 # value tags
 _T_NONE, _T_FALSE, _T_TRUE, _T_INT, _T_NEGINT, _T_BYTES, _T_STR, _T_LIST, \
-    _T_DICT = range(9)
+    _T_DICT, _T_TUPLE = range(10)
 
 
 class Encoder:
@@ -73,7 +74,8 @@ class Encoder:
         return self.blob(s.encode("utf-8"))
 
     def value(self, v: Any) -> "Encoder":
-        """Tagged self-describing value (None/bool/int/bytes/str/list/dict)."""
+        """Tagged self-describing value
+        (None/bool/int/bytes/str/list/tuple/dict)."""
         if v is None:
             self.u8(_T_NONE)
         elif v is True:
@@ -91,7 +93,11 @@ class Encoder:
             self.u8(_T_BYTES).blob(bytes(v))
         elif isinstance(v, str):
             self.u8(_T_STR).string(v)
-        elif isinstance(v, (list, tuple)):
+        elif isinstance(v, tuple):
+            self.u8(_T_TUPLE).varint(len(v))
+            for item in v:
+                self.value(item)
+        elif isinstance(v, list):
             self.u8(_T_LIST).varint(len(v))
             for item in v:
                 self.value(item)
@@ -170,6 +176,8 @@ class Decoder:
             return self.string()
         if tag == _T_LIST:
             return [self.value() for _ in range(self.varint())]
+        if tag == _T_TUPLE:
+            return tuple(self.value() for _ in range(self.varint()))
         if tag == _T_DICT:
             return {self.string(): self.value() for _ in range(self.varint())}
         raise ValueError(f"bad value tag {tag}")

@@ -258,3 +258,18 @@ def test_objectstore_tool_roundtrip(tmp_path, capsys):
     assert d.getattr("alpha", "_size") == 3
     assert d.read("beta") == b"BBBB"
     d.umount()
+
+
+def test_kstore_truncate_then_remove_leaves_no_orphan_stripes(tmp_path):
+    """A shrink staged in the same txn as a remove must not orphan the
+    stripes beyond the shrunken size (their stale bytes could resurface
+    in a later sparse write)."""
+    s = os_mod.create("kstore", str(tmp_path / "store"))
+    s.queue_transaction(Transaction().write("o", 0, b"A" * 200_000))
+    s.queue_transaction(Transaction().truncate("o", 0).remove("o"))
+    assert not s.exists("o")
+    assert list(s.db.get_iterator("D")) == []  # no orphan data stripes
+    # recreate sparse: the gap must read back as zeros, not stale bytes
+    s.queue_transaction(Transaction().write("o", 100_000, b"x"))
+    assert s.read("o", 65_000, 1_000) == b"\0" * 1_000
+    s.umount()

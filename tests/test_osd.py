@@ -335,3 +335,128 @@ def test_write_range_from_scratch():
         await cluster.shutdown()
 
     run(main())
+
+
+def test_stale_shard_after_revive_is_filtered():
+    """A shard that missed writes while its OSD was down must not
+    contribute stale bytes to a decode after the OSD comes back
+    (VERSION_KEY consistent-cut; the peering/pg-log role)."""
+
+    async def run():
+        from ceph_tpu.osd.cluster import ECCluster
+
+        c = ECCluster(6, {"k": "2", "m": "1"})
+        old = b"version-one" * 300
+        new = b"VERSION-TWO!" * 250
+        await c.write("obj", old)
+        acting = c.backend.acting_set("obj")
+        victim = acting[0]
+        c.kill_osd(victim)
+        await c.write("obj", new)  # degraded overwrite: victim misses it
+        c.revive_osd(victim)  # back up, still holding the v1 shard
+        got = await c.read("obj")
+        assert got == new, "stale shard leaked into the decode"
+        # recovery then repairs the lagging shard and reads still agree
+        await c.backend.recover_shard("obj", 0, victim)
+        assert await c.read("obj") == new
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_new_primary_learns_object_version():
+    """A fresh primary (client restart) must continue an object's version
+    sequence -- a regressed version would be discarded by the shards'
+    stale-write gate and silently lose the write."""
+
+    async def run():
+        from ceph_tpu.osd.cluster import ECCluster
+        from ceph_tpu.osd.ecbackend import ECBackend
+        from ceph_tpu.osd.placement import CrushPlacement
+
+        c = ECCluster(6, {"k": "2", "m": "1"})
+        for i in range(5):  # drive the version counter up
+            await c.write("obj", f"gen-{i}".encode() * 100)
+        # second primary over the same OSDs: fresh (empty) version map
+        placement = CrushPlacement(6, c.ec.get_chunk_count())
+        b2 = ECBackend(c.ec, c.osds, c.messenger, name="client2",
+                       placement=placement)
+        await b2.write("obj", b"from-new-primary" * 100)
+        assert await c.read("obj") == b"from-new-primary" * 100
+        assert await b2.read("obj") == b"from-new-primary" * 100
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_failed_partial_write_falls_back_to_complete_version():
+    """If a write died after reaching < k shards, reads must fall back to
+    the newest version with >= k shards (log-rollback semantics), not
+    refuse service."""
+
+    async def run():
+        from ceph_tpu.osd.cluster import ECCluster
+        from ceph_tpu.osd.ecbackend import shard_oid, VERSION_KEY
+        from ceph_tpu.osd.types import ECSubWrite, Transaction
+
+        c = ECCluster(6, {"k": "2", "m": "1"})
+        committed = b"fully-committed" * 200
+        await c.write("obj", committed)
+        acting = c.backend.acting_set("obj")
+        # forge a partial v+1 write: only shard 0's OSD applies it
+        v_next = c.backend._versions["obj"] + 1
+        osd = c.osds[acting[0]]
+        soid = shard_oid("obj", 0)
+        torn = ECSubWrite(
+            from_shard=0, tid=77777, oid="obj",
+            transaction=(
+                Transaction().write(soid, 0, b"T" * 100)
+                .truncate(soid, 100)
+                .setattr(soid, VERSION_KEY, v_next)
+            ),
+            at_version=v_next,
+        )
+        await osd.handle_sub_write("osd.client", torn)
+        # v+1 exists on only 1 shard (< k): read must serve the complete v
+        assert await c.read("obj") == committed
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_cold_primary_recovery_applies_on_target():
+    """recover_shard from a primary with an empty version map must still
+    take effect on a target whose applied-version is high (the push
+    carries the sources' version, not the primary's counter)."""
+
+    async def run():
+        from ceph_tpu.osd.cluster import ECCluster
+        from ceph_tpu.osd.ecbackend import ECBackend, shard_oid
+        from ceph_tpu.osd.placement import CrushPlacement
+
+        c = ECCluster(6, {"k": "2", "m": "1"})
+        for i in range(3):
+            await c.write("obj", f"generation-{i}".encode() * 150)
+        latest = b"generation-2" * 150
+        acting = c.backend.acting_set("obj")
+        victim = acting[0]
+        c.kill_osd(victim)
+        final = b"after-victim-died" * 120
+        await c.write("obj", final)
+        c.revive_osd(victim)
+        # recovery driven by a COLD primary (fresh process, empty versions)
+        placement = CrushPlacement(6, c.ec.get_chunk_count())
+        b2 = ECBackend(c.ec, c.osds, c.messenger, name="client2",
+                       placement=placement)
+        await b2.recover_shard("obj", 0, victim)
+        # the victim's shard must now hold the recovered current chunk
+        store = c.osds[victim].store
+        fresh = c.osds[acting[1]].store
+        assert (
+            store.getattr(shard_oid("obj", 0), "_version")
+            == fresh.getattr(shard_oid("obj", 1), "_version")
+        )
+        assert await b2.read("obj") == final
+        await c.shutdown()
+
+    asyncio.run(run())

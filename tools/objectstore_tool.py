@@ -25,15 +25,21 @@ from ceph_tpu.utils.encoding import (  # noqa: E402
 )
 
 
+#: the EC path's shard xattrs (there is no attr-enumeration API on the
+#: store surface, so the dump lists them explicitly; VERSION_KEY matters:
+#: without it imported shards decode as version 0 and the read-time
+#: consistent cut would discard them as stale)
+_KNOWN_ATTRS = ("hinfo_key", "_size", "_version")
+
+
 def export(store, oids, path):
     with open(path, "wb") as f:
         for oid in oids:
             enc = Encoder()
             enc.string(oid)
             enc.blob(store.read(oid))
-            # dump every attr we can see via the generic surface
             attrs = {}
-            for name in ("hinfo_key", "_size"):
+            for name in _KNOWN_ATTRS:
                 v = store.getattr(oid, name)
                 if v is not None:
                     attrs[name] = v
@@ -98,7 +104,7 @@ def main(argv=None):
                 ap.error("--op info needs --oid")
             print(f"oid: {args.oid}")
             print(f"size: {store.stat(args.oid)}")
-            for name in ("hinfo_key", "_size"):
+            for name in _KNOWN_ATTRS:
                 v = store.getattr(args.oid, name)
                 if v is not None:
                     print(f"attr {name}: {v}")

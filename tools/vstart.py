@@ -1,0 +1,248 @@
+#!/usr/bin/env python3
+"""Developer mini-cluster over real processes (vstart.sh analogue).
+
+Reference: src/vstart.sh boots mon/mgr/osd daemons on loopback ports for
+development; qa/standalone/ceph-helpers.sh (run_osd/kill_daemons) drives
+the same layout from tests.  Here:
+
+  vstart.py start --dir RUN --osds 6 --k 4 --m 2 [--objectstore filestore]
+  vstart.py status --dir RUN
+  vstart.py put --dir RUN OID FILE     # client I/O over TCP
+  vstart.py get --dir RUN OID [FILE]
+  vstart.py kill-osd --dir RUN N       # SIGKILL, thrasher-style
+  vstart.py stop --dir RUN
+
+``RUN/addr_map.json`` is the cluster address book; ``RUN/cluster.json``
+records the EC profile; pids live in ``RUN/pids``.
+"""
+
+import argparse
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _free_ports(n):
+    socks, ports = [], []
+    for _ in range(n):
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        socks.append(s)
+        ports.append(s.getsockname()[1])
+    for s in socks:
+        s.close()
+    return ports
+
+
+def _daemon_env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.setdefault("JAX_PLATFORMS", "cpu")  # daemons never use the device
+    return env
+
+
+def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
+                  op_queue="wpq", wait=10.0):
+    """Boot n_osds daemon processes; returns the addr map path.
+    Library entry point used by the CLI and the standalone tests."""
+    os.makedirs(run_dir, exist_ok=True)
+    ports = _free_ports(n_osds + 1)
+    addr_map = {f"osd.{i}": ("127.0.0.1", ports[i]) for i in range(n_osds)}
+    addr_map["client"] = ("127.0.0.1", ports[n_osds])
+    map_path = os.path.join(run_dir, "addr_map.json")
+    with open(map_path, "w") as f:
+        json.dump(addr_map, f)
+    with open(os.path.join(run_dir, "cluster.json"), "w") as f:
+        json.dump({"profile": profile, "n_osds": n_osds,
+                   "objectstore": objectstore}, f)
+    data_path = os.path.join(run_dir, "data")
+    pids = {}
+    for i in range(n_osds):
+        pids[i] = spawn_osd(run_dir, i, objectstore=objectstore,
+                            op_queue=op_queue, data_path=data_path)
+    _save_pids(run_dir, pids)
+    # readiness: every daemon's port accepts connections
+    deadline = time.time() + wait
+    for i in range(n_osds):
+        host, port = addr_map[f"osd.{i}"]
+        while True:
+            try:
+                socket.create_connection((host, port), timeout=0.25).close()
+                break
+            except OSError:
+                if time.time() > deadline:
+                    raise TimeoutError(f"osd.{i} did not come up")
+                time.sleep(0.05)
+    return map_path
+
+
+def spawn_osd(run_dir, osd_id, objectstore="memstore", op_queue="wpq",
+              data_path=None):
+    """Start (or restart) one OSD daemon process; returns its pid."""
+    data_path = data_path or os.path.join(run_dir, "data")
+    log = open(os.path.join(run_dir, f"osd.{osd_id}.log"), "ab")
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "ceph_tpu.daemon.osd",
+         "--id", str(osd_id),
+         "--addr-map", os.path.join(run_dir, "addr_map.json"),
+         "--objectstore", objectstore,
+         "--data-path", data_path,
+         "--op-queue", op_queue],
+        stdout=log, stderr=log, env=_daemon_env(), cwd=REPO,
+    )
+    return proc.pid
+
+
+def _save_pids(run_dir, pids):
+    with open(os.path.join(run_dir, "pids"), "w") as f:
+        json.dump({str(k): v for k, v in pids.items()}, f)
+
+
+def _load_pids(run_dir):
+    try:
+        with open(os.path.join(run_dir, "pids")) as f:
+            return {int(k): v for k, v in json.load(f).items()}
+    except FileNotFoundError:
+        return {}
+
+
+def kill_osd(run_dir, osd_id, sig=signal.SIGKILL):
+    pids = _load_pids(run_dir)
+    pid = pids.get(osd_id)
+    if pid is None:
+        return False
+    try:
+        os.kill(pid, sig)
+        os.waitpid(pid, 0)
+    except (ProcessLookupError, ChildProcessError):
+        pass
+    del pids[osd_id]
+    _save_pids(run_dir, pids)
+    return True
+
+
+def revive_osd(run_dir, osd_id):
+    with open(os.path.join(run_dir, "cluster.json")) as f:
+        conf = json.load(f)
+    pids = _load_pids(run_dir)
+    pids[osd_id] = spawn_osd(run_dir, osd_id,
+                             objectstore=conf["objectstore"])
+    _save_pids(run_dir, pids)
+    # wait for the port
+    with open(os.path.join(run_dir, "addr_map.json")) as f:
+        host, port = json.load(f)[f"osd.{osd_id}"]
+    deadline = time.time() + 10
+    while True:
+        try:
+            socket.create_connection((host, port), timeout=0.25).close()
+            return
+        except OSError:
+            if time.time() > deadline:
+                raise TimeoutError(f"osd.{osd_id} did not revive")
+            time.sleep(0.05)
+
+
+def stop_cluster(run_dir):
+    pids = _load_pids(run_dir)
+    for pid in pids.values():
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except ProcessLookupError:
+            pass
+    for pid in pids.values():
+        try:
+            os.waitpid(pid, 0)
+        except (ChildProcessError, ProcessLookupError):
+            pass
+    _save_pids(run_dir, {})
+
+
+async def _client(run_dir):
+    from ceph_tpu.daemon.client import RemoteClient
+
+    with open(os.path.join(run_dir, "cluster.json")) as f:
+        conf = json.load(f)
+    c = await RemoteClient.connect(
+        os.path.join(run_dir, "addr_map.json"), conf["profile"]
+    )
+    await c.probe_osds()
+    return c
+
+
+def main(argv=None):
+    import asyncio
+
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("cmd", choices=["start", "stop", "status", "put", "get",
+                                    "kill-osd", "revive-osd"])
+    ap.add_argument("args", nargs="*")
+    ap.add_argument("--dir", default="./vstart-run")
+    ap.add_argument("--osds", type=int, default=6)
+    ap.add_argument("--k", type=int, default=4)
+    ap.add_argument("--m", type=int, default=2)
+    ap.add_argument("--plugin", default="jerasure")
+    ap.add_argument("--objectstore", default="memstore")
+    args = ap.parse_args(argv)
+
+    if args.cmd == "start":
+        profile = {"plugin": args.plugin, "k": str(args.k), "m": str(args.m)}
+        start_cluster(args.dir, args.osds, profile,
+                      objectstore=args.objectstore)
+        print(f"cluster up: {args.osds} osds, profile {profile}")
+    elif args.cmd == "stop":
+        stop_cluster(args.dir)
+        print("stopped")
+    elif args.cmd == "status":
+        pids = _load_pids(args.dir)
+        for osd_id, pid in sorted(pids.items()):
+            try:
+                os.kill(pid, 0)
+                state = "up"
+            except ProcessLookupError:
+                state = "down"
+            print(f"osd.{osd_id}: pid {pid} {state}")
+    elif args.cmd == "kill-osd":
+        kill_osd(args.dir, int(args.args[0]))
+        print(f"killed osd.{args.args[0]}")
+    elif args.cmd == "revive-osd":
+        revive_osd(args.dir, int(args.args[0]))
+        print(f"revived osd.{args.args[0]}")
+    elif args.cmd == "put":
+        oid, path = args.args
+        with open(path, "rb") as f:
+            data = f.read()
+
+        async def put():
+            c = await _client(args.dir)
+            await c.write(oid, data)
+            await c.close()
+
+        asyncio.run(put())
+        print(f"wrote {oid} ({len(data)} bytes)")
+    elif args.cmd == "get":
+        oid = args.args[0]
+
+        async def get():
+            c = await _client(args.dir)
+            data = await c.read(oid)
+            await c.close()
+            return data
+
+        data = asyncio.run(get())
+        if len(args.args) > 1:
+            with open(args.args[1], "wb") as f:
+                f.write(data)
+        else:
+            sys.stdout.buffer.write(data)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
