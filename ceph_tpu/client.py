@@ -82,38 +82,7 @@ class IoCtx:
         return self._rados._run(self._cluster.read(oid))
 
     def remove(self, oid: str) -> None:
-        async def _rm():
-            backend = self._cluster.backend
-            acting = backend.acting_set(oid)
-            from ceph_tpu.osd.types import ECSubWrite, Transaction
-
-            # only shards with a mapped, live OSD can ack (CRUSH holes are
-            # None; down OSDs never reply — waiting on either stalls)
-            up = [s for s in range(backend.km) if backend._shard_up(acting, s)]
-            backend._tid += 1
-            tid = backend._tid
-            done = asyncio.get_event_loop().create_future()
-            backend._pending[tid] = {
-                "committed": set(),
-                "expected": {f"osd.{acting[s]}" for s in up},
-                "done": done,
-            }
-            version = max(backend._versions.values(), default=0) + 1
-            backend._versions[oid] = version
-            for s in up:
-                txn = Transaction().remove(shard_oid(oid, s))
-                await backend.messenger.send_message(
-                    backend.name,
-                    f"osd.{acting[s]}",
-                    ECSubWrite(
-                        from_shard=s, tid=tid, oid=oid,
-                        transaction=txn, at_version=version,
-                    ),
-                )
-            await asyncio.wait_for(done, timeout=30)
-            del backend._pending[tid]
-
-        self._rados._run(_rm())
+        self._rados._run(self._cluster.backend.remove_object(oid))
 
     def stat(self, oid: str) -> int:
         """Logical object size (from the first reachable shard's xattr)."""
@@ -141,6 +110,51 @@ class IoCtx:
 
     def scrub(self, oid: str) -> dict:
         return self._rados._run(self._cluster.deep_scrub(oid))
+
+    # -- omap / cls exec / watch-notify (librados metadata surface) --------
+
+    def omap_set(self, oid: str, kvs: Dict[str, bytes]) -> None:
+        self._rados._run(self._cluster.backend.omap_set(oid, kvs))
+
+    def omap_get(self, oid: str, keys: Optional[List[str]] = None
+                 ) -> Dict[str, bytes]:
+        return self._rados._run(self._cluster.backend.omap_get(oid, keys))
+
+    def omap_rm(self, oid: str, keys: List[str]) -> None:
+        self._rados._run(self._cluster.backend.omap_rm(oid, keys))
+
+    def exec(self, oid: str, cls: str, method: str, inp: bytes = b""):
+        """Invoke a server-side object-class method (librados exec)."""
+        return self._rados._run(
+            self._cluster.backend.exec(oid, cls, method, inp)
+        )
+
+    def watch(self, oid: str, callback) -> None:
+        self._rados._run(self._cluster.backend.watch(oid, callback))
+
+    def unwatch(self, oid: str) -> None:
+        self._rados._run(self._cluster.backend.unwatch(oid))
+
+    def notify(self, oid: str, payload=None, timeout: float = 5.0) -> dict:
+        return self._rados._run(
+            self._cluster.backend.notify(oid, payload, timeout)
+        )
+
+    def lock_exclusive(self, oid: str, name: str, cookie: str) -> int:
+        from ceph_tpu.utils.encoding import Encoder
+
+        ret, _ = self.exec(oid, "lock", "lock", Encoder().value(
+            {"name": name, "locker": cookie, "type": "exclusive"}
+        ).bytes())
+        return ret
+
+    def unlock(self, oid: str, name: str, cookie: str) -> int:
+        from ceph_tpu.utils.encoding import Encoder
+
+        ret, _ = self.exec(oid, "lock", "unlock", Encoder().value(
+            {"name": name, "locker": cookie}
+        ).bytes())
+        return ret
 
     # -- async surface -----------------------------------------------------
 

@@ -1,0 +1,120 @@
+"""RBD image-header class (reference: src/cls/rbd/cls_rbd.cc).
+
+The librbd layer keeps each image's metadata in the omap of a header
+object (``rbd_header.<id>``): size, order (object-size shift), snapshot
+table, and settable key/value metadata.  These methods manage that state;
+the data path (striping image extents over data objects) lives in
+``ceph_tpu.rbd``.
+"""
+
+from __future__ import annotations
+
+from ceph_tpu.cls import register
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+def _enc(v) -> bytes:
+    return Encoder().value(v).bytes()
+
+
+def _dec(b):
+    return Decoder(b).value() if b else None
+
+
+@register("rbd", "create")
+async def create(ctx, inp: bytes):
+    req = _dec(inp)
+    if (await ctx.omap_get(["size"])).get("size") is not None:
+        return -17, b""  # -EEXIST
+    await ctx.omap_set({
+        "size": _enc(int(req["size"])),
+        "order": _enc(int(req.get("order", 22))),  # 4 MiB objects
+        # seq lives INSIDE the snaps blob: snapshot id allocation and the
+        # table update are one CAS, so racing snap_adds cannot reuse ids
+        "snaps": _enc({"seq": 0, "by_name": {}}),
+    })
+    return 0, b""
+
+
+@register("rbd", "get_metadata")
+async def get_metadata(ctx, inp: bytes):
+    omap = await ctx.omap_get(["size", "order", "snaps"])
+    if "size" not in omap:
+        return -2, b""
+    snaps = _dec(omap.get("snaps")) or {"seq": 0, "by_name": {}}
+    return 0, _enc({
+        "size": _dec(omap["size"]),
+        "order": _dec(omap["order"]),
+        "snap_seq": snaps["seq"],
+        "snaps": snaps["by_name"],
+    })
+
+
+@register("rbd", "set_size")
+async def set_size(ctx, inp: bytes):
+    req = _dec(inp)
+    if (await ctx.omap_get(["size"])).get("size") is None:
+        return -2, b""
+    await ctx.omap_set({"size": _enc(int(req["size"]))})
+    return 0, b""
+
+
+@register("rbd", "snap_add")
+async def snap_add(ctx, inp: bytes):
+    req = _dec(inp)
+    name = req["name"]
+    for _ in range(16):
+        omap = await ctx.omap_get(["snaps", "size"])
+        if "size" not in omap:
+            return -2, b""
+        cur_raw = omap.get("snaps")
+        snaps = _dec(cur_raw) or {"seq": 0, "by_name": {}}
+        if name in snaps["by_name"]:
+            return -17, b""
+        seq = snaps["seq"] + 1
+        new = {
+            "seq": seq,
+            "by_name": dict(
+                snaps["by_name"],
+                **{name: {"id": seq, "size": _dec(omap["size"])}},
+            ),
+        }
+        ok, _ = await ctx.omap_cas("snaps", cur_raw, _enc(new))
+        if ok:
+            return 0, _enc(seq)
+    return -11, b""
+
+
+@register("rbd", "snap_remove")
+async def snap_remove(ctx, inp: bytes):
+    req = _dec(inp)
+    for _ in range(16):
+        cur_raw = (await ctx.omap_get(["snaps"])).get("snaps")
+        snaps = _dec(cur_raw) or {"seq": 0, "by_name": {}}
+        if req["name"] not in snaps["by_name"]:
+            return -2, b""
+        by_name = dict(snaps["by_name"])
+        del by_name[req["name"]]
+        ok, _ = await ctx.omap_cas(
+            "snaps", cur_raw, _enc({"seq": snaps["seq"], "by_name": by_name})
+        )
+        if ok:
+            return 0, b""
+    return -11, b""
+
+
+@register("rbd", "metadata_set")
+async def metadata_set(ctx, inp: bytes):
+    req = _dec(inp)
+    await ctx.omap_set({f"meta.{k}": v for k, v in req.items()})
+    return 0, b""
+
+
+@register("rbd", "metadata_get")
+async def metadata_get(ctx, inp: bytes):
+    req = _dec(inp)
+    omap = await ctx.omap_get([f"meta.{req['key']}"])
+    v = omap.get(f"meta.{req['key']}")
+    if v is None:
+        return -2, b""
+    return 0, v

@@ -160,6 +160,27 @@ class FileStore:
     def _attr_path(self, oid: str) -> str:
         return os.path.join(self._objdir, _escape(oid) + ".attr")
 
+    def _omap_path(self, oid: str) -> str:
+        return os.path.join(self._objdir, _escape(oid) + ".omap")
+
+    def _read_omap(self, oid: str) -> Dict[str, bytes]:
+        p = self._omap_path(oid)
+        if not os.path.exists(p):
+            return {}
+        with open(p, "rb") as f:
+            payload, _ = unframe(f.read(), 0)
+        if payload is None:
+            return {}
+        return Decoder(payload).value()  # type: ignore[return-value]
+
+    def _write_omap(self, oid: str, omap: Dict[str, bytes]) -> None:
+        tmp = self._omap_path(oid) + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(frame(Encoder().value(omap).bytes()))
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, self._omap_path(oid))
+
     def _read_attrs(self, oid: str) -> Dict[str, object]:
         p = self._attr_path(oid)
         if not os.path.exists(p):
@@ -209,11 +230,29 @@ class FileStore:
                 if not os.path.exists(p):
                     open(p, "wb").close()
             elif op.op == "remove":
-                for p in (self._data_path(op.oid), self._attr_path(op.oid)):
+                for p in (self._data_path(op.oid), self._attr_path(op.oid),
+                          self._omap_path(op.oid)):
                     try:
                         os.remove(p)
                     except FileNotFoundError:
                         pass
+            elif op.op == "omap_set":
+                omap = self._read_omap(op.oid)
+                omap.update(op.attr_value)
+                self._write_omap(op.oid, omap)
+                p = self._data_path(op.oid)
+                if not os.path.exists(p):
+                    open(p, "wb").close()
+            elif op.op == "omap_rm":
+                omap = self._read_omap(op.oid)
+                for k in op.attr_value:
+                    omap.pop(k, None)
+                self._write_omap(op.oid, omap)
+            elif op.op == "omap_clear":
+                try:
+                    os.remove(self._omap_path(op.oid))
+                except FileNotFoundError:
+                    pass
             else:
                 raise ValueError(f"unknown op {op.op}")
 
@@ -231,6 +270,15 @@ class FileStore:
         if not os.path.exists(self._data_path(oid)):
             raise FileNotFoundError(oid)
         return self._read_attrs(oid).get(name)
+
+    def omap_get(self, oid: str, keys: Optional[List[str]] = None
+                 ) -> Dict[str, bytes]:
+        if not os.path.exists(self._data_path(oid)):
+            raise FileNotFoundError(oid)
+        omap = self._read_omap(oid)
+        if keys is None:
+            return omap
+        return {k: omap[k] for k in keys if k in omap}
 
     def stat(self, oid: str) -> int:
         p = self._data_path(oid)

@@ -46,6 +46,17 @@ class KStore:
     def _stripe_key(self, oid: str, n: int) -> str:
         return f"{oid}.{n:08d}"
 
+    def _omap_key(self, oid: str, key: str) -> str:
+        return f"{oid}\x00{key}"
+
+    def _omap_db_keys(self, oid: str) -> List[str]:
+        prefix = oid + "\x00"
+        return [
+            k[len(prefix):]
+            for k, _ in self.db.get_iterator("O")
+            if k.startswith(prefix)
+        ]
+
     # -- transaction path --------------------------------------------------
 
     def queue_transaction(self, txn: Transaction) -> None:
@@ -54,6 +65,8 @@ class KStore:
         batch = KVTransaction()
         metas: Dict[str, Optional[dict]] = {}
         stripes: Dict[str, Dict[int, bytearray]] = {}
+        #: staged omap mutations per oid: key -> bytes (set) | None (rm)
+        omaps: Dict[str, Dict[str, Optional[bytes]]] = {}
         removed: set = set()  # oids removed earlier in this txn
 
         def meta_for(oid: str) -> dict:
@@ -120,10 +133,28 @@ class KStore:
                 )
                 metas[op.oid] = None
                 stripes.pop(op.oid, None)
+                omaps.pop(op.oid, None)
                 removed.add(op.oid)
                 batch.rmkey("M", op.oid)
                 for n in range(max_size // self.stripe_size + 1):
                     batch.rmkey("D", self._stripe_key(op.oid, n))
+                for k in self._omap_db_keys(op.oid):
+                    batch.rmkey("O", self._omap_key(op.oid, k))
+            elif op.op == "omap_set":
+                meta_for(op.oid)
+                omaps.setdefault(op.oid, {}).update(op.attr_value)
+            elif op.op == "omap_rm":
+                staged_omap = omaps.setdefault(op.oid, {})
+                for k in op.attr_value:
+                    staged_omap[k] = None
+            elif op.op == "omap_clear":
+                staged_omap = omaps.setdefault(op.oid, {})
+                staged_omap.clear()
+                keys = (
+                    [] if op.oid in removed else self._omap_db_keys(op.oid)
+                )
+                for k in keys:
+                    staged_omap[k] = None
             else:
                 raise ValueError(f"unknown op {op.op}")
 
@@ -139,6 +170,14 @@ class KStore:
                     batch.set("D", self._stripe_key(oid, n), bytes(st))
                 else:
                     batch.rmkey("D", self._stripe_key(oid, n))
+        for oid, staged_omap in omaps.items():
+            if metas.get(oid, True) is None:
+                continue
+            for k, v in staged_omap.items():
+                if v is None:
+                    batch.rmkey("O", self._omap_key(oid, k))
+                else:
+                    batch.set("O", self._omap_key(oid, k), v)
         self.db.submit_transaction(batch, sync=True)
 
     # -- reads (MemStore API) ----------------------------------------------
@@ -167,6 +206,22 @@ class KStore:
         if meta is None:
             raise FileNotFoundError(oid)
         return meta["xattrs"].get(name)
+
+    def omap_get(self, oid: str, keys: Optional[List[str]] = None
+                 ) -> Dict[str, bytes]:
+        if self._get_meta(oid) is None:
+            raise FileNotFoundError(oid)
+        if keys is not None:
+            out = {}
+            for k in keys:
+                v = self.db.get("O", self._omap_key(oid, k))
+                if v is not None:
+                    out[k] = v
+            return out
+        return {
+            k: self.db.get("O", self._omap_key(oid, k))
+            for k in self._omap_db_keys(oid)
+        }
 
     def stat(self, oid: str) -> int:
         meta = self._get_meta(oid)

@@ -102,6 +102,10 @@ class OSDShard:
         #: write, so applies are version-gated (reference: recovery pushes
         #: carry the object version and PG logic discards stale ones)
         self._applied_version: Dict[str, int] = {}
+        #: watch/notify state (reference src/osd/Watch.cc): oid -> watchers
+        self.watches: Dict[str, Dict[str, bool]] = {}
+        self._notify_seq = 0
+        self._notify_pending: Dict[int, tuple] = {}
         self.optracker = OpTracker()
         self.op_queue_type = op_queue
         if op_queue == "mclock":
@@ -134,6 +138,9 @@ class OSDShard:
             # fast dispatch: heartbeats never sit behind the op queue
             await self.messenger.send_message(self.name, src, ("pong", self.name))
             return
+        if isinstance(msg, dict) and "op" in msg:
+            await self._handle_meta_op(src, msg)
+            return
         if isinstance(msg, (ECSubWrite, ECSubRead)):
             klass = getattr(msg, "op_class", "client")
             cost = self._op_cost(msg)
@@ -145,6 +152,147 @@ class OSDShard:
                 self.opq.enqueue(OP_PRIORITY.get(klass, 63), cost, (src, msg))
             self.perf.inc(f"queued_{klass}")
             self._op_event.set()
+
+    async def _handle_meta_op(self, src: str, msg: dict) -> None:
+        """Metadata-plane ops served fast-dispatch (single-threaded, so
+        compare-and-swap is atomic without extra locking):
+
+        * ``omap_cas`` -- the atomicity primitive cls_lock-style classes
+          need: this OSD (the object's primary-shard holder) is the CAS
+          authority (the reference runs cls methods on the primary OSD,
+          src/osd/ClassHandler.cc; our primary engine is client-side, so
+          atomic read-modify-write is delegated here).
+        * ``watch`` / ``unwatch`` / ``notify`` -- watch/notify semantics
+          (reference src/osd/Watch.cc): watchers register here; notify
+          fans an event to every watcher and gathers acks.
+        * ``meta_get`` -- omap + xattrs + meta version for the replicated
+          metadata object.
+        """
+        op = msg["op"]
+        oid = msg.get("oid", "")
+        soid = f"{oid}@meta"
+        if op == "meta_get":
+            try:
+                omap = self.store.omap_get(soid)
+                ver = self.store.getattr(soid, "_meta_version") or 0
+            except FileNotFoundError:
+                omap, ver = None, 0
+            await self.messenger.send_message(self.name, src, {
+                "op": "meta_get_reply", "tid": msg["tid"],
+                "omap": omap, "version": ver, "from": self.name,
+            })
+        elif op == "meta_apply":
+            # replicated metadata write: the message carries the FULL
+            # resulting omap, not a delta, so a replica that missed any
+            # number of earlier versions (it was down) converges to the
+            # complete state in one application -- a delta under a
+            # version-gap gate would either be rejected forever or stamp
+            # a newer version over incomplete contents
+            ver = msg["version"]
+            try:
+                cur = self.store.getattr(soid, "_meta_version") or 0
+            except FileNotFoundError:
+                cur = 0
+            if ver >= cur:
+                txn = (
+                    Transaction()
+                    .omap_clear(soid)
+                    .omap_setkeys(soid, msg["omap"])
+                    .setattr(soid, "_meta_version", ver)
+                )
+                self.store.queue_transaction(txn)
+            await self.messenger.send_message(self.name, src, {
+                "op": "meta_apply_reply", "tid": msg["tid"],
+                "from": self.name, "applied": ver >= cur,
+            })
+        elif op == "omap_cas":
+            key, expect, new = msg["key"], msg["expect"], msg["new"]
+            try:
+                omap = self.store.omap_get(soid)
+            except FileNotFoundError:
+                omap = {}
+            cur = omap.get(key)
+            success = cur == expect
+            ver = (self.store.getattr(soid, "_meta_version") or 0
+                   if self.store.exists(soid) else 0)
+            if success:
+                ver += 1
+                if new is None:
+                    omap.pop(key, None)
+                else:
+                    omap[key] = new
+                txn = (
+                    Transaction()
+                    .omap_clear(soid)
+                    .omap_setkeys(soid, omap)
+                    .setattr(soid, "_meta_version", ver)
+                )
+                self.store.queue_transaction(txn)
+            await self.messenger.send_message(self.name, src, {
+                "op": "omap_cas_reply", "tid": msg["tid"],
+                "success": success, "current": cur, "version": ver,
+                # full state for replication fan-out by the caller
+                "omap": omap,
+            })
+        elif op == "watch":
+            self.watches.setdefault(oid, {})[msg["watcher"]] = True
+            await self.messenger.send_message(self.name, src, {
+                "op": "watch_reply", "tid": msg["tid"], "ok": True,
+            })
+        elif op == "unwatch":
+            self.watches.get(oid, {}).pop(msg["watcher"], None)
+            await self.messenger.send_message(self.name, src, {
+                "op": "watch_reply", "tid": msg["tid"], "ok": True,
+            })
+        elif op == "notify":
+            self._notify_seq += 1
+            notify_id = self._notify_seq
+            watchers = list(self.watches.get(oid, {}))
+            if not watchers:
+                await self.messenger.send_message(self.name, src, {
+                    "op": "notify_reply", "tid": msg["tid"],
+                    "acks": [], "timeouts": [],
+                })
+                return
+            pending = set(watchers)
+            acked: list = []
+            fut = asyncio.get_event_loop().create_future()
+            self._notify_pending[notify_id] = (pending, acked, fut)
+            for w in watchers:
+                await self.messenger.send_message(self.name, w, {
+                    "op": "notify_event", "oid": oid,
+                    "payload": msg.get("payload"),
+                    "notify_id": notify_id, "notifier": self.name,
+                })
+
+            async def gather_acks(tid=msg["tid"]):
+                # runs as its own task: the dispatch loop must stay free
+                # to deliver the very notify_acks being awaited here
+                try:
+                    await asyncio.wait_for(
+                        fut, timeout=msg.get("timeout", 5.0)
+                    )
+                except asyncio.TimeoutError:
+                    pass
+                self._notify_pending.pop(notify_id, None)
+                await self.messenger.send_message(self.name, src, {
+                    "op": "notify_reply", "tid": tid,
+                    "acks": list(acked), "timeouts": sorted(pending),
+                })
+
+            self.messenger.adopt_task(
+                f"{self.name}.notify{notify_id}",
+                asyncio.get_event_loop().create_task(gather_acks()),
+            )
+        elif op == "notify_ack":
+            state = self._notify_pending.get(msg["notify_id"])
+            if state is not None:
+                pending, acked, fut = state
+                if msg["watcher"] in pending:
+                    pending.discard(msg["watcher"])
+                    acked.append(msg["watcher"])
+                if not pending and not fut.done():
+                    fut.set_result(True)
 
     async def _op_worker(self) -> None:
         """Dequeue-and-execute loop (the osd_op_tp worker thread role)."""
@@ -322,6 +470,11 @@ class ECBackend:
         from ceph_tpu.osd.extent_cache import ExtentCache
 
         self.extent_cache = ExtentCache()
+        #: replicated-metadata version sequence per oid (meta plane is
+        #: versioned separately from the chunk plane)
+        self._meta_versions: Dict[str, int] = {}
+        #: oid -> callback for watch/notify events
+        self._watch_callbacks: Dict[str, object] = {}
         # CRUSH placement engine (ceph_tpu.osd.placement.CrushPlacement);
         # None falls back to the seeded-permutation CRUSH-lite below.
         self.placement = placement
@@ -362,6 +515,42 @@ class ECBackend:
 
     async def dispatch(self, src: str, msg) -> None:
         if isinstance(msg, dict):
+            op = msg.get("op")
+            if op in ("meta_get_reply", "meta_apply_reply",
+                      "omap_cas_reply", "watch_reply", "notify_reply"):
+                state = self._pending.get(msg.get("tid"))
+                if state is not None:
+                    state["replies"][src] = msg
+                    state["outstanding"].discard(src)
+                    if not state["outstanding"] and not state["done"].done():
+                        state["done"].set_result(True)
+                return
+            if op == "notify_event":
+                # run the callback as its own task: a callback that does
+                # I/O (e.g. header refresh) needs this dispatch loop free;
+                # the ack goes out after the callback finishes (librados
+                # semantics: notify completes when handlers have run)
+                async def run_cb(msg=msg, src=src):
+                    cb = self._watch_callbacks.get(msg["oid"])
+                    if cb is not None:
+                        try:
+                            res = cb(msg["oid"], msg.get("payload"))
+                            if asyncio.iscoroutine(res):
+                                await res
+                        except Exception:  # noqa: BLE001 -- a watcher
+                            # callback crash must not lose the ack
+                            import traceback
+                            traceback.print_exc()
+                    await self.messenger.send_message(self.name, src, {
+                        "op": "notify_ack", "notify_id": msg["notify_id"],
+                        "watcher": self.name,
+                    })
+
+                self.messenger.adopt_task(
+                    f"{self.name}.watchcb{msg['notify_id']}",
+                    asyncio.get_event_loop().create_task(run_cb()),
+                )
+                return
             # monitor traffic (command replies, osdmap broadcasts)
             hook = getattr(self, "mon_hook", None)
             if hook is not None:
@@ -556,42 +745,22 @@ class ECBackend:
                 hinfos[s] = attrs[ecutil.HINFO_KEY]
             versions[s] = attrs.get(VERSION_KEY) or 0
 
-    def _consistent_cut(self, chunks, versions, sizes):
-        """Keep only shards of one consistent version: the newest version
-        still held by >= k shards (a shard that was down during writes
-        holds stale bytes that must not enter a decode -- the peering /
-        PG-log missing-set role).  Falling back past a version with < k
-        shards is the log-rollback semantic: such a write died mid-flight
-        and was never acked to the client."""
-        counts: Dict[int, int] = {}
-        for s in chunks:
-            v = versions.get(s, 0)
-            counts[v] = counts.get(v, 0) + 1
-        if not counts:
-            return None
-        complete = [v for v, c in counts.items() if c >= self.k]
-        target = max(complete) if complete else max(counts)
-        stale = [s for s in chunks if versions.get(s, 0) != target]
-        for s in stale:
-            del chunks[s]
-        if stale:
-            self.perf.inc("stale_shards_dropped")
-        size = None
-        for s in chunks:
-            if sizes.get(s) is not None:
-                size = sizes[s]
-                break
-        return size
-
     async def _gather_consistent(
         self, oid, shards, acting, extents=None, op_class="client",
         up_shards=None,
     ):
-        """One read round over ``shards`` + an escalation round to every
-        remaining up shard when results are short or version-skewed,
-        ending in the consistent cut.  Shared by read / read_range /
+        """Version-authoritative gather, shared by read / read_range /
         recovery so the staleness rules cannot diverge between them.
-        Returns (chunks, sizes_hint, hinfo_hint)."""
+
+        Round 1 reads data from ``shards`` and, concurrently, version
+        attrs from EVERY other up shard -- the minimum data set alone
+        cannot establish the authoritative version (it might consist
+        entirely of same-version stale shards that missed a degraded
+        write).  Then candidate versions are tried newest-complete first:
+        missing chunks of the candidate are fetched and, if >= k line up,
+        that version wins; otherwise fall back (log-rollback semantics
+        for writes that died mid-flight).
+        Returns (chunks, size_hint, hinfo_hint, version)."""
         if up_shards is None:
             up_shards = [
                 s for s in range(self.km) if self._shard_up(acting, s)
@@ -601,29 +770,65 @@ class ECBackend:
         sizes: Dict[int, int] = {}
         hinfos: Dict[int, dict] = {}
         failed: List[int] = []
-        replies = await self._read_shards(
+        others = [s for s in up_shards if s not in shards]
+        data_coro = self._read_shards(
             oid, shards, acting, extents=extents, op_class=op_class
         )
-        self._collect_read(replies, oid, chunks, versions, sizes, failed,
-                           hinfos)
-        vmax = max((versions.get(s, 0) for s in chunks), default=0)
-        missing = [s for s in shards if s not in chunks]
-        skew = any(versions.get(s, 0) != vmax for s in chunks)
-        if missing or skew or len(chunks) < self.k:
-            self.perf.inc("degraded_read")
-            rest = [
-                s for s in up_shards if s not in chunks and s not in failed
+        if others:
+            attr_coro = self._read_shards(
+                oid, others, acting, extents=[(0, 0)], op_class=op_class
+            )
+            data_replies, attr_replies = await asyncio.gather(
+                data_coro, attr_coro
+            )
+        else:
+            data_replies, attr_replies = await data_coro, {}
+        self._collect_read(data_replies, oid, chunks, versions, sizes,
+                           failed, hinfos)
+        # attr-only round: versions/sizes/hinfos, never chunk content
+        attr_chunks: Dict[int, np.ndarray] = {}
+        self._collect_read(attr_replies, oid, attr_chunks, versions, sizes,
+                           failed, hinfos)
+
+        counts: Dict[int, int] = {}
+        for s, v in versions.items():
+            if s not in failed:
+                counts[v] = counts.get(v, 0) + 1
+        if not counts:
+            return {}, None, None, 0
+        candidates = sorted(
+            (v for v, c in counts.items() if c >= self.k), reverse=True
+        ) or [max(counts)]
+
+        for target in candidates:
+            holders = [
+                s for s in up_shards
+                if versions.get(s) == target and s not in failed
             ]
-            if rest:
+            need = [s for s in holders if s not in chunks]
+            if need:
+                self.perf.inc("degraded_read")
                 more = await self._read_shards(
-                    oid, rest, acting, extents=extents, op_class=op_class
+                    oid, need, acting, extents=extents, op_class=op_class
                 )
                 self._collect_read(more, oid, chunks, versions, sizes,
                                    failed, hinfos)
-        size = self._consistent_cut(chunks, versions, sizes)
-        hinfo = next((hinfos[s] for s in chunks if s in hinfos), None)
-        vcut = max((versions.get(s, 0) for s in chunks), default=0)
-        return chunks, size, hinfo, vcut
+            have = {
+                s: chunks[s] for s in holders
+                if s in chunks and versions.get(s) == target
+            }
+            if len(have) >= self.k or target == candidates[-1]:
+                if len(chunks) != len(have):
+                    self.perf.inc("stale_shards_dropped")
+                size = next(
+                    (sizes[s] for s in holders if sizes.get(s) is not None),
+                    None,
+                )
+                hinfo = next(
+                    (hinfos[s] for s in holders if s in hinfos), None
+                )
+                return have, size, hinfo, target
+        return {}, None, None, 0  # unreachable: loop always returns
 
     async def read(self, oid: str) -> bytes:
         """objects_read_and_reconstruct: minimum shards, degraded fallback."""
@@ -816,6 +1021,203 @@ class ECBackend:
         # publish committed bytes for read-through (padding included: those
         # bytes are logically zero up to new_size and real data below it)
         pin.commit(start, buf.tobytes())
+
+    async def remove_object(self, oid: str) -> None:
+        """Delete every shard of an object (librados remove role)."""
+        acting = self.acting_set(oid)
+        up = [s for s in range(self.km) if self._shard_up(acting, s)]
+        if not up:
+            raise IOError(f"cannot remove {oid}: no shards up")
+        if oid not in self._versions:
+            await self._stat(oid)
+        version = max(self._versions.values(), default=0) + 1
+        self._versions[oid] = version
+        self._tid += 1
+        tid = self._tid
+        done = asyncio.get_event_loop().create_future()
+        self._pending[tid] = {
+            "committed": set(),
+            "expected": {f"osd.{acting[s]}" for s in up},
+            "done": done,
+        }
+        for s in up:
+            await self.messenger.send_message(
+                self.name, f"osd.{acting[s]}",
+                ECSubWrite(
+                    from_shard=s, tid=tid, oid=oid,
+                    transaction=Transaction().remove(shard_oid(oid, s)),
+                    at_version=version,
+                ),
+            )
+        await self._await_commits(oid, tid, done, min_acks=1)
+        self.extent_cache.invalidate(oid)
+
+    # -- metadata plane: replicated omap / CAS / watch-notify / cls --------
+    #
+    # The reference keeps object metadata (cls state, rbd headers, locks)
+    # in omap on replicated pools and runs cls methods + watch/notify on
+    # the primary OSD.  Here the metadata object "<oid>@meta" is fully
+    # replicated to every up shard OSD (metadata is small; survival under
+    # any k-available scenario matters more than space), versioned on its
+    # own sequence; the acting[0] OSD is the atomicity (CAS) and
+    # watch/notify authority.
+
+    def _meta_targets(self, oid: str):
+        acting = self.acting_set(oid)
+        up = [
+            f"osd.{acting[s]}"
+            for s in range(self.km)
+            if self._shard_up(acting, s)
+        ]
+        if not up:
+            raise IOError(f"no up OSDs for {oid} metadata")
+        return up
+
+    async def _meta_roundtrip(self, targets, payload: dict,
+                              timeout: float = 5.0) -> Dict[str, dict]:
+        """Send one dict op to each target, gather replies by sender."""
+        self._tid += 1
+        tid = self._tid
+        done = asyncio.get_event_loop().create_future()
+        self._pending[tid] = {
+            "replies": {}, "outstanding": set(targets), "done": done,
+        }
+        for t in targets:
+            await self.messenger.send_message(
+                self.name, t, dict(payload, tid=tid)
+            )
+        try:
+            await asyncio.wait_for(done, timeout=timeout)
+        except asyncio.TimeoutError:
+            pass
+        state = self._pending.pop(tid)
+        return state["replies"]
+
+    async def _meta_read(self, oid: str) -> Dict[str, bytes]:
+        """Highest-versioned replica's omap (+ learn the version)."""
+        targets = self._meta_targets(oid)
+        replies = await self._meta_roundtrip(
+            targets, {"op": "meta_get", "oid": oid}
+        )
+        best_ver, best = 0, None
+        for r in replies.values():
+            if r.get("omap") is not None and r["version"] >= best_ver:
+                best_ver, best = r["version"], r["omap"]
+        if best_ver > self._meta_versions.get(oid, 0):
+            self._meta_versions[oid] = best_ver
+        return best if best is not None else {}
+
+    async def _meta_write(self, oid: str, sets=None, rms=None,
+                          clear=False) -> None:
+        """Read-modify-write of the FULL replicated omap.  Full-state
+        replication lets a replica that missed versions converge in one
+        step; concurrent plain writers are last-writer-wins (atomic
+        read-modify-write goes through omap_cas / cls methods, as in the
+        reference)."""
+        targets = self._meta_targets(oid)
+        omap = {} if clear else await self._meta_read(oid)
+        if rms:
+            for k in rms:
+                omap.pop(k, None)
+        if sets:
+            omap.update(sets)
+        ver = self._meta_versions.get(oid, 0) + 1
+        self._meta_versions[oid] = ver
+        replies = await self._meta_roundtrip(targets, {
+            "op": "meta_apply", "oid": oid, "version": ver, "omap": omap,
+        })
+        if not replies:
+            raise IOError(f"metadata write for {oid} reached no OSD")
+
+    async def omap_set(self, oid: str, kvs: Dict[str, bytes]) -> None:
+        await self._meta_write(oid, sets=dict(kvs))
+
+    async def omap_rm(self, oid: str, keys) -> None:
+        await self._meta_write(oid, rms=list(keys))
+
+    async def omap_clear(self, oid: str) -> None:
+        await self._meta_write(oid, clear=True)
+
+    async def omap_get(self, oid: str, keys=None) -> Dict[str, bytes]:
+        omap = await self._meta_read(oid)
+        if keys is None:
+            return omap
+        return {k: omap[k] for k in keys if k in omap}
+
+    async def omap_cas(self, oid: str, key: str, expect, new):
+        """Atomic compare-and-swap on the primary-shard OSD, then
+        replicate the outcome to the remaining replicas."""
+        acting = self.acting_set(oid)
+        primary = None
+        for s in range(self.km):
+            if self._shard_up(acting, s):
+                primary = f"osd.{acting[s]}"
+                break
+        if primary is None:
+            raise IOError(f"no up OSDs for {oid} CAS")
+        replies = await self._meta_roundtrip(
+            [primary],
+            {"op": "omap_cas", "oid": oid, "key": key,
+             "expect": expect, "new": new},
+        )
+        r = replies.get(primary)
+        if r is None:
+            raise IOError(f"CAS on {oid} got no reply from {primary}")
+        if r["success"]:
+            # propagate the authority's full state to the other replicas
+            self._meta_versions[oid] = r["version"]
+            others = [t for t in self._meta_targets(oid) if t != primary]
+            if others:
+                await self._meta_roundtrip(others, {
+                    "op": "meta_apply", "oid": oid,
+                    "version": r["version"], "omap": r["omap"],
+                })
+        return r["success"], r["current"]
+
+    async def watch(self, oid: str, callback) -> None:
+        """Register for notify events on oid (librados watch role)."""
+        targets = self._meta_targets(oid)[:1]
+        self._watch_callbacks[oid] = callback
+        replies = await self._meta_roundtrip(
+            targets, {"op": "watch", "oid": oid, "watcher": self.name}
+        )
+        if not replies:
+            del self._watch_callbacks[oid]
+            raise IOError(f"watch {oid}: no reply")
+
+    async def unwatch(self, oid: str) -> None:
+        targets = self._meta_targets(oid)[:1]
+        self._watch_callbacks.pop(oid, None)
+        await self._meta_roundtrip(
+            targets, {"op": "unwatch", "oid": oid, "watcher": self.name}
+        )
+
+    async def notify(self, oid: str, payload=None, timeout: float = 5.0):
+        """Notify every watcher; returns {"acks": [...], "timeouts": [...]}
+        once all ack or the timeout passes (librados notify role)."""
+        targets = self._meta_targets(oid)[:1]
+        replies = await self._meta_roundtrip(
+            targets,
+            {"op": "notify", "oid": oid, "payload": payload,
+             "timeout": timeout},
+            # the OSD gathers watcher acks for up to ``timeout`` before it
+            # replies; give the round-trip headroom past that
+            timeout=timeout + 2.0,
+        )
+        for r in replies.values():
+            return {"acks": r["acks"], "timeouts": r["timeouts"]}
+        raise IOError(f"notify {oid}: no reply")
+
+    async def exec(self, oid: str, cls: str, method: str, inp: bytes = b""):
+        """Run a server-side object class method (cls exec role).
+
+        The reference dlopens cls plugins on the OSD (ClassHandler); our
+        primary engine hosts the class registry and methods run against
+        this backend's object surface, with omap_cas as the atomicity
+        primitive where a method needs read-modify-write."""
+        from ceph_tpu.cls import call_method
+
+        return await call_method(self, oid, cls, method, inp)
 
     # -- scrub -------------------------------------------------------------
 

@@ -17,6 +17,7 @@ class MemObject:
     def __init__(self):
         self.data = bytearray()
         self.xattrs: Dict[str, object] = {}
+        self.omap: Dict[str, bytes] = {}
 
 
 class MemStore:
@@ -38,6 +39,7 @@ class MemStore:
                     if existing is not None:
                         clone.data = bytearray(existing.data)
                         clone.xattrs = dict(existing.xattrs)
+                        clone.omap = dict(existing.omap)
                     staged[oid] = clone
                 return staged[oid]  # type: ignore[return-value]
 
@@ -58,6 +60,14 @@ class MemStore:
                         o.data.extend(b"\0" * (op.offset - len(o.data)))
                 elif op.op == "remove":
                     staged[op.oid] = None
+                elif op.op == "omap_set":
+                    obj_for(op.oid).omap.update(op.attr_value)
+                elif op.op == "omap_rm":
+                    o = obj_for(op.oid)
+                    for k in op.attr_value:
+                        o.omap.pop(k, None)
+                elif op.op == "omap_clear":
+                    obj_for(op.oid).omap.clear()
                 else:
                     raise ValueError(f"unknown op {op.op}")
             for oid, obj in staged.items():
@@ -83,6 +93,16 @@ class MemStore:
             if obj is None:
                 raise FileNotFoundError(oid)
             return obj.xattrs.get(name)
+
+    def omap_get(self, oid: str, keys: Optional[List[str]] = None
+                 ) -> Dict[str, bytes]:
+        with self._lock:
+            obj = self._objects.get(oid)
+            if obj is None:
+                raise FileNotFoundError(oid)
+            if keys is None:
+                return dict(obj.omap)
+            return {k: obj.omap[k] for k in keys if k in obj.omap}
 
     def stat(self, oid: str) -> int:
         with self._lock:

@@ -47,6 +47,24 @@ class Transaction:
         self.ops.append(TxnOp("truncate", oid=oid, offset=offset))
         return self
 
+    # -- omap (reference: ObjectStore omap_setkeys/rmkeys/clear; the
+    # per-object sorted key->value map cls/mds/rbd metadata lives in) ----
+
+    def omap_setkeys(self, oid: str, kvs: Dict[str, bytes]) -> "Transaction":
+        self.ops.append(
+            TxnOp("omap_set", oid=oid,
+                  attr_value={k: bytes(v) for k, v in kvs.items()})
+        )
+        return self
+
+    def omap_rmkeys(self, oid: str, keys: List[str]) -> "Transaction":
+        self.ops.append(TxnOp("omap_rm", oid=oid, attr_value=list(keys)))
+        return self
+
+    def omap_clear(self, oid: str) -> "Transaction":
+        self.ops.append(TxnOp("omap_clear", oid=oid))
+        return self
+
 
 @dataclasses.dataclass
 class LogEntry:

@@ -1,0 +1,7 @@
+"""Client-side data-path helpers (reference: src/osdc -- Objecter/
+Striper/ObjectCacher).  The Objecter's placement+retry role is fused
+into ECBackend; Striper lives here."""
+
+from ceph_tpu.osdc.striper import FileLayout, Striper
+
+__all__ = ["FileLayout", "Striper"]

@@ -1,0 +1,22 @@
+"""RBD: block images striped over RADOS objects.
+
+Reference: src/librbd (58.7k LoC) reduced to the core image model:
+
+* header object ``rbd_header.<name>`` -- size/order/snaps/metadata in
+  omap, managed by the ``rbd`` object class (ceph_tpu/cls/cls_rbd.py,
+  reference src/cls/rbd);
+* data objects ``rbd_data.<name>.<object_no:016x>`` -- image extents
+  mapped by the Striper (object_size = 2^order);
+* exclusive-lock via cls_lock, header-change notification via
+  watch/notify (the reference's ExclusiveLock + ImageWatcher roles);
+* the image directory object ``rbd_directory`` lists images (cls_rbd
+  dir methods' role).
+
+Reductions vs the reference (documented, not hidden): snapshots are
+header metadata only (no OSD-level COW clones), no journaling/mirroring,
+no parent/child layering.
+"""
+
+from ceph_tpu.rbd.image import RBD, Image
+
+__all__ = ["RBD", "Image"]

@@ -1,0 +1,186 @@
+"""RBD image management + I/O (librbd core surface)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ceph_tpu.osdc.striper import FileLayout, Striper
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+_DIR_OID = "rbd_directory"
+
+
+def _enc(v) -> bytes:
+    return Encoder().value(v).bytes()
+
+
+def _dec(b):
+    return Decoder(b).value() if b else None
+
+
+def _header_oid(name: str) -> str:
+    return f"rbd_header.{name}"
+
+
+def _data_oid(name: str, object_no: int) -> str:
+    return f"rbd_data.{name}.{object_no:016x}"
+
+
+class RBD:
+    """Image management (librbd::RBD): create/list/remove/resize."""
+
+    def __init__(self, backend):
+        self.backend = backend  # the pool's primary EC engine
+
+    async def create(self, name: str, size: int, order: int = 22) -> None:
+        ret, _ = await self.backend.exec(
+            _header_oid(name), "rbd", "create",
+            _enc({"size": size, "order": order}),
+        )
+        if ret == -17:
+            raise FileExistsError(name)
+        if ret != 0:
+            raise IOError(f"rbd create {name}: rc={ret}")
+        await self.backend.omap_set(_DIR_OID, {f"name_{name}": b"1"})
+
+    async def list(self) -> List[str]:
+        try:
+            omap = await self.backend.omap_get(_DIR_OID)
+        except FileNotFoundError:
+            return []
+        return sorted(
+            k[len("name_"):] for k in omap if k.startswith("name_")
+        )
+
+    async def remove(self, name: str) -> None:
+        img = await Image.open(self.backend, name)
+        n_objects = img.striper.object_count(img.size)
+        for object_no in range(n_objects):
+            try:
+                await self.backend.remove_object(_data_oid(name, object_no))
+            except (FileNotFoundError, IOError):
+                pass  # never-written object
+        await self.backend.omap_clear(_header_oid(name))
+        await self.backend.omap_rm(_DIR_OID, [f"name_{name}"])
+
+
+class Image:
+    """An open image (librbd::Image): read/write/resize/snap/lock."""
+
+    def __init__(self, backend, name: str, size: int, order: int,
+                 snaps: Dict[str, dict]):
+        self.backend = backend
+        self.name = name
+        self.size = size
+        self.order = order
+        self.snaps = snaps
+        self.striper = Striper(FileLayout(
+            object_size=1 << order, stripe_unit=1 << order, stripe_count=1,
+        ))
+
+    @classmethod
+    async def open(cls, backend, name: str) -> "Image":
+        ret, out = await backend.exec(_header_oid(name), "rbd",
+                                      "get_metadata")
+        if ret == -2:
+            raise FileNotFoundError(name)
+        md = _dec(out)
+        return cls(backend, name, md["size"], md["order"], md["snaps"])
+
+    async def refresh(self) -> None:
+        md = _dec((await self.backend.exec(
+            _header_oid(self.name), "rbd", "get_metadata"))[1])
+        self.size, self.order = md["size"], md["order"]
+        self.snaps = md["snaps"]
+
+    # -- I/O ---------------------------------------------------------------
+
+    async def write(self, offset: int, data: bytes) -> None:
+        if offset + len(data) > self.size:
+            raise IOError("write past end of image")
+        pos = 0
+        for object_no, obj_off, length in self.striper.map_extent(
+            offset, len(data)
+        ):
+            oid = _data_oid(self.name, object_no)
+            await self.backend.write_range(
+                oid, obj_off, data[pos : pos + length]
+            )
+            pos += length
+
+    async def read(self, offset: int, length: int) -> bytes:
+        length = max(0, min(length, self.size - offset))
+        out = bytearray(length)
+        pos = 0
+        for object_no, obj_off, take in self.striper.map_extent(
+            offset, length
+        ):
+            oid = _data_oid(self.name, object_no)
+            try:
+                piece = await self.backend.read_range(oid, obj_off, take)
+            except (FileNotFoundError, IOError):
+                piece = b""  # never-written object reads as zeros
+            out[pos : pos + len(piece)] = piece
+            pos += take
+        return bytes(out)
+
+    async def resize(self, new_size: int) -> None:
+        ret, _ = await self.backend.exec(
+            _header_oid(self.name), "rbd", "set_size",
+            _enc({"size": new_size}),
+        )
+        if ret != 0:
+            raise IOError(f"resize rc={ret}")
+        self.size = new_size
+        # header watchers (other clients with the image open) refresh
+        await self.backend.notify(
+            _header_oid(self.name), {"event": "resize", "size": new_size},
+            timeout=1.0,
+        )
+
+    # -- snapshots (metadata-level; see package docstring) ----------------
+
+    async def snap_create(self, snap: str) -> int:
+        ret, out = await self.backend.exec(
+            _header_oid(self.name), "rbd", "snap_add", _enc({"name": snap}))
+        if ret != 0:
+            raise IOError(f"snap_create rc={ret}")
+        await self.refresh()
+        return _dec(out)
+
+    async def snap_remove(self, snap: str) -> None:
+        ret, _ = await self.backend.exec(
+            _header_oid(self.name), "rbd", "snap_remove",
+            _enc({"name": snap}))
+        if ret != 0:
+            raise IOError(f"snap_remove rc={ret}")
+        await self.refresh()
+
+    def snap_list(self) -> List[str]:
+        return sorted(self.snaps)
+
+    # -- exclusive lock (cls_lock-backed, ExclusiveLock role) --------------
+
+    async def lock_acquire(self, cookie: str) -> None:
+        ret, _ = await self.backend.exec(
+            _header_oid(self.name), "lock", "lock",
+            _enc({"name": "rbd_lock", "locker": cookie,
+                  "type": "exclusive"}),
+        )
+        if ret == -16:
+            raise BlockingIOError(f"image {self.name} is locked")
+        if ret != 0:
+            raise IOError(f"lock rc={ret}")
+
+    async def lock_release(self, cookie: str) -> None:
+        await self.backend.exec(
+            _header_oid(self.name), "lock", "unlock",
+            _enc({"name": "rbd_lock", "locker": cookie}),
+        )
+
+    async def watch_header(self, callback) -> None:
+        """ImageWatcher role: get notified of header changes."""
+        await self.backend.watch(_header_oid(self.name), callback)
+
+    async def unwatch_header(self) -> None:
+        await self.backend.unwatch(_header_oid(self.name))

@@ -1,0 +1,271 @@
+"""Omap, cls object classes, CAS atomicity, watch/notify.
+
+Reference tiers: src/test/cls_lock, cls_version unit tests; omap via
+store_test.cc; watch/notify via librados watch_notify tests.
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+def _enc(v) -> bytes:
+    return Encoder().value(v).bytes()
+
+
+def _dec(b):
+    return Decoder(b).value() if b else None
+
+
+def _mk():
+    return ECCluster(6, {"k": "2", "m": "1"})
+
+
+# -- replicated omap plane -------------------------------------------------
+
+
+def test_omap_set_get_rm_roundtrip():
+    async def run():
+        c = _mk()
+        b = c.backend
+        await b.omap_set("obj", {"a": b"1", "b": b"2"})
+        assert await b.omap_get("obj") == {"a": b"1", "b": b"2"}
+        assert await b.omap_get("obj", ["b"]) == {"b": b"2"}
+        await b.omap_rm("obj", ["a"])
+        assert await b.omap_get("obj") == {"b": b"2"}
+        await b.omap_clear("obj")
+        assert await b.omap_get("obj") == {}
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_omap_survives_primary_shard_osd_loss():
+    """Metadata is replicated to every up shard: losing the CAS authority
+    OSD must not lose the omap."""
+
+    async def run():
+        c = _mk()
+        b = c.backend
+        await b.omap_set("obj", {"k": b"v"})
+        acting = b.acting_set("obj")
+        c.kill_osd(acting[0])
+        assert await b.omap_get("obj") == {"k": b"v"}
+        # writes keep working against the surviving replicas
+        await b.omap_set("obj", {"k2": b"v2"})
+        assert (await b.omap_get("obj"))["k2"] == b"v2"
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_omap_cas_contention_single_winner():
+    async def run():
+        c = _mk()
+        b = c.backend
+        results = await asyncio.gather(*[
+            b.omap_cas("obj", "owner", None, f"client-{i}".encode())
+            for i in range(8)
+        ])
+        winners = [r for r in results if r[0]]
+        assert len(winners) == 1
+        owner = (await b.omap_get("obj", ["owner"]))["owner"]
+        assert owner in {f"client-{i}".encode() for i in range(8)}
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+# -- cls classes -----------------------------------------------------------
+
+
+def test_cls_lock_exclusive_and_unlock():
+    async def run():
+        c = _mk()
+        b = c.backend
+        ret, _ = await b.exec("obj", "lock", "lock", _enc(
+            {"name": "rbd_lock", "locker": "me", "type": "exclusive"}))
+        assert ret == 0
+        ret, _ = await b.exec("obj", "lock", "lock", _enc(
+            {"name": "rbd_lock", "locker": "other", "type": "exclusive"}))
+        assert ret == -16  # EBUSY
+        ret, out = await b.exec("obj", "lock", "get_info", _enc(
+            {"name": "rbd_lock"}))
+        assert _dec(out)["lockers"] == ["me"]
+        ret, _ = await b.exec("obj", "lock", "unlock", _enc(
+            {"name": "rbd_lock", "locker": "me"}))
+        assert ret == 0
+        ret, _ = await b.exec("obj", "lock", "lock", _enc(
+            {"name": "rbd_lock", "locker": "other", "type": "exclusive"}))
+        assert ret == 0
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_cls_lock_shared():
+    async def run():
+        c = _mk()
+        b = c.backend
+        for who in ("r1", "r2"):
+            ret, _ = await b.exec("obj", "lock", "lock", _enc(
+                {"name": "l", "locker": who, "type": "shared"}))
+            assert ret == 0
+        ret, _ = await b.exec("obj", "lock", "lock", _enc(
+            {"name": "l", "locker": "w", "type": "exclusive"}))
+        assert ret == -16
+        ret, out = await b.exec("obj", "lock", "get_info", _enc({"name": "l"}))
+        assert sorted(_dec(out)["lockers"]) == ["r1", "r2"]
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_cls_version_inc_and_check():
+    async def run():
+        c = _mk()
+        b = c.backend
+        ret, out = await b.exec("obj", "version", "inc")
+        assert ret == 0 and _dec(out) == 1
+        ret, out = await b.exec("obj", "version", "get")
+        assert _dec(out) == 1
+        ret, _ = await b.exec("obj", "version", "check", _enc({"ver": 1}))
+        assert ret == 0
+        ret, _ = await b.exec("obj", "version", "check", _enc({"ver": 9}))
+        assert ret == -125  # ECANCELED
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_cls_unknown_method_returns_enoexec():
+    async def run():
+        c = _mk()
+        ret, _ = await c.backend.exec("obj", "nope", "nah")
+        assert ret == -8
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_cls_rbd_header_lifecycle():
+    async def run():
+        c = _mk()
+        b = c.backend
+        ret, _ = await b.exec("rbd_header.img", "rbd", "create", _enc(
+            {"size": 1 << 26, "order": 20}))
+        assert ret == 0
+        ret, _ = await b.exec("rbd_header.img", "rbd", "create", _enc(
+            {"size": 1}))
+        assert ret == -17  # EEXIST
+        ret, out = await b.exec("rbd_header.img", "rbd", "get_metadata")
+        md = _dec(out)
+        assert md["size"] == 1 << 26 and md["order"] == 20
+        ret, out = await b.exec("rbd_header.img", "rbd", "snap_add", _enc(
+            {"name": "s1"}))
+        assert ret == 0 and _dec(out) == 1
+        ret, out = await b.exec("rbd_header.img", "rbd", "get_metadata")
+        assert "s1" in _dec(out)["snaps"]
+        ret, _ = await b.exec("rbd_header.img", "rbd", "snap_remove", _enc(
+            {"name": "s1"}))
+        assert ret == 0
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+# -- watch / notify --------------------------------------------------------
+
+
+def test_watch_notify_ack_roundtrip():
+    async def run():
+        from ceph_tpu.osd.ecbackend import ECBackend
+        from ceph_tpu.osd.placement import CrushPlacement
+
+        c = _mk()
+        got = []
+        await c.backend.watch("obj", lambda oid, p: got.append((oid, p)))
+        # second client watches too
+        placement = CrushPlacement(6, c.ec.get_chunk_count())
+        b2 = ECBackend(c.ec, c.osds, c.messenger, name="client2",
+                       placement=placement)
+        got2 = []
+        await b2.watch("obj", lambda oid, p: got2.append((oid, p)))
+        res = await c.backend.notify("obj", {"event": "resized"})
+        assert sorted(res["acks"]) == ["client", "client2"]
+        assert res["timeouts"] == []
+        assert got == [("obj", {"event": "resized"})]
+        assert got2 == [("obj", {"event": "resized"})]
+        await c.backend.unwatch("obj")
+        res = await b2.notify("obj")
+        assert res["acks"] == ["client2"]
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_notify_timeout_on_dead_watcher():
+    async def run():
+        from ceph_tpu.osd.ecbackend import ECBackend
+        from ceph_tpu.osd.placement import CrushPlacement
+
+        c = _mk()
+        placement = CrushPlacement(6, c.ec.get_chunk_count())
+        b2 = ECBackend(c.ec, c.osds, c.messenger, name="client2",
+                       placement=placement)
+        await b2.watch("obj", lambda oid, p: None)
+        c.messenger.mark_down("client2")  # watcher dies silently
+        res = await c.backend.notify("obj", timeout=0.3)
+        assert res["acks"] == []
+        assert res["timeouts"] == ["client2"]
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+# -- IoCtx sync surface ----------------------------------------------------
+
+
+def test_ioctx_omap_exec_lock_surface():
+    from ceph_tpu.client import Rados
+
+    r = Rados(n_osds=6)
+    io = r.pool_create("meta", {"plugin": "jerasure", "k": "2", "m": "1"})
+    io.omap_set("o", {"x": b"1"})
+    assert io.omap_get("o") == {"x": b"1"}
+    assert io.lock_exclusive("o", "l", "cookie-1") == 0
+    assert io.lock_exclusive("o", "l", "cookie-2") == -16
+    assert io.unlock("o", "l", "cookie-1") == 0
+    r.shutdown()
+
+
+# -- omap at the store tier (all backends) ---------------------------------
+
+
+@pytest.mark.parametrize("kind", ["memstore", "filestore", "kstore"])
+def test_store_omap(kind, tmp_path):
+    from ceph_tpu import objectstore as os_mod
+    from ceph_tpu.osd.types import Transaction
+
+    s = os_mod.create(kind, str(tmp_path / "s"))
+    s.queue_transaction(
+        Transaction().omap_setkeys("o", {"k1": b"v1", "k2": b"v2"})
+    )
+    assert s.omap_get("o") == {"k1": b"v1", "k2": b"v2"}
+    assert s.omap_get("o", ["k2", "nope"]) == {"k2": b"v2"}
+    s.queue_transaction(Transaction().omap_rmkeys("o", ["k1"]))
+    assert s.omap_get("o") == {"k2": b"v2"}
+    s.queue_transaction(Transaction().omap_clear("o"))
+    assert s.omap_get("o") == {}
+    # omap survives remount on persistent stores
+    if kind != "memstore":
+        s.queue_transaction(Transaction().omap_setkeys("o", {"p": b"q"}))
+        s.umount()
+        s2 = os_mod.create(kind, str(tmp_path / "s"))
+        assert s2.omap_get("o") == {"p": b"q"}
+        s2.umount()
+    elif hasattr(s, "umount"):
+        s.umount()

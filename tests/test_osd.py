@@ -460,3 +460,28 @@ def test_cold_primary_recovery_applies_on_target():
         await c.shutdown()
 
     asyncio.run(run())
+
+
+def test_read_detects_stale_minimum_set():
+    """k=2,m=2: if BOTH data shards are stale (their OSDs missed a
+    degraded overwrite), the minimum read set is version-consistent but
+    wrong -- the attr round over all up shards must expose the newer
+    version held by the parity shards."""
+
+    async def run():
+        from ceph_tpu.osd.cluster import ECCluster
+
+        c = ECCluster(8, {"k": "2", "m": "2"})
+        old = b"old-old-old!" * 250
+        new = b"NEW_NEW_NEW!" * 200
+        await c.write("obj", old)
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[0])
+        c.kill_osd(acting[1])  # both data shards go dark
+        await c.write("obj", new)  # commits on the two parity shards only
+        c.revive_osd(acting[0])
+        c.revive_osd(acting[1])
+        assert await c.read("obj") == new, "stale minimum set won the read"
+        await c.shutdown()
+
+    asyncio.run(run())

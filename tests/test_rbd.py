@@ -1,0 +1,219 @@
+"""Striper + RBD image layer (reference: src/osdc/Striper tests, librbd
+test surface reduced to the core image model)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osdc.striper import FileLayout, Striper
+from ceph_tpu.rbd import RBD, Image
+
+
+# -- Striper ---------------------------------------------------------------
+
+
+def test_striper_simple_layout():
+    s = Striper(FileLayout(object_size=1 << 20, stripe_unit=1 << 20,
+                           stripe_count=1))
+    # one object, inside
+    assert s.map_extent(100, 50) == [(0, 100, 50)]
+    # crossing an object boundary
+    ext = s.map_extent((1 << 20) - 10, 20)
+    assert ext == [(0, (1 << 20) - 10, 10), (1, 0, 10)]
+
+
+def test_striper_raid0_round_robin():
+    # 3 objects per set, 64K units, 256K objects -> 4 units per object
+    lo = FileLayout(object_size=256 << 10, stripe_unit=64 << 10,
+                    stripe_count=3)
+    s = Striper(lo)
+    su = 64 << 10
+    # unit u lands on object (u % 3), at offset (u // 3 within set) * su
+    for u in range(12):
+        [(obj, off, ln)] = s.map_extent(u * su, su)
+        assert ln == su
+        assert obj == u % 3
+        assert off == (u // 3) * su
+    # unit 12 starts object set 1 -> objects 3..5
+    [(obj, off, _)] = s.map_extent(12 * su, su)
+    assert (obj, off) == (3, 0)
+
+
+def test_striper_reassembly_covers_everything():
+    lo = FileLayout(object_size=128 << 10, stripe_unit=32 << 10,
+                    stripe_count=2)
+    s = Striper(lo)
+    total = 1_000_000
+    ext = s.map_extent(0, total)
+    assert sum(e[2] for e in ext) == total
+    # coalesced per-object extents must be disjoint and sorted
+    for obj, spans in s.coalesce(ext).items():
+        for (a, al), (b, _) in zip(spans, spans[1:]):
+            assert a + al <= b
+
+
+# -- RBD images ------------------------------------------------------------
+
+
+def _mk():
+    return ECCluster(6, {"k": "2", "m": "1"})
+
+
+def test_rbd_create_list_info_remove():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img1", 1 << 24, order=20)
+        await rbd.create("img2", 1 << 22)
+        assert await rbd.list() == ["img1", "img2"]
+        img = await Image.open(c.backend, "img1")
+        assert img.size == 1 << 24 and img.order == 20
+        with pytest.raises(FileExistsError):
+            await rbd.create("img1", 1)
+        await rbd.remove("img2")
+        assert await rbd.list() == ["img1"]
+        with pytest.raises(FileNotFoundError):
+            await Image.open(c.backend, "img2")
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_rbd_io_across_objects():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        # order 16 -> 64 KiB objects, so a ~200 KiB image spans 4 objects
+        await rbd.create("img", 200 << 10, order=16)
+        img = await Image.open(c.backend, "img")
+        payload = bytes(range(256)) * 300  # 76800 B
+        off = (64 << 10) - 1000  # straddles the object 0/1 boundary
+        await img.write(off, payload)
+        assert await img.read(off, len(payload)) == payload
+        # unwritten regions read as zeros
+        assert await img.read(0, 100) == b"\0" * 100
+        # overwrite inside object 1
+        await img.write(off + 5000, b"X" * 100)
+        got = await img.read(off, len(payload))
+        exp = bytearray(payload)
+        exp[5000:5100] = b"X" * 100
+        assert got == bytes(exp)
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_rbd_write_past_end_rejected():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1000)
+        img = await Image.open(c.backend, "img")
+        with pytest.raises(IOError):
+            await img.write(990, b"x" * 20)
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_rbd_resize_notifies_other_clients():
+    async def run():
+        from ceph_tpu.osd.ecbackend import ECBackend
+        from ceph_tpu.osd.placement import CrushPlacement
+
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20)
+        img = await Image.open(c.backend, "img")
+
+        placement = CrushPlacement(6, c.ec.get_chunk_count())
+        b2 = ECBackend(c.ec, c.osds, c.messenger, name="client2",
+                       placement=placement)
+        img2 = await Image.open(b2, "img")
+        refreshed = asyncio.Event()
+
+        async def on_header(oid, payload):
+            await img2.refresh()
+            refreshed.set()
+
+        await img2.watch_header(on_header)
+        await img.resize(1 << 21)
+        await asyncio.wait_for(refreshed.wait(), 5)
+        assert img2.size == 1 << 21
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_rbd_snapshots_metadata():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20)
+        img = await Image.open(c.backend, "img")
+        sid = await img.snap_create("s1")
+        assert sid == 1
+        assert await img.snap_create("s2") == 2
+        assert img.snap_list() == ["s1", "s2"]
+        await img.snap_remove("s1")
+        assert img.snap_list() == ["s2"]
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_rbd_exclusive_lock():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20)
+        img = await Image.open(c.backend, "img")
+        await img.lock_acquire("client-A")
+        with pytest.raises(BlockingIOError):
+            await img.lock_acquire("client-B")
+        await img.lock_release("client-A")
+        await img.lock_acquire("client-B")
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_rbd_cli_roundtrip(tmp_path, capsys):
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..",
+                                    "tools"))
+    import rbd_cli
+
+    data_path = str(tmp_path / "data")
+    src = tmp_path / "src.bin"
+    dst = tmp_path / "dst.bin"
+    src.write_bytes(bytes(range(256)) * 2000)
+
+    base = ["--data-path", data_path, "--osds", "4"]
+    assert rbd_cli.main(["import", str(src), "disk1", "--order", "16",
+                         *base]) == 0
+    assert rbd_cli.main(["ls", *base]) == 0
+    assert "disk1" in capsys.readouterr().out
+    assert rbd_cli.main(["info", "disk1", *base]) == 0
+    assert rbd_cli.main(["export", "disk1", str(dst), *base]) == 0
+    assert dst.read_bytes() == src.read_bytes()
+
+    asyncio.set_event_loop(asyncio.new_event_loop())
+
+
+def test_striper_object_count_raid0():
+    # object_size=4, su=2, sc=2: 6 bytes = units 0,1,2 -> objects 0,1,0
+    lo = FileLayout(object_size=4, stripe_unit=2, stripe_count=2)
+    s = Striper(lo)
+    assert s.object_count(0) == 0
+    assert s.object_count(1) == 1
+    assert s.object_count(3) == 2   # units 0,1 -> objects 0,1
+    assert s.object_count(6) == 2   # unit 2 wraps back onto object 0
+    assert s.object_count(9) == 3   # unit 4 opens object set 1
+    # exhaustive cross-check against map_extent
+    for total in range(1, 40):
+        touched = {e[0] for e in s.map_extent(0, total)}
+        assert s.object_count(total) == len(touched), total
