@@ -1,0 +1,27 @@
+"""Authentication (reference: src/auth -- cephx).
+
+The reference's cephx protocol: every entity shares a secret with the
+monitors (keyring), proves identity via challenge-response without
+sending the secret, gets a session key, and (with ``ms_sign_messages``)
+signs every message with it.  This module keeps that shape, reduced to
+the two-party case our messenger needs:
+
+* ``KeyRing`` -- entity name -> secret, loadable from the same
+  ``[entity] key = base64`` INI format ceph keyrings use;
+* mutual challenge-response handshake (``AuthHandshake``): both sides
+  prove knowledge of the shared secret via HMAC-SHA256 over the paired
+  nonces; neither secret nor its hash crosses the wire;
+* per-connection session key = HMAC(secret, client_nonce || server_nonce)
+  -- both sides derive it, nothing key-like is transmitted;
+* per-frame signatures (``sign``/``verify``) with the session key -- the
+  ``ms_sign_messages`` role (reference src/auth/cephx/CephxSessionHandler).
+
+Reduction vs the reference (documented): no ticket-granting service /
+rotating tickets -- every entity authenticates straight against the
+shared keyring, i.e. the auth topology of a cephx cluster collapsed to
+one realm.
+"""
+
+from ceph_tpu.auth.cephx import AuthError, AuthHandshake, KeyRing
+
+__all__ = ["KeyRing", "AuthHandshake", "AuthError"]

@@ -24,10 +24,12 @@ def _dec(b):
 @register("rbd", "create")
 async def create(ctx, inp: bytes):
     req = _dec(inp)
-    if (await ctx.omap_get(["size"])).get("size") is not None:
+    # CAS from absent: racing creates get exactly one winner (a plain
+    # get-then-set would let both succeed with interleaved headers)
+    ok, _ = await ctx.omap_cas("size", None, _enc(int(req["size"])))
+    if not ok:
         return -17, b""  # -EEXIST
     await ctx.omap_set({
-        "size": _enc(int(req["size"])),
         "order": _enc(int(req.get("order", 22))),  # 4 MiB objects
         # seq lives INSIDE the snaps blob: snapshot id allocation and the
         # table update are one CAS, so racing snap_adds cannot reuse ids

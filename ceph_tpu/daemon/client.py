@@ -32,12 +32,17 @@ class RemoteClient:
         profile: Dict[str, str],
         name: str = "client",
         hosts=None,
+        keyring=None,
     ) -> "RemoteClient":
         if isinstance(addr_map, str):
             with open(addr_map) as f:
                 addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+        if isinstance(keyring, str):
+            from ceph_tpu.auth import KeyRing
+
+            keyring = KeyRing.load(keyring)
         n_osds = sum(1 for k in addr_map if k.startswith("osd."))
-        messenger = TCPMessenger(name, addr_map)
+        messenger = TCPMessenger(name, addr_map, keyring=keyring)
         await messenger.start()
 
         profile = dict(profile)

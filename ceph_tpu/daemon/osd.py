@@ -28,7 +28,12 @@ async def serve(args) -> None:
     with open(args.addr_map) as f:
         addr_map = {k: tuple(v) for k, v in json.load(f).items()}
     name = f"osd.{args.id}"
-    messenger = TCPMessenger(name, addr_map)
+    keyring = None
+    if args.keyring:
+        from ceph_tpu.auth import KeyRing
+
+        keyring = KeyRing.load(args.keyring)
+    messenger = TCPMessenger(name, addr_map, keyring=keyring)
     await messenger.start()
     OSDShard(
         args.id, messenger, op_queue=args.op_queue,
@@ -51,6 +56,8 @@ def main(argv=None) -> int:
     ap.add_argument("--objectstore", default="memstore")
     ap.add_argument("--data-path", default="")
     ap.add_argument("--op-queue", default="wpq")
+    ap.add_argument("--keyring", default="",
+                    help="keyring file enabling cephx-style auth")
     args = ap.parse_args(argv)
     try:
         asyncio.run(serve(args))

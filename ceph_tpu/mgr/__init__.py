@@ -1,0 +1,19 @@
+"""Manager daemon (reference: src/mgr + src/pybind/mgr).
+
+The reference mgr aggregates daemon state (DaemonServer/ClusterState +
+the mon's PGMap), evaluates health checks, and exports metrics through
+python modules (prometheus, status, ...).  Same roles here:
+
+* ``ClusterState`` -- pulls per-OSD perf counters + store usage and the
+  cluster's liveness/placement view (the PGMap/DaemonState role);
+* ``health_checks`` -- OSD_DOWN / PG_DEGRADED-style checks with the
+  reference's HEALTH_OK/WARN/ERR severities (src/mon/health_check.h);
+* ``prometheus_text`` -- Prometheus exposition (pybind/mgr/prometheus);
+* ``MgrDaemon`` -- an asyncio HTTP endpoint serving /metrics and
+  /health (the mgr module HTTP server role).
+"""
+
+from ceph_tpu.mgr.mgr import ClusterState, MgrDaemon, health_checks, \
+    prometheus_text
+
+__all__ = ["ClusterState", "MgrDaemon", "health_checks", "prometheus_text"]

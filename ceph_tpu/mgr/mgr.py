@@ -1,0 +1,200 @@
+"""Cluster state aggregation, health checks, prometheus exposition."""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+
+class ClusterState:
+    """Aggregated cluster view (PGMap / DaemonStateIndex role)."""
+
+    def __init__(self, cluster):
+        self.cluster = cluster  # ECCluster
+
+    def osd_stats(self) -> Dict[str, dict]:
+        out = {}
+        for osd in self.cluster.osds:
+            store = osd.store
+            objects = store.list_objects()
+            used = 0
+            for oid in objects:
+                try:
+                    used += store.stat(oid)
+                except FileNotFoundError:
+                    pass
+            out[osd.name] = {
+                "up": not self.cluster.messenger.is_down(osd.name),
+                "num_shards": len(objects),
+                "bytes_used": used,
+                "perf": osd.perf.snapshot(),
+                "ops_in_flight":
+                    osd.optracker.dump_ops_in_flight()["num_ops"],
+            }
+        return out
+
+    def pool_stats(self) -> dict:
+        b = self.cluster.backend
+        oids = set()
+        for osd in self.cluster.osds:
+            for soid in osd.store.list_objects():
+                oids.add(soid.rsplit("@", 1)[0])
+        return {
+            "num_objects": len(oids),
+            "k": b.k,
+            "m": b.m,
+            "client_perf": b.perf.snapshot(),
+        }
+
+    def degraded_objects(self) -> List[str]:
+        """Objects with at least one shard on a down/unmapped OSD
+        (the PG_DEGRADED accounting role)."""
+        b = self.cluster.backend
+        degraded = []
+        oids = sorted({
+            soid.rsplit("@", 1)[0]
+            for osd in self.cluster.osds
+            for soid in osd.store.list_objects()
+        })
+        for oid in oids:
+            if oid.endswith("@meta"):
+                continue
+            acting = b.acting_set(oid)
+            if any(not b._shard_up(acting, s) for s in range(b.km)):
+                degraded.append(oid)
+        return degraded
+
+    def dump(self) -> dict:
+        osds = self.osd_stats()
+        n_up = sum(1 for s in osds.values() if s["up"])
+        return {
+            "osdmap": {"num_osds": len(osds), "num_up_osds": n_up},
+            "osd_stats": osds,
+            "pools": self.pool_stats(),
+            "degraded_objects": self.degraded_objects(),
+        }
+
+
+def health_checks(state: dict) -> dict:
+    """Health evaluation (src/mon/health_check.h severities)."""
+    checks = {}
+    osdmap = state["osdmap"]
+    down = osdmap["num_osds"] - osdmap["num_up_osds"]
+    if down:
+        checks["OSD_DOWN"] = {
+            "severity": "HEALTH_WARN",
+            "summary": f"{down} osds down",
+        }
+    degraded = state["degraded_objects"]
+    if degraded:
+        checks["PG_DEGRADED"] = {
+            "severity": "HEALTH_WARN",
+            "summary":
+                f"{len(degraded)} objects have shards on down OSDs",
+        }
+    status = "HEALTH_OK"
+    for c in checks.values():
+        if c["severity"] == "HEALTH_ERR":
+            status = "HEALTH_ERR"
+            break
+        status = "HEALTH_WARN"
+    return {"status": status, "checks": checks}
+
+
+def prometheus_text(state: dict) -> str:
+    """Prometheus exposition (pybind/mgr/prometheus module role)."""
+    lines = [
+        "# HELP ceph_osd_up OSD liveness",
+        "# TYPE ceph_osd_up gauge",
+    ]
+    for name, s in sorted(state["osd_stats"].items()):
+        osd_id = name.split(".")[1]
+        lines.append(f'ceph_osd_up{{ceph_daemon="{name}"}} '
+                     f"{1 if s['up'] else 0}")
+    lines += ["# HELP ceph_osd_bytes_used bytes stored per OSD",
+              "# TYPE ceph_osd_bytes_used gauge"]
+    for name, s in sorted(state["osd_stats"].items()):
+        lines.append(f'ceph_osd_bytes_used{{ceph_daemon="{name}"}} '
+                     f"{s['bytes_used']}")
+    lines += ["# HELP ceph_osd_num_shards shard objects per OSD",
+              "# TYPE ceph_osd_num_shards gauge"]
+    for name, s in sorted(state["osd_stats"].items()):
+        lines.append(f'ceph_osd_num_shards{{ceph_daemon="{name}"}} '
+                     f"{s['num_shards']}")
+    lines += ["# HELP ceph_pool_objects logical objects in the pool",
+              "# TYPE ceph_pool_objects gauge",
+              f"ceph_pool_objects {state['pools']['num_objects']}",
+              "# HELP ceph_degraded_objects objects with shards on down "
+              "OSDs",
+              "# TYPE ceph_degraded_objects gauge",
+              f"ceph_degraded_objects {len(state['degraded_objects'])}"]
+    # per-daemon perf counters, flattened
+    lines += ["# HELP ceph_osd_perf per-OSD perf counters",
+              "# TYPE ceph_osd_perf counter"]
+    for name, s in sorted(state["osd_stats"].items()):
+        for counter, value in sorted(s["perf"].items()):
+            if isinstance(value, (int, float)):
+                lines.append(
+                    f'ceph_osd_perf{{ceph_daemon="{name}",'
+                    f'counter="{counter}"}} {value}'
+                )
+    return "\n".join(lines) + "\n"
+
+
+class MgrDaemon:
+    """HTTP endpoint: /metrics (prometheus), /health, /status (JSON)."""
+
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+        self.state = ClusterState(cluster)
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            request = await reader.readline()
+            while True:  # drain headers
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+            path = request.split()[1].decode() if request.split() else "/"
+            if path == "/metrics":
+                body = prometheus_text(self.state.dump())
+                ctype = "text/plain; version=0.0.4"
+                code = "200 OK"
+            elif path == "/health":
+                import json
+
+                body = json.dumps(health_checks(self.state.dump()))
+                ctype = "application/json"
+                code = "200 OK"
+            elif path == "/status":
+                import json
+
+                body = json.dumps(self.state.dump())
+                ctype = "application/json"
+                code = "200 OK"
+            else:
+                body, ctype, code = "not found\n", "text/plain", "404 Not Found"
+            data = body.encode()
+            writer.write(
+                f"HTTP/1.1 {code}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(data)}\r\n"
+                "Connection: close\r\n\r\n".encode() + data
+            )
+            await writer.drain()
+        finally:
+            writer.close()

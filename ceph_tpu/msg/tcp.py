@@ -30,8 +30,9 @@ from ceph_tpu.msg.wire import decode_message, encode_message
 from ceph_tpu.osd.messenger import FaultInjector
 from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
 
-_PROTOCOL_VERSION = 1
+_PROTOCOL_VERSION = 2
 _BANNER = "ceph-tpu-msgr"
+_SIG_LEN = 16
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
@@ -58,11 +59,16 @@ class TCPMessenger:
         node: str,
         addr_map: Dict[str, Tuple[str, int]],
         fault: Optional[FaultInjector] = None,
+        keyring=None,
     ):
         #: this process's node name; must appear in addr_map for serving
         self.node = node
         self.addr_map = dict(addr_map)
         self.fault = fault or FaultInjector()
+        #: cephx-style auth: when a KeyRing is given, every connection
+        #: must pass the mutual challenge-response handshake and every
+        #: frame is signed with the derived session key (ms_sign_messages)
+        self.keyring = keyring
         self._local_queues: Dict[str, asyncio.Queue] = {}
         self._dispatchers: Dict[str, Callable] = {}
         self._tasks: Dict[str, asyncio.Task] = {}
@@ -88,8 +94,8 @@ class TCPMessenger:
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
-        for _, writer, _ in self._conns.values():
-            writer.close()
+        for conn in self._conns.values():
+            conn[1].close()
         self._conns.clear()
         pending = list(self._tasks.values()) + list(self._serve_tasks)
         for task in pending:
@@ -158,6 +164,15 @@ class TCPMessenger:
             writer.close()  # protocol mismatch: refuse (reference -EXDEV)
             return
         peer_node = dec.string()
+        client_nonce = dec.blob()
+        session_key = None
+        if self.keyring is not None:
+            session_key = await self._auth_accept(
+                reader, writer, peer_node, client_nonce
+            )
+            if session_key is None:
+                writer.close()  # failed handshake: refuse (-EACCES)
+                return
         self._unreachable.discard(peer_node)
         # the peer (re)connected: any cached outgoing connection to it may
         # be a dead socket from its previous incarnation (writes into one
@@ -171,6 +186,14 @@ class TCPMessenger:
             rec = await _read_frame(reader)
             if rec is None:
                 break
+            if session_key is not None:
+                if len(rec) < _SIG_LEN:
+                    break
+                from ceph_tpu.auth.cephx import verify as _verify
+
+                rec, sig = rec[:-_SIG_LEN], rec[-_SIG_LEN:]
+                if not _verify(session_key, rec, sig):
+                    break  # forged/tampered frame: drop the connection
             dec = Decoder(rec)
             src = dec.string()
             dst = dec.string()
@@ -180,6 +203,27 @@ class TCPMessenger:
                 await queue.put((src, msg))
         writer.close()
 
+    async def _auth_accept(self, reader, writer, peer_node: str,
+                           client_nonce: bytes):
+        """Acceptor half of the cephx-style handshake; returns the
+        session key, or None to refuse."""
+        from ceph_tpu.auth.cephx import AuthHandshake
+
+        secret = self.keyring.get(peer_node)
+        if secret is None or not client_nonce:
+            return None  # unknown entity / peer not speaking auth
+        hs = AuthHandshake(secret, client_nonce, AuthHandshake.new_nonce())
+        writer.write(frame(
+            Encoder().blob(hs.server_nonce).blob(hs.server_proof()).bytes()
+        ))
+        await writer.drain()
+        reply = await _read_frame(reader)
+        if reply is None:
+            return None
+        if not hs.verify_client(Decoder(reply).blob()):
+            return None
+        return hs.session_key()
+
     # -- client side -------------------------------------------------------
 
     def _node_of(self, entity: str) -> Optional[str]:
@@ -188,15 +232,46 @@ class TCPMessenger:
         return entity if entity in self.addr_map else None
 
     async def _connect(self, node: str):
+        from ceph_tpu.auth.cephx import AuthHandshake
+
         host, port = self.addr_map[node]
         reader, writer = await asyncio.open_connection(host, port)
+        nonce = AuthHandshake.new_nonce() if self.keyring is not None else b""
         banner = (
             Encoder().string(_BANNER).varint(_PROTOCOL_VERSION)
-            .string(self.node).bytes()
+            .string(self.node).blob(nonce).bytes()
         )
         writer.write(frame(banner))
         await writer.drain()
-        return reader, writer, asyncio.Lock()
+        session_key = None
+        if self.keyring is not None:
+            secret = self.keyring.get(self.node)
+            if secret is None:
+                writer.close()
+                raise OSError(f"no key for {self.node} in keyring")
+            try:
+                # a no-auth peer never answers the handshake: time out
+                # with a clear error instead of hanging every send
+                reply = await asyncio.wait_for(_read_frame(reader), 3.0)
+            except asyncio.TimeoutError:
+                writer.close()
+                raise OSError(
+                    f"{node} did not answer the auth handshake "
+                    "(auth-mode mismatch?)"
+                )
+            if reply is None:
+                writer.close()
+                raise OSError(f"auth refused by {node}")
+            dec = Decoder(reply)
+            server_nonce = dec.blob()
+            hs = AuthHandshake(secret, nonce, server_nonce)
+            if not hs.verify_server(dec.blob()):
+                writer.close()
+                raise OSError(f"{node} failed to prove keyring knowledge")
+            writer.write(frame(Encoder().blob(hs.client_proof()).bytes()))
+            await writer.drain()
+            session_key = hs.session_key()
+        return reader, writer, asyncio.Lock(), session_key
 
     async def send_message(self, src: str, dst: str, msg: object) -> None:
         if src in self._marked_down or dst in self._marked_down:
@@ -219,7 +294,6 @@ class TCPMessenger:
             Encoder().string(src).string(dst)
             .blob(encode_message(msg)).bytes()
         )
-        rec = frame(payload)
         conn = self._conns.get(node)
         if conn is None:
             try:
@@ -229,7 +303,8 @@ class TCPMessenger:
                 return
             self._conns[node] = conn
             self._unreachable.discard(node)
-        _, writer, lock = conn
+        _, writer, lock, skey = conn
+        rec = frame(self._seal(payload, skey))
         async with lock:
             try:
                 writer.write(rec)
@@ -242,11 +317,20 @@ class TCPMessenger:
                 try:
                     conn = await self._connect(node)
                     self._conns[node] = conn
+                    rec = frame(self._seal(payload, conn[3]))
                     conn[1].write(rec)
                     await conn[1].drain()
                     self._unreachable.discard(node)
                 except OSError:
                     self._unreachable.add(node)
+
+    @staticmethod
+    def _seal(payload: bytes, session_key) -> bytes:
+        if session_key is None:
+            return payload
+        from ceph_tpu.auth.cephx import sign
+
+        return payload + sign(session_key, payload)
 
     async def probe(self, entity: str, timeout: float = 1.0) -> bool:
         """Liveness probe: can we (re)connect to the entity's node?

@@ -54,6 +54,19 @@ def shard_oid(oid: str, shard: int) -> str:
     return f"{oid}@{shard}"
 
 
+def meta_vt(v) -> tuple:
+    """Order metadata versions.  Stored/wire form is ``[counter, writer]``
+    (legacy plain ints order as writer "").  The writer name breaks ties
+    when two writers race to the same counter: every replica then picks
+    the SAME winner, so full-state replication converges instead of
+    leaving same-version replicas with different contents."""
+    if v is None:
+        return (0, "")
+    if isinstance(v, int):
+        return (v, "")
+    return (v[0], v[1])
+
+
 #: osd_client_op_priority / osd_recovery_op_priority defaults
 OP_PRIORITY = {"client": 63, "recovery": 10, "scrub": 5}
 
@@ -106,6 +119,9 @@ class OSDShard:
         self.watches: Dict[str, Dict[str, bool]] = {}
         self._notify_seq = 0
         self._notify_pending: Dict[int, tuple] = {}
+        #: OSD-side meta_apply fan-out acks (CAS replication authority)
+        self._meta_tid = 0
+        self._meta_pending: Dict[int, tuple] = {}
         self.optracker = OpTracker()
         self.op_queue_type = op_queue
         if op_queue == "mclock":
@@ -1049,7 +1065,11 @@ class ECBackend:
                     at_version=version,
                 ),
             )
-        await self._await_commits(oid, tid, done, min_acks=1)
+        # resurrection guard: a removal acked by fewer than m+1 shards
+        # could leave >= k same-version chunks on revived OSDs, making a
+        # "removed" object readable again.  m+1 deletions cap survivors
+        # at k-1 (the reference gets this from PG-log replay at peering).
+        await self._await_commits(oid, tid, done, min_acks=self.m + 1)
         self.extent_cache.invalidate(oid)
 
     # -- metadata plane: replicated omap / CAS / watch-notify / cls --------

@@ -125,6 +125,7 @@ class Image:
         return bytes(out)
 
     async def resize(self, new_size: int) -> None:
+        old_size = self.size
         ret, _ = await self.backend.exec(
             _header_oid(self.name), "rbd", "set_size",
             _enc({"size": new_size}),
@@ -132,6 +133,28 @@ class Image:
         if ret != 0:
             raise IOError(f"resize rc={ret}")
         self.size = new_size
+        if new_size < old_size:
+            # trim (librbd shrink semantics): whole objects past the new
+            # end are deleted and the boundary object's tail is zeroed --
+            # otherwise a later regrow would resurface the old bytes
+            osz = 1 << self.order
+            first_dead = (new_size + osz - 1) // osz
+            for object_no in range(first_dead,
+                                   self.striper.object_count(old_size)):
+                try:
+                    await self.backend.remove_object(
+                        _data_oid(self.name, object_no)
+                    )
+                except (FileNotFoundError, IOError):
+                    pass
+            boundary = new_size % osz
+            if boundary:
+                oid = _data_oid(self.name, new_size // osz)
+                obj_size, _ = await self.backend._stat(oid)
+                if obj_size > boundary:
+                    await self.backend.write_range(
+                        oid, boundary, b"\0" * (obj_size - boundary)
+                    )
         # header watchers (other clients with the image open) refresh
         await self.backend.notify(
             _header_oid(self.name), {"event": "resize", "size": new_size},

@@ -1,0 +1,99 @@
+"""mgr: aggregation, health checks, prometheus endpoint (reference:
+src/mgr DaemonServer/ClusterState, mon health checks, pybind/mgr/
+prometheus)."""
+
+import asyncio
+import json
+
+from ceph_tpu.mgr import ClusterState, MgrDaemon, health_checks, \
+    prometheus_text
+from ceph_tpu.osd.cluster import ECCluster
+
+
+def _mk():
+    return ECCluster(6, {"k": "2", "m": "1"})
+
+
+def test_cluster_state_aggregates():
+    async def run():
+        c = _mk()
+        await c.write("a", b"x" * 5000)
+        await c.write("b", b"y" * 3000)
+        state = ClusterState(c).dump()
+        assert state["osdmap"]["num_osds"] == 6
+        assert state["osdmap"]["num_up_osds"] == 6
+        assert state["pools"]["num_objects"] == 2
+        # every byte written lives somewhere
+        total = sum(s["bytes_used"] for s in state["osd_stats"].values())
+        assert total > 8000
+        assert state["degraded_objects"] == []
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_health_transitions_on_osd_down():
+    async def run():
+        c = _mk()
+        await c.write("obj", b"z" * 4000)
+        cs = ClusterState(c)
+        assert health_checks(cs.dump())["status"] == "HEALTH_OK"
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[0])
+        h = health_checks(cs.dump())
+        assert h["status"] == "HEALTH_WARN"
+        assert "OSD_DOWN" in h["checks"]
+        assert "PG_DEGRADED" in h["checks"]
+        c.revive_osd(acting[0])
+        assert health_checks(cs.dump())["status"] == "HEALTH_OK"
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_prometheus_text_shape():
+    async def run():
+        c = _mk()
+        await c.write("obj", b"m" * 2000)
+        text = prometheus_text(ClusterState(c).dump())
+        assert '# TYPE ceph_osd_up gauge' in text
+        assert 'ceph_osd_up{ceph_daemon="osd.0"} 1' in text
+        assert "ceph_pool_objects 1" in text
+        assert "ceph_degraded_objects 0" in text
+        # counters flattened with labels
+        assert 'counter="sub_write"' in text
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_mgr_http_endpoints():
+    async def run():
+        c = _mk()
+        await c.write("obj", b"h" * 1000)
+        mgr = MgrDaemon(c)
+        port = await mgr.start()
+
+        async def get(path):
+            reader, writer = await asyncio.open_connection("127.0.0.1",
+                                                           port)
+            writer.write(f"GET {path} HTTP/1.1\r\n\r\n".encode())
+            await writer.drain()
+            data = await reader.read()
+            writer.close()
+            head, _, body = data.partition(b"\r\n\r\n")
+            return head.decode(), body.decode()
+
+        head, body = await get("/metrics")
+        assert "200 OK" in head
+        assert "ceph_pool_objects 1" in body
+        head, body = await get("/health")
+        assert json.loads(body)["status"] == "HEALTH_OK"
+        head, body = await get("/status")
+        assert json.loads(body)["osdmap"]["num_osds"] == 6
+        head, _ = await get("/nope")
+        assert "404" in head
+        await mgr.stop()
+        await c.shutdown()
+
+    asyncio.run(run())

@@ -49,9 +49,12 @@ def _daemon_env():
 
 
 def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
-                  op_queue="wpq", wait=10.0):
+                  op_queue="wpq", wait=10.0, auth=False):
     """Boot n_osds daemon processes; returns the addr map path.
-    Library entry point used by the CLI and the standalone tests."""
+    Library entry point used by the CLI and the standalone tests.
+    With auth=True a keyring is generated and every connection runs the
+    cephx-style handshake + message signing (vstart.sh enables cephx by
+    default too)."""
     os.makedirs(run_dir, exist_ok=True)
     ports = _free_ports(n_osds + 1)
     addr_map = {f"osd.{i}": ("127.0.0.1", ports[i]) for i in range(n_osds)}
@@ -59,14 +62,22 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
     map_path = os.path.join(run_dir, "addr_map.json")
     with open(map_path, "w") as f:
         json.dump(addr_map, f)
+    if auth:
+        from ceph_tpu.auth import KeyRing
+
+        ring = KeyRing()
+        for entity in addr_map:
+            ring.add(entity)
+        ring.save(os.path.join(run_dir, "keyring"))
     with open(os.path.join(run_dir, "cluster.json"), "w") as f:
         json.dump({"profile": profile, "n_osds": n_osds,
-                   "objectstore": objectstore}, f)
+                   "objectstore": objectstore, "auth": auth}, f)
     data_path = os.path.join(run_dir, "data")
     pids = {}
     for i in range(n_osds):
         pids[i] = spawn_osd(run_dir, i, objectstore=objectstore,
-                            op_queue=op_queue, data_path=data_path)
+                            op_queue=op_queue, data_path=data_path,
+                            auth=auth)
     _save_pids(run_dir, pids)
     # readiness: every daemon's port accepts connections
     deadline = time.time() + wait
@@ -84,18 +95,20 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
 
 
 def spawn_osd(run_dir, osd_id, objectstore="memstore", op_queue="wpq",
-              data_path=None):
+              data_path=None, auth=False):
     """Start (or restart) one OSD daemon process; returns its pid."""
     data_path = data_path or os.path.join(run_dir, "data")
     log = open(os.path.join(run_dir, f"osd.{osd_id}.log"), "ab")
+    cmd = [sys.executable, "-m", "ceph_tpu.daemon.osd",
+           "--id", str(osd_id),
+           "--addr-map", os.path.join(run_dir, "addr_map.json"),
+           "--objectstore", objectstore,
+           "--data-path", data_path,
+           "--op-queue", op_queue]
+    if auth:
+        cmd += ["--keyring", os.path.join(run_dir, "keyring")]
     proc = subprocess.Popen(
-        [sys.executable, "-m", "ceph_tpu.daemon.osd",
-         "--id", str(osd_id),
-         "--addr-map", os.path.join(run_dir, "addr_map.json"),
-         "--objectstore", objectstore,
-         "--data-path", data_path,
-         "--op-queue", op_queue],
-        stdout=log, stderr=log, env=_daemon_env(), cwd=REPO,
+        cmd, stdout=log, stderr=log, env=_daemon_env(), cwd=REPO,
     )
     return proc.pid
 
@@ -133,7 +146,8 @@ def revive_osd(run_dir, osd_id):
         conf = json.load(f)
     pids = _load_pids(run_dir)
     pids[osd_id] = spawn_osd(run_dir, osd_id,
-                             objectstore=conf["objectstore"])
+                             objectstore=conf["objectstore"],
+                             auth=conf.get("auth", False))
     _save_pids(run_dir, pids)
     # wait for the port
     with open(os.path.join(run_dir, "addr_map.json")) as f:
@@ -169,8 +183,12 @@ async def _client(run_dir):
 
     with open(os.path.join(run_dir, "cluster.json")) as f:
         conf = json.load(f)
+    keyring = (
+        os.path.join(run_dir, "keyring") if conf.get("auth") else None
+    )
     c = await RemoteClient.connect(
-        os.path.join(run_dir, "addr_map.json"), conf["profile"]
+        os.path.join(run_dir, "addr_map.json"), conf["profile"],
+        keyring=keyring,
     )
     await c.probe_osds()
     return c
@@ -189,13 +207,16 @@ def main(argv=None):
     ap.add_argument("--m", type=int, default=2)
     ap.add_argument("--plugin", default="jerasure")
     ap.add_argument("--objectstore", default="memstore")
+    ap.add_argument("--auth", action="store_true",
+                    help="enable cephx-style auth (keyring + signing)")
     args = ap.parse_args(argv)
 
     if args.cmd == "start":
         profile = {"plugin": args.plugin, "k": str(args.k), "m": str(args.m)}
         start_cluster(args.dir, args.osds, profile,
-                      objectstore=args.objectstore)
-        print(f"cluster up: {args.osds} osds, profile {profile}")
+                      objectstore=args.objectstore, auth=args.auth)
+        print(f"cluster up: {args.osds} osds, profile {profile}"
+              + (" [cephx auth]" if args.auth else ""))
     elif args.cmd == "stop":
         stop_cluster(args.dir)
         print("stopped")
