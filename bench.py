@@ -1,146 +1,218 @@
-"""North-star benchmark: TPU erasure-code encode+decode throughput.
+"""North-star benchmark: TPU erasure-code throughput at the TOOL surface.
 
-Metric (BASELINE.json): k=8, m=4 reed_sol_van over GF(2^8), 1 MiB chunks.
-We measure device-resident codec throughput (data bytes processed per
-second, GiB/s) for an encode pass plus a 2-erasure decode pass, and compare
-against the CPU reference implementation measured on this host
-(BASELINE.md "Populated-numbers policy": reference numbers are produced
-locally; the native C++ kernels are used when built, else the numpy oracle).
+Round-2 policy (VERDICT.md "Next round" #1): the headline number is the
+honest host-to-host throughput of the `ceph_erasure_code_benchmark`-
+equivalent path -- payload bytes in host memory, parity bytes back in host
+memory, every iteration timed -- NOT a device-resident kernel loop.  The
+batched/pipelined plugin API (`encode_batch`/`decode_batch`,
+ceph_tpu/ops/pipeline.py) is what the tool drives; `tools/ec_benchmark.py
+--batch` reproduces these numbers from the CLI.
+
+Context for the recorded value (PERF_NOTES.md "Transfer ceiling"): on this
+harness the TPU is attached through a network relay whose measured D2H
+bandwidth is ~25-55 MiB/s.  Parity egress is m/k of the data volume, so the
+host-to-host ceiling here is d2h_bw * k/m regardless of codec speed; the
+extra JSON fields report the measured tunnel bandwidths, the implied
+ceiling, the fraction of it we achieve, and the device-resident codec
+throughput (what the same pipeline delivers once transfers are PCIe-class).
 
 Prints ONE JSON line:
-    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N}
-plus a detail line on stderr.
+    {"metric": ..., "value": N, "unit": "GiB/s", "vs_baseline": N, ...}
+plus detail lines on stderr.
 """
 
 from __future__ import annotations
 
-import functools
 import json
 import sys
 import time
 
 import numpy as np
 
+K, M, W = 8, 4, 8
+CHUNK = 1 << 20  # 1 MiB chunks -> 8 MiB payload
+SIZE = K * CHUNK
+BATCH = 8
+ITERS = 3
+ERASURES = [1, 6]  # fixed 2-erasure signature for decode
 
-def _time_chained(step, d, iters=32):
-    """Dependency-chained timing inside one dispatch (lax.scan): each
-    iteration consumes the previous one's output, so overlap/elision cannot
-    inflate the number, and per-dispatch host overhead is amortized away."""
-    import jax
 
-    @jax.jit
-    def many(d):
-        def body(d, _):
-            return step(d), ()
-
-        d, _ = jax.lax.scan(body, d, None, length=iters)
-        return d
-
-    d = many(d)
-    jax.block_until_ready(d)  # warmup + compile
+def _tool_encode_gibps(ec, payload, batch, iters) -> float:
+    want = set(range(ec.get_chunk_count()))
+    if hasattr(ec, "encode_batch"):
+        stripes = [payload] * batch
+        ec.encode_batch(stripes[:1])  # warm: compile + matrix upload
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ec.encode_batch(stripes)
+        dt = time.perf_counter() - t0
+        return iters * batch * payload.nbytes / dt / (1 << 30)
+    ec.encode(want, payload)  # warm tables
     t0 = time.perf_counter()
-    d = many(d)
-    jax.block_until_ready(d)
-    return (time.perf_counter() - t0) / iters
+    for _ in range(iters * batch):
+        ec.encode(want, payload)
+    dt = time.perf_counter() - t0
+    return iters * batch * payload.nbytes / dt / (1 << 30)
 
 
-def main() -> int:
+def _tool_decode_gibps(ec, payload, batch, iters) -> float:
+    want = set(range(ec.get_chunk_count()))
+    encoded = ec.encode(want, payload)
+    chunks = {c: a for c, a in encoded.items() if c not in ERASURES}
+    if hasattr(ec, "decode_batch"):
+        maps = [dict(chunks)] * batch
+        ec.decode_batch(maps[:1])  # warm
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            ec.decode_batch(maps)
+        dt = time.perf_counter() - t0
+        return iters * batch * payload.nbytes / dt / (1 << 30)
+    ec.decode(want, chunks)  # warm
+    t0 = time.perf_counter()
+    for _ in range(iters * batch):
+        ec.decode(want, chunks)
+    dt = time.perf_counter() - t0
+    return iters * batch * payload.nbytes / dt / (1 << 30)
+
+
+def _tunnel_bandwidths() -> tuple:
+    """Measured H2D / D2H GiB/s for fresh 8 MiB random buffers."""
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    jax.device_put(np.ones(16, np.uint8), d).block_until_ready()
+    h2d = []
+    for i in range(2):
+        a = np.random.RandomState(i).randint(0, 256, size=8 << 20, dtype=np.uint8)
+        t0 = time.perf_counter()
+        y = jax.device_put(a, d)
+        y.block_until_ready()
+        h2d.append(8 / 1024 / (time.perf_counter() - t0))
+    gen = jax.jit(
+        lambda i: (jax.random.randint(jax.random.PRNGKey(i), (8 << 20,), 0, 256,
+                                      dtype=jnp.int32) & 255).astype(jnp.uint8)
+    )
+    d2h = []
+    for i in range(2):
+        y = gen(i)
+        y.block_until_ready()
+        t0 = time.perf_counter()
+        np.asarray(y)
+        d2h.append(8 / 1024 / (time.perf_counter() - t0))
+    return max(h2d), max(d2h)
+
+
+def _device_resident_gibps() -> float:
+    """Chained-dependency device-resident codec throughput (the pipeline's
+    compute capability once transfers are PCIe-class; kept as a secondary
+    field, never the headline)."""
     import jax
     import jax.numpy as jnp
 
     from ceph_tpu.matrices import reed_sol
     from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
-    from ceph_tpu.ops import cpu_engine
-    from ceph_tpu.ops.gf import gf
 
     on_tpu = jax.default_backend() == "tpu"
+    Mmat = reed_sol.vandermonde_coding_matrix(K, M, W)
+    bits = matrix_to_bitmatrix(Mmat, W)
+    rng = np.random.RandomState(0)
+    data_np = rng.randint(0, 256, size=(K, 8 * CHUNK)).astype(np.uint8)
+    iters = 32
+
     if on_tpu:
         from ceph_tpu.ops.pallas_gf import _matrix_encode_call, prep_matrix_w8
+
+        Bp = jnp.asarray(prep_matrix_w8(bits, K))
+
+        def step(d32):
+            p = _matrix_encode_call(Bp, d32, K, M, 4096)
+            return d32.at[0, :].set(p[0, :] ^ d32[0, :])
+
+        init = jax.device_put(jnp.asarray(data_np.view(np.int32)))
     else:
         from ceph_tpu.ops.xla_gf import _encode_words_kernel
 
-    k, m, w = 8, 4, 8
-    chunk = 1 << 20  # 1 MiB
-    batch = 8  # stripes fused along the matmul N axis
-    F = gf(w)
-    M = reed_sol.vandermonde_coding_matrix(k, m, w)
-    Bbits = matrix_to_bitmatrix(M, w)
+        Bj = jnp.asarray(bits)
 
-    rng = np.random.RandomState(0)
-    data_np = rng.randint(0, 256, size=(k, batch * chunk)).astype(np.uint8)
-    data_bytes = k * batch * chunk
+        def step(d):
+            p = _encode_words_kernel(Bj, d, W)
+            return d.at[0, :].set(p[0, :] ^ d[0, :])
 
-    def make_step(bits: np.ndarray):
-        rows = bits.shape[0] // 8
-        if on_tpu:
-            Bp = jnp.asarray(prep_matrix_w8(bits, k))
+        init = jax.device_put(jnp.asarray(data_np))
 
-            def step(d32):
-                p = _matrix_encode_call(Bp, d32, k, rows, 4096)
-                return d32.at[0, :].set(p[0, :] ^ d32[0, :])
+    @jax.jit
+    def many(d):
+        def body(c, _):
+            return step(c), ()
 
-            init = jax.device_put(jnp.asarray(data_np.view(np.int32)))
-        else:
-            Bj = jnp.asarray(bits)
+        d, _ = jax.lax.scan(body, d, None, length=iters)
+        return d
 
-            def step(d):
-                p = _encode_words_kernel(Bj, d, w)
-                return d.at[0, :].set(p[0, :] ^ d[0, :])
+    d = many(init)
+    jax.block_until_ready(d)  # warmup + compile
+    t0 = time.perf_counter()
+    d = many(d)
+    jax.block_until_ready(d)
+    dt = (time.perf_counter() - t0) / iters
+    return data_np.nbytes / dt / (1 << 30)
 
-            init = jax.device_put(jnp.asarray(data_np))
-        return step, init
 
-    # ---- encode (chained: parity XORed back into one data row) ----
-    enc_step, data = make_step(Bbits)
-    t_enc = _time_chained(enc_step, data)
-    enc_gibps = data_bytes / t_enc / (1 << 30)
+def main() -> int:
+    import jax
 
-    # ---- decode (2 erasures: reconstruct rows applied to k survivors) ----
-    erased = [1, 6]
-    sel = [i for i in range(k + m) if i not in erased][:k]
-    A = np.zeros((k, k), dtype=np.uint32)
-    for r, cid in enumerate(sel):
-        A[r, :] = M[cid - k, :] if cid >= k else 0
-        if cid < k:
-            A[r, cid] = 1
-    dec_bits = matrix_to_bitmatrix(F.mat_invert(A)[erased, :], w)
-    dec_step, data2 = make_step(dec_bits)
-    t_dec = _time_chained(dec_step, data2)
-    dec_gibps = data_bytes / t_dec / (1 << 30)
+    sys.path.insert(0, __file__.rsplit("/", 1)[0])
+    from ceph_tpu.plugins import registry as registry_mod
 
-    combined = 2 * data_bytes / (t_enc + t_dec) / (1 << 30)
+    registry = registry_mod.instance()
+    registry.disable_dlclose = True
+    profile = {"technique": "reed_sol_van", "k": str(K), "m": str(M)}
+    payload = np.full(SIZE, ord("X"), dtype=np.uint8)  # reference payload
 
-    # ---- CPU baseline (scaled-down run, best-of-3, same semantics) ----
-    cpu_slice = data_np[:, : chunk // 2]
+    # -- TPU plugin at the tool surface (host-to-host, honest) -------------
+    tpu_ec = registry.factory("tpu", dict(profile), "")
+    enc = _tool_encode_gibps(tpu_ec, payload, BATCH, ITERS)
+    dec = _tool_decode_gibps(tpu_ec, payload, BATCH, ITERS)
+    combined = 2 / (1 / enc + 1 / dec)
 
-    def best_of(fn, n=3):
-        times = []
-        fn()  # warm tables/caches
-        for _ in range(n):
-            t0 = time.perf_counter()
-            fn()
-            times.append(time.perf_counter() - t0)
-        return min(times)
-
-    t_cpu = best_of(lambda: cpu_engine.matrix_encode(M, cpu_slice, w))
-    cpu_gibps = cpu_slice.size / t_cpu / (1 << 30)
+    # -- CPU baseline plugin, same surface ---------------------------------
+    cpu_prof = dict(profile)
     try:
-        from ceph_tpu.native import gf_native  # C++ fast path when built
+        from ceph_tpu.native import gf_native  # noqa: F401  C++ fast path
 
-        t_native = best_of(lambda: gf_native.matrix_encode(M, cpu_slice, w))
-        cpu_gibps = max(cpu_gibps, cpu_slice.size / t_native / (1 << 30))
+        cpu_prof["backend"] = "native"
     except Exception:
         pass
+    cpu_ec = registry.factory("jerasure", cpu_prof, "")
+    cpu_enc = _tool_encode_gibps(cpu_ec, payload, BATCH, max(1, ITERS))
+    cpu_dec = _tool_decode_gibps(cpu_ec, payload, BATCH, max(1, ITERS))
+    cpu_combined = 2 / (1 / cpu_enc + 1 / cpu_dec)
+
+    # -- context fields ----------------------------------------------------
+    h2d, d2h = _tunnel_bandwidths()
+    ceiling = d2h * K / M  # parity egress bound for encode
+    dev = _device_resident_gibps()
 
     result = {
-        "metric": "ec_encode_decode_k8m4_1MiB_GiB_s",
+        "metric": "ec_tool_encode_decode_k8m4_1MiB_GiB_s",
         "value": round(combined, 3),
         "unit": "GiB/s",
-        "vs_baseline": round(combined / cpu_gibps, 3) if cpu_gibps else None,
+        "vs_baseline": round(combined / cpu_combined, 3) if cpu_combined else None,
+        "tool_encode_GiBs": round(enc, 3),
+        "tool_decode_GiBs": round(dec, 3),
+        "cpu_plugin_GiBs": round(cpu_combined, 3),
+        "tunnel_h2d_GiBs": round(h2d, 3),
+        "tunnel_d2h_GiBs": round(d2h, 3),
+        "transfer_ceiling_GiBs": round(ceiling, 3),
+        "ceiling_fraction": round(enc / ceiling, 2) if ceiling else None,
+        "device_resident_GiBs": round(dev, 3),
+        "platform": jax.devices()[0].platform,
     }
     print(
-        f"encode {enc_gibps:.2f} GiB/s, decode {dec_gibps:.2f} GiB/s, "
-        f"cpu-ref {cpu_gibps:.2f} GiB/s on {jax.devices()[0].platform}",
+        f"tool-path tpu encode {enc:.3f} / decode {dec:.3f} GiB/s vs cpu "
+        f"{cpu_combined:.3f}; tunnel h2d {h2d:.3f} d2h {d2h:.3f} -> encode "
+        f"ceiling {ceiling:.3f}; device-resident {dev:.1f} GiB/s on "
+        f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )
     print(json.dumps(result))

@@ -4,14 +4,18 @@ A drop-in peer to the jerasure/isa/shec plugins behind the same registry
 (BASELINE.json north_star; reference plugin shape:
 src/erasure-code/jerasure/ErasureCodePluginJerasure.cc): profile
 ``plugin=tpu technique=<any jerasure technique> k=.. m=..`` yields a codec
-whose encode/decode run as bit-sliced GF(2) matmuls on the MXU
-(ceph_tpu/ops/xla_gf.py), bit-exact with the CPU oracle for every technique.
+whose encode/decode run as bit-sliced GF(2) matmuls on the MXU, bit-exact
+with the CPU oracle for every technique.
 
-Beyond the synchronous per-stripe contract, the plugin exposes the batched
-entry points the reference API cannot express (SURVEY.md section 5 "Hard
-parts": sync-API <-> async-device impedance): ``encode_batch`` fuses a whole
-stripe batch into one device dispatch -- stripes are the batch dimension,
-concatenated along the matmul N axis, exactly how the MXU wants them.
+All device work routes through the persistent async pipeline
+(ceph_tpu/ops/pipeline.py): the coding matrix is uploaded once per codec
+instance, every sync encode()/decode() is one fused dispatch, and the
+batched entry points (``encode_batch``/``decode_batch``/``encode_async``)
+stream granules through the device with bounded in-flight depth --
+overlapping host prep, H2D, MXU compute and D2H.  This is the seam the
+reference's synchronous API cannot express (SURVEY.md section 7 step 5) and
+the reason the plugin is benchmarked with ``tools/ec_benchmark.py --batch``
+as well as the reference's per-call loop.
 """
 
 from __future__ import annotations
@@ -21,69 +25,163 @@ from typing import Dict, List, Sequence
 import numpy as np
 
 from ceph_tpu.ops import xla_gf
+from ceph_tpu.ops.pipeline import DeviceCodec, EncodePipeline
 from ceph_tpu.plugins import jerasure as jer
 from ceph_tpu.plugins import registry as registry_mod
 from ceph_tpu.plugins.interface import ErasureCodeProfile
 
 
 class _TpuMixin:
-    """Forces the XLA engine and adds batched entry points."""
+    """Routes codec math through the persistent device pipeline."""
+
+    _device_codec: DeviceCodec | None = None
 
     def _engine(self):
-        return xla_gf
+        return xla_gf  # fallback path for shapes the pipeline can't take
 
-    # -- batched API (TPU extension) --------------------------------------
+    def _dc(self) -> DeviceCodec:
+        if self._device_codec is None:
+            matrix = getattr(self, "matrix", None)
+            bitmatrix = getattr(self, "bitmatrix", None)
+            self._device_codec = DeviceCodec(
+                matrix=matrix,
+                bitmatrix=bitmatrix if matrix is None else None,
+                k=self.k, m=self.m, w=self.w,
+                packetsize=getattr(self, "packetsize", 0),
+            )
+        return self._device_codec
+
+    def _pipeline_ok(self, blocksize: int) -> bool:
+        """The packed-lane kernels want int32 lanes (matrix codes) or whole
+        packet groups (bitmatrix codes); odd sizes fall back to the plain
+        engine path, same bytes either way."""
+        if getattr(self, "matrix", None) is not None:
+            return blocksize % 4 == 0
+        pw = self.w * getattr(self, "packetsize", 0)
+        return pw > 0 and blocksize % pw == 0 and (blocksize // self.w) % 4 == 0
+
+    # -- sync contract (one fused dispatch per call) -----------------------
+
+    def jerasure_encode(self, data: np.ndarray) -> np.ndarray:
+        if self._pipeline_ok(data.shape[1]):
+            return self._dc().encode(np.ascontiguousarray(data))
+        return super().jerasure_encode(data)
+
+    def jerasure_decode(self, have, blocksize):
+        if self._pipeline_ok(blocksize):
+            return self._dc().decode(have, blocksize)
+        return super().jerasure_decode(have, blocksize)
+
+    # -- batched / async API (TPU extension) -------------------------------
 
     def encode_batch(self, stripes: Sequence[bytes | np.ndarray]) -> List[Dict[int, np.ndarray]]:
-        """Encode many equal-length stripes in one device dispatch.
-
-        Each stripe is padded/split exactly like encode(); all stripes must
-        share a length so they share a chunk size.
-        """
+        """Encode many stripes, granule-fused and pipelined: stripes ride
+        the matmul N axis; up to `depth` granules stream through the device
+        concurrently."""
         if not stripes:
             return []
         prepared = [self.encode_prepare(_to_u8(s)) for s in stripes]
         k, m = self.k, self.m
         blocksize = len(prepared[0][0])
-        nb = len(prepared)
-        # stack: [k, nb * blocksize] -- stripes ride the matmul N axis
-        data = np.stack(
-            [np.concatenate([p[j] for p in prepared]) for j in range(k)]
-        )
-        coding = self.jerasure_encode(data)  # [m, nb*blocksize]
-        out: List[Dict[int, np.ndarray]] = []
-        for s in range(nb):
-            enc = dict(prepared[s])
+        if not self._pipeline_ok(blocksize):
+            out = []
+            for p in prepared:
+                data = np.stack([p[j] for j in range(k)])
+                coding = super().jerasure_encode(data)
+                enc = dict(p)
+                for i in range(m):
+                    enc[k + i][:] = coding[i]
+                out.append(enc)
+            return out
+        pipe = EncodePipeline(self._dc().encode_stream())
+        tickets = [
+            pipe.submit(np.stack([p[j] for j in range(k)])) for p in prepared
+        ]
+        pipe.flush()
+        out = []
+        for p, t in zip(prepared, tickets):
+            coding = pipe.result(t)
+            enc = dict(p)
             for i in range(m):
-                enc[k + i] = coding[i, s * blocksize : (s + 1) * blocksize]
+                enc[k + i] = coding[i]
             out.append(enc)
         return out
+
+    def encode_async(self, data: bytes | np.ndarray):
+        """Submit one stripe for encoding; returns a zero-arg callable that
+        blocks until the parity lands and returns the full chunk map.  The
+        async-completion face of the reference's sync encode()."""
+        prepared = self.encode_prepare(_to_u8(data))
+        k, m = self.k, self.m
+        blocksize = len(prepared[0])
+        if not self._pipeline_ok(blocksize):
+            result = self.encode(set(range(k + m)), data)
+            return lambda: result
+        if getattr(self, "_shared_pipe", None) is None:
+            self._shared_pipe = EncodePipeline(self._dc().encode_stream())
+        pipe = self._shared_pipe
+        ticket = pipe.submit(np.stack([prepared[j] for j in range(k)]))
+
+        def wait() -> Dict[int, np.ndarray]:
+            coding = pipe.result(ticket)
+            enc = dict(prepared)
+            for i in range(m):
+                enc[k + i] = coding[i]
+            return enc
+
+        return wait
+
+    def flush_async(self) -> None:
+        pipe = getattr(self, "_shared_pipe", None)
+        if pipe is not None:
+            pipe.flush()
 
     def decode_batch(
         self,
         chunk_maps: Sequence[Dict[int, np.ndarray]],
     ) -> List[Dict[int, np.ndarray]]:
-        """Reconstruct every stripe; stripes sharing an erasure signature are
-        fused into one device dispatch (the ISA-L decode-table-LRU analogue:
-        one host inversion covers the whole signature group)."""
+        """Reconstruct every stripe; stripes sharing an erasure signature
+        share one reconstruction matrix (decode-stream LRU) and ride the
+        same pipelined granule stream."""
         if not chunk_maps:
             return []
+        km = self.k + self.m
         groups: Dict[tuple, List[int]] = {}
         for idx, cm in enumerate(chunk_maps):
             groups.setdefault(tuple(sorted(cm.keys())), []).append(idx)
         results: List[Dict[int, np.ndarray]] = [None] * len(chunk_maps)  # type: ignore
         for sig, idxs in groups.items():
             blocksize = len(next(iter(chunk_maps[idxs[0]].values())))
-            fused = {
-                cid: np.concatenate([chunk_maps[i][cid] for i in idxs])
-                for cid in sig
-            }
-            rec = self.jerasure_decode(fused, blocksize * len(idxs))
+            erased = [i for i in range(km) if i not in sig]
+            if not erased:
+                for i in idxs:
+                    results[i] = {
+                        c: np.asarray(a, dtype=np.uint8)
+                        for c, a in chunk_maps[i].items()
+                    }
+                continue
+            if not self._pipeline_ok(blocksize):
+                for i in idxs:
+                    results[i] = super().jerasure_decode(
+                        dict(chunk_maps[i]), blocksize
+                    )
+                continue
+            sel, stream = self._dc().decode_stream(list(sig), erased)
+            pipe = EncodePipeline(stream)
+            tickets = [
+                pipe.submit(np.stack([chunk_maps[i][c] for c in sel]))
+                for i in idxs
+            ]
+            pipe.flush()
             for pos, i in enumerate(idxs):
-                results[i] = {
-                    cid: arr[pos * blocksize : (pos + 1) * blocksize]
-                    for cid, arr in rec.items()
+                rec = pipe.result(tickets[pos])
+                full = {
+                    c: np.asarray(a, dtype=np.uint8)
+                    for c, a in chunk_maps[i].items()
                 }
+                for j, e in enumerate(erased):
+                    full[e] = rec[j]
+                results[i] = full
         return results
 
 

@@ -1,0 +1,241 @@
+"""Async device pipeline: bit-exactness + pipelining semantics.
+
+The pipeline (ceph_tpu/ops/pipeline.py) is the stripe-batching shim of
+SURVEY.md section 7 step 5; these tests pin its bytes to the CPU oracle for
+matrix and packetized techniques, exercise granule fusing / flush / ticket
+ordering, and cover the plugin-level batched API end to end.
+"""
+
+import numpy as np
+import pytest
+
+from ceph_tpu.matrices import reed_sol
+from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
+from ceph_tpu.ops import cpu_engine
+from ceph_tpu.ops.pipeline import (
+    DeviceCodec,
+    EncodePipeline,
+    bitmatrix_reconstruct_rows,
+    matrix_reconstruct_rows,
+)
+from ceph_tpu.plugins import registry as registry_mod
+
+
+def _rng(seed=0):
+    return np.random.RandomState(seed)
+
+
+def test_device_codec_encode_matches_cpu_oracle():
+    k, m, w = 4, 2, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    data = _rng(1).randint(0, 256, size=(k, 4096), dtype=np.uint8)
+    want = cpu_engine.matrix_encode(M, data, w)
+    got = dc.encode(data)
+    np.testing.assert_array_equal(got, want)
+
+
+@pytest.mark.parametrize("erased", [[0], [1, 4], [2, 5], [4, 5]])
+def test_device_codec_decode_all_erasure_kinds(erased):
+    k, m, w = 4, 2, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    data = _rng(2).randint(0, 256, size=(k, 1024), dtype=np.uint8)
+    coding = cpu_engine.matrix_encode(M, data, w)
+    full = {i: data[i] for i in range(k)} | {k + i: coding[i] for i in range(m)}
+    have = {i: a for i, a in full.items() if i not in erased}
+    out = dc.decode(have, 1024)
+    for i in range(k + m):
+        np.testing.assert_array_equal(out[i], full[i], err_msg=f"chunk {i}")
+
+
+def test_pipeline_granule_fusing_and_ticket_order():
+    k, m, w = 4, 2, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    pipe = EncodePipeline(dc.encode_stream(), depth=2, max_granule=1 << 14)
+    rng = _rng(3)
+    stripes = [rng.randint(0, 256, size=(k, 2048), dtype=np.uint8)
+               for _ in range(23)]
+    tickets = [pipe.submit(s) for s in stripes]
+    pipe.flush()
+    # out-of-order result retrieval must still return the right stripe
+    for t, s in sorted(zip(tickets, stripes), key=lambda x: -x[0]):
+        want = cpu_engine.matrix_encode(M, s, w)
+        np.testing.assert_array_equal(pipe.result(t), want)
+
+
+def test_pipeline_mixed_stripe_sizes():
+    k, m, w = 2, 2, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    pipe = EncodePipeline(dc.encode_stream())
+    sizes = [64, 4096, 128, 65536]
+    stripes = [_rng(i).randint(0, 256, size=(k, s), dtype=np.uint8)
+               for i, s in enumerate(sizes)]
+    outs = pipe.encode_many(stripes)
+    for s, o in zip(stripes, outs):
+        np.testing.assert_array_equal(o, cpu_engine.matrix_encode(M, s, w))
+
+
+def test_pipeline_stripe_larger_than_max_granule():
+    """Oversized stripes split into column segments and reassemble exactly."""
+    k, m, w = 2, 2, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    pipe = EncodePipeline(dc.encode_stream(), max_granule=1 << 14)
+    s = _rng(11).randint(0, 256, size=(k, 3 * (1 << 14) + 256), dtype=np.uint8)
+    out = pipe.result(pipe.submit(s))
+    np.testing.assert_array_equal(out, cpu_engine.matrix_encode(M, s, w))
+
+
+def test_pipeline_overflow_accumulation_splits_granules():
+    """Pending stripes crossing the granule cap dispatch in multiple
+    granules instead of overflowing the assembly buffer."""
+    k, m, w = 2, 1, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    pipe = EncodePipeline(dc.encode_stream(), max_granule=1 << 14)
+    stripes = [_rng(20 + i).randint(0, 256, size=(k, 3 << 12), dtype=np.uint8)
+               for i in range(6)]  # 6 x 12 KiB rows vs 16 KiB cap
+    outs = pipe.encode_many(stripes)
+    for s, o in zip(stripes, outs):
+        np.testing.assert_array_equal(o, cpu_engine.matrix_encode(M, s, w))
+
+
+def test_pipeline_discard_releases_state():
+    k, m, w = 2, 1, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    dc = DeviceCodec(matrix=M, k=k, m=m, w=w)
+    pipe = EncodePipeline(dc.encode_stream())
+    t1 = pipe.submit(_rng(30).randint(0, 256, size=(k, 1024), dtype=np.uint8))
+    s2 = _rng(31).randint(0, 256, size=(k, 1024), dtype=np.uint8)
+    t2 = pipe.submit(s2)
+    pipe.discard(t1)
+    pipe.drain()
+    assert t1 not in pipe._done and t1 not in pipe._need
+    np.testing.assert_array_equal(
+        pipe.result(t2), cpu_engine.matrix_encode(M, s2, w)
+    )
+    assert not pipe._done and not pipe._parts
+
+
+def test_matrix_reconstruct_rows_covers_parity_chunks():
+    k, m, w = 4, 2, 8
+    M = reed_sol.vandermonde_coding_matrix(k, m, w)
+    data = _rng(5).randint(0, 256, size=(k, 256), dtype=np.uint8)
+    coding = cpu_engine.matrix_encode(M, data, w)
+    full = np.concatenate([data, coding])
+    erased = [1, 5]
+    available = [i for i in range(k + m) if i not in erased]
+    sel, rows = matrix_reconstruct_rows(M, k, m, w, available, erased)
+    survivors = np.stack([full[c] for c in sel])
+    rec = cpu_engine.matrix_encode(rows, survivors, w)
+    for i, e in enumerate(erased):
+        np.testing.assert_array_equal(rec[i], full[e])
+
+
+def test_bitmatrix_reconstruct_rows_covers_parity_chunks():
+    k, m, w, ps = 3, 2, 4, 8
+    from ceph_tpu.matrices import cauchy
+
+    M = cauchy.good_general_coding_matrix(k, m, w)
+    B = matrix_to_bitmatrix(M, w)
+    bs = k * w * ps * 4
+    data = _rng(6).randint(0, 256, size=(k, bs), dtype=np.uint8)
+    coding = cpu_engine.bitmatrix_encode(B, data, w, ps)
+    full = np.concatenate([data, coding])
+    erased = [0, 4]
+    available = [i for i in range(k + m) if i not in erased]
+    sel, rows = bitmatrix_reconstruct_rows(B, k, m, w, available, erased)
+    assert sel == available[:k] and rows.shape == (len(erased) * w, k * w)
+    dc = DeviceCodec(bitmatrix=B, k=k, m=m, w=w, packetsize=ps)
+    have = {c: full[c] for c in available}
+    out = dc.decode(have, bs)
+    for e in erased:
+        np.testing.assert_array_equal(out[e], full[e], err_msg=f"chunk {e}")
+
+
+@pytest.mark.parametrize("technique,params", [
+    ("reed_sol_van", {"k": "4", "m": "2"}),
+    ("reed_sol_van", {"k": "4", "m": "2", "w": "16"}),
+    ("cauchy_good", {"k": "4", "m": "2", "packetsize": "64"}),
+    ("liber8tion", {"k": "4", "packetsize": "64"}),
+])
+def test_plugin_batch_roundtrip_bit_exact(technique, params):
+    registry = registry_mod.instance()
+    profile = dict(params, technique=technique)
+    tpu = registry.factory("tpu", dict(profile), "")
+    jer = registry.factory("jerasure", dict(profile), "")
+    size = 1 << 15
+    rng = _rng(7)
+    stripes = [rng.randint(0, 256, size=size, dtype=np.uint8)
+               for _ in range(5)]
+    batch = tpu.encode_batch(stripes)
+    for s, enc in zip(stripes, batch):
+        ref = jer.encode(set(range(jer.get_chunk_count())), s)
+        assert set(enc) == set(ref)
+        for c in ref:
+            np.testing.assert_array_equal(enc[c], ref[c], err_msg=f"chunk {c}")
+    # decode_batch across varied signatures
+    km = tpu.get_chunk_count()
+    maps, wants = [], []
+    for i, enc in enumerate(batch):
+        cm = {c: np.asarray(a) for c, a in enc.items()}
+        for e in [(i % km), ((i + 3) % km)]:
+            cm.pop(e, None)
+        maps.append(cm)
+        wants.append(enc)
+    recs = tpu.decode_batch(maps)
+    for rec, want in zip(recs, wants):
+        for c in range(km):
+            np.testing.assert_array_equal(rec[c], want[c], err_msg=f"chunk {c}")
+
+
+def test_plugin_sync_encode_still_bit_exact_odd_size():
+    """Odd payload sizes route through the fallback path, same bytes."""
+    registry = registry_mod.instance()
+    tpu = registry.factory("tpu", {"technique": "reed_sol_van", "k": "3", "m": "2"}, "")
+    jer = registry.factory("jerasure", {"technique": "reed_sol_van", "k": "3", "m": "2"}, "")
+    payload = _rng(8).randint(0, 256, size=1000, dtype=np.uint8)
+    want = set(range(5))
+    a = tpu.encode(want, payload)
+    b = jer.encode(want, payload)
+    for c in b:
+        np.testing.assert_array_equal(a[c], b[c])
+
+
+def test_encode_async_completion():
+    registry = registry_mod.instance()
+    tpu = registry.factory("tpu", {"technique": "reed_sol_van", "k": "2", "m": "1"}, "")
+    payloads = [_rng(i).randint(0, 256, size=4096, dtype=np.uint8)
+                for i in range(4)]
+    waits = [tpu.encode_async(p) for p in payloads]
+    tpu.flush_async()
+    jer = registry.factory("jerasure", {"technique": "reed_sol_van", "k": "2", "m": "1"}, "")
+    for p, wfn in zip(payloads, waits):
+        enc = wfn()
+        ref = jer.encode(set(range(3)), p)
+        for c in ref:
+            np.testing.assert_array_equal(enc[c], ref[c])
+
+
+def test_benchmark_tool_batch_mode(capsys):
+    import tools.ec_benchmark as bench
+
+    rc = bench.main([
+        "--plugin", "tpu", "--workload", "encode", "--size", "16384",
+        "--iterations", "2", "--batch", "4",
+        "--parameter", "k=2", "--parameter", "m=1",
+    ])
+    assert rc == 0
+    outp = capsys.readouterr().out.strip().splitlines()[-1]
+    secs, kib = outp.split("\t")
+    assert float(secs) > 0
+    assert int(kib) == 2 * 4 * 16
+    rc = bench.main([
+        "--plugin", "tpu", "--workload", "decode", "--size", "16384",
+        "--iterations", "1", "--batch", "3", "--erasures", "1",
+        "--parameter", "k=2", "--parameter", "m=1",
+    ])
+    assert rc == 0
