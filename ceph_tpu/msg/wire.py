@@ -73,7 +73,8 @@ def encode_message(msg: object) -> bytes:
         enc.u8(_MSG_EC_SUB_WRITE)
         enc.varint(msg.from_shard).varint(msg.tid).string(msg.oid)
         encode_transaction(enc, msg.transaction)
-        enc.varint(msg.at_version)
+        enc.value(tuple(msg.at_version) if isinstance(
+            msg.at_version, (tuple, list)) else msg.at_version)
         enc.varint(len(msg.log_entries))
         for e in msg.log_entries:
             _encode_log_entry(enc, e)
@@ -82,6 +83,8 @@ def encode_message(msg: object) -> bytes:
         enc.u8(_MSG_EC_SUB_WRITE_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
         enc.value(msg.committed).value(msg.applied)
+        enc.value(tuple(msg.current_version) if isinstance(
+            msg.current_version, (tuple, list)) else msg.current_version)
     elif isinstance(msg, ECSubRead):
         enc.u8(_MSG_EC_SUB_READ)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -114,7 +117,7 @@ def decode_message(data: bytes) -> object:
         tid = dec.varint()
         oid = dec.string()
         txn = decode_transaction(dec)
-        at_version = dec.varint()
+        at_version = dec.value()
         entries = [_decode_log_entry(dec) for _ in range(dec.varint())]
         return ECSubWrite(
             from_shard=from_shard, tid=tid, oid=oid, transaction=txn,
@@ -125,6 +128,7 @@ def decode_message(data: bytes) -> object:
         return ECSubWriteReply(
             from_shard=dec.varint(), tid=dec.varint(),
             committed=dec.value(), applied=dec.value(),
+            current_version=dec.value(),
         )
     if kind == _MSG_EC_SUB_READ:
         return ECSubRead(

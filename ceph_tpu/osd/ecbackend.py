@@ -54,17 +54,23 @@ def shard_oid(oid: str, shard: int) -> str:
     return f"{oid}@{shard}"
 
 
-def meta_vt(v) -> tuple:
-    """Order metadata versions.  Stored/wire form is ``[counter, writer]``
-    (legacy plain ints order as writer "").  The writer name breaks ties
-    when two writers race to the same counter: every replica then picks
-    the SAME winner, so full-state replication converges instead of
-    leaving same-version replicas with different contents."""
+def vt(v) -> tuple:
+    """Order object/metadata versions.  Stored/wire form is
+    ``(counter, writer)`` (legacy plain ints order as writer "").  The
+    writer name breaks ties when two primaries race to the same counter:
+    every shard/replica then picks the SAME winner and two writes can
+    never share a version, so a read-time consistent cut cannot mix
+    chunks from different writes (the role the reference gets from one
+    primary OSD serializing the PG, src/osd/ECBackend.h:522-573)."""
     if v is None:
         return (0, "")
     if isinstance(v, int):
         return (v, "")
     return (v[0], v[1])
+
+
+#: backward-compatible name (the metadata plane used this first)
+meta_vt = vt
 
 
 #: osd_client_op_priority / osd_recovery_op_priority defaults
@@ -110,11 +116,14 @@ class OSDShard:
         self.messenger = messenger
         self.perf = PerfCounters(f"osd.{osd_id}")
         self.pglog = PGLog()
-        #: per-shard-object applied version: the QoS queue may legally
-        #: reorder a low-priority recovery push behind a newer client
-        #: write, so applies are version-gated (reference: recovery pushes
-        #: carry the object version and PG logic discards stale ones)
-        self._applied_version: Dict[str, int] = {}
+        #: per-shard-object applied version tuple (counter, writer): the
+        #: QoS queue may legally reorder a low-priority recovery push
+        #: behind a newer client write, and racing primaries may deliver
+        #: writes out of version order, so applies are version-gated
+        #: (reference: recovery pushes carry the object version and PG
+        #: logic discards stale ones; primaries racing is impossible in
+        #: the reference because one primary OSD serializes a PG)
+        self._applied_version: Dict[str, tuple] = {}
         #: watch/notify state (reference src/osd/Watch.cc): oid -> watchers
         self.watches: Dict[str, Dict[str, bool]] = {}
         self._notify_seq = 0
@@ -378,26 +387,40 @@ class OSDShard:
         from ceph_tpu.osd.pglog import PGLogEntry
 
         soid = shard_oid(msg.oid, msg.from_shard)
-        if msg.at_version < self._applied_version.get(soid, 0):
+        new_vt = vt(msg.at_version)
+        cur_vt = vt(self._applied_version.get(soid))
+        if new_vt < cur_vt:
             # dequeued behind a newer write to the same object (priority
-            # reordering): applying would clobber newer bytes with stale
-            # ones.  Ack without applying -- the shard holds the newer data.
+            # reordering or a racing primary).  Applying would clobber
+            # newer bytes with stale ones.
             self.perf.inc("sub_write_stale")
-            reply = ECSubWriteReply(
-                from_shard=msg.from_shard, tid=msg.tid,
-                committed=True, applied=False,
-            )
+            if msg.op_class == "client":
+                # a racing client write lost: refuse loudly so the writer
+                # retries at a higher version instead of believing a
+                # commit that never applied (split-brain fix)
+                reply = ECSubWriteReply(
+                    from_shard=msg.from_shard, tid=msg.tid,
+                    committed=False, applied=False,
+                    current_version=cur_vt,
+                )
+            else:
+                # a recovery/scrub push made obsolete by a newer client
+                # write is genuinely done: the shard holds newer data
+                reply = ECSubWriteReply(
+                    from_shard=msg.from_shard, tid=msg.tid,
+                    committed=True, applied=False,
+                )
             await self.messenger.send_message(self.name, src, reply)
             return
-        self._applied_version[soid] = msg.at_version
+        self._applied_version[soid] = new_vt
         try:
             prior = self.store.stat(soid)
         except FileNotFoundError:
             prior = 0
-        if msg.at_version > self.pglog.head_version:
+        if new_vt[0] > self.pglog.head_version:
             self.pglog.append(
                 PGLogEntry(
-                    version=msg.at_version,
+                    version=new_vt[0],
                     oid=soid,
                     op="append",
                     prior_size=prior,
@@ -454,6 +477,22 @@ class OSDShard:
         await self.messenger.send_message(self.name, src, reply)
 
 
+class WriteConflict(IOError):
+    """A shard refused a client write as stale: a racing primary committed
+    a newer version first.  Carries the winning version tuple."""
+
+    def __init__(self, winner: tuple):
+        super().__init__(f"write lost to concurrent version {winner}")
+        self.winner = winner
+
+
+class ObjectIncomplete(IOError):
+    """The newest observed version might have been acked but cannot
+    assemble k chunks from up shards — serving an older version would be a
+    read-after-ack consistency violation (the reference's peering would
+    block or mark the PG incomplete, src/osd/PG.cc)."""
+
+
 class ECBackend:
     """Primary-side engine: placement, write pipeline, read/reconstruct."""
 
@@ -486,6 +525,13 @@ class ECBackend:
         from ceph_tpu.osd.extent_cache import ExtentCache
 
         self.extent_cache = ExtentCache()
+        #: per-object write mutex: version-assignment + fan-out + commit
+        #: wait run under it, so writes to one object from this primary
+        #: complete in version order (the reference's in-order write
+        #: pipeline, ECBackend.h:522-541).  Without it two disjoint-extent
+        #: RMWs could interleave across awaits and a shard could apply
+        #: them newest-first, silently discarding the older one's extent.
+        self._oid_locks: Dict[str, asyncio.Lock] = {}
         #: replicated-metadata version sequence per oid (meta plane is
         #: versioned separately from the chunk plane)
         self._meta_versions: Dict[str, int] = {}
@@ -576,6 +622,15 @@ class ECBackend:
             state = self._pending.get(msg.tid)
             if state is None:
                 return
+            if not msg.committed and msg.current_version is not None:
+                # stale-write refusal: a racing primary won this object.
+                # Fail the op now so the writer retries at a higher
+                # version; waiting out the commit quorum would hang.
+                if not state["done"].done():
+                    state["done"].set_exception(
+                        WriteConflict(vt(msg.current_version))
+                    )
+                return
             if msg.committed:
                 state["committed"].add(src)
             if state["committed"] >= state["expected"]:
@@ -590,27 +645,54 @@ class ECBackend:
             if not state["outstanding"] and not state["done"].done():
                 state["done"].set_result(True)
 
+    def _object_lock(self, oid: str) -> asyncio.Lock:
+        lock = self._oid_locks.get(oid)
+        if lock is None:
+            lock = self._oid_locks[oid] = asyncio.Lock()
+        return lock
+
+    def _next_version(self, oid: str) -> tuple:
+        """pg-wide dense version counter + this primary's name: the
+        eversion analogue with a writer tiebreak (see vt())."""
+        counter = max(self._versions.values(), default=0) + 1
+        self._versions[oid] = counter
+        return (counter, self.name)
+
+    def _learn_version(self, oid: str, seen: tuple) -> None:
+        if seen[0] > self._versions.get(oid, 0):
+            self._versions[oid] = seen[0]
+
+    _WRITE_RETRIES = 4
+
     async def write(self, oid: str, data: bytes) -> None:
         """Append-only full-object write (create or replace)."""
-        # full-object replace conflicts with any in-flight RMW on the object
-        async with self.extent_cache.pin(oid, 0, 1 << 62):
-            try:
-                await self._write_pinned(oid, data)
-            finally:
-                # invalidate even on a partial/failed replace: some shards
-                # may have applied, so cached pre-replace bytes are stale
-                self.extent_cache.invalidate(oid)
+        # serialize writes per object (in-order pipeline) and conflict with
+        # any in-flight RMW on the object via the whole-object pin
+        async with self._object_lock(oid):
+            for attempt in range(self._WRITE_RETRIES):
+                async with self.extent_cache.pin(oid, 0, 1 << 62):
+                    try:
+                        await self._write_pinned(oid, data)
+                        return
+                    except WriteConflict as wc:
+                        # a racing primary committed a newer version; adopt
+                        # its counter and replay ours on top
+                        self._learn_version(oid, wc.winner)
+                        self.perf.inc("write_conflict_retry")
+                    finally:
+                        # invalidate even on a partial/failed replace: some
+                        # shards may have applied, so cached pre-replace
+                        # bytes are stale
+                        self.extent_cache.invalidate(oid)
+            raise IOError(f"write {oid}: lost {self._WRITE_RETRIES} races")
 
     async def _write_pinned(self, oid: str, data: bytes) -> None:
         # a primary that has never touched this object must learn its
         # current version first: overwriting with a regressed version
-        # would be silently discarded by the shards' stale-write gate
+        # would be refused by the shards' stale-write gate
         if oid not in self._versions:
             await self._stat(oid)
-        # pg-wide dense version (the eversion analogue): shards log every
-        # write in order so divergence is detectable and rollbackable
-        version = max(self._versions.values(), default=0) + 1
-        self._versions[oid] = version
+        version = self._next_version(oid)
         logical = len(data)
         padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
         buf = np.zeros(padded_len, dtype=np.uint8)
@@ -642,7 +724,8 @@ class ECBackend:
             "expected": {f"osd.{acting[s]}" for s in up},
             "done": done,
         }
-        entry = LogEntry(version=version, oid=oid, op="append", prior_size=0)
+        entry = LogEntry(version=version[0], oid=oid, op="append",
+                         prior_size=0)
         self.log.append(entry)
         for s in range(self.km):
             if acting[s] is None:
@@ -746,7 +829,8 @@ class ECBackend:
     def _collect_read(replies, oid, chunks, versions, sizes, failed,
                       hinfos=None) -> None:
         """Merge one _read_shards round into per-shard chunk/version/size
-        maps (absent VERSION_KEY decodes as 0: pre-versioning objects)."""
+        maps (absent VERSION_KEY decodes as vt(0): pre-versioning or
+        never-written objects)."""
         for s, reply in replies.items():
             if oid in reply.errors:
                 failed.append(s)
@@ -759,11 +843,11 @@ class ECBackend:
                 sizes[s] = attrs[SIZE_KEY]
             if hinfos is not None and attrs.get(ecutil.HINFO_KEY) is not None:
                 hinfos[s] = attrs[ecutil.HINFO_KEY]
-            versions[s] = attrs.get(VERSION_KEY) or 0
+            versions[s] = vt(attrs.get(VERSION_KEY))
 
     async def _gather_consistent(
         self, oid, shards, acting, extents=None, op_class="client",
-        up_shards=None,
+        up_shards=None, allow_incomplete=False,
     ):
         """Version-authoritative gather, shared by read / read_range /
         recovery so the staleness rules cannot diverge between them.
@@ -772,17 +856,23 @@ class ECBackend:
         attrs from EVERY other up shard -- the minimum data set alone
         cannot establish the authoritative version (it might consist
         entirely of same-version stale shards that missed a degraded
-        write).  Then candidate versions are tried newest-complete first:
-        missing chunks of the candidate are fetched and, if >= k line up,
-        that version wins; otherwise fall back (log-rollback semantics
-        for writes that died mid-flight).
-        Returns (chunks, size_hint, hinfo_hint, version)."""
+        write).  Versions are tried newest first.  A version that cannot
+        assemble k chunks is skipped ONLY if it provably was never acked
+        (its up holders plus every unreachable shard still total < k
+        commits — a write that died mid-flight below min_size; log
+        rollback semantics).  If it MIGHT have been acked, the object is
+        reported incomplete instead of silently serving older data — the
+        read-after-ack guarantee (the reference's peering would block or
+        mark the PG incomplete rather than answer).  Recovery passes
+        ``allow_incomplete`` to reconstruct the newest assemblable
+        version (its job is exactly to repair such objects).
+        Returns (chunks, size_hint, hinfo_hint, version_tuple)."""
         if up_shards is None:
             up_shards = [
                 s for s in range(self.km) if self._shard_up(acting, s)
             ]
         chunks: Dict[int, np.ndarray] = {}
-        versions: Dict[int, int] = {}
+        versions: Dict[int, tuple] = {}
         sizes: Dict[int, int] = {}
         hinfos: Dict[int, dict] = {}
         failed: List[int] = []
@@ -806,17 +896,38 @@ class ECBackend:
         self._collect_read(attr_replies, oid, attr_chunks, versions, sizes,
                            failed, hinfos)
 
-        counts: Dict[int, int] = {}
+        counts: Dict[tuple, int] = {}
         for s, v in versions.items():
             if s not in failed:
                 counts[v] = counts.get(v, 0) + 1
         if not counts:
-            return {}, None, None, 0
-        candidates = sorted(
-            (v for v, c in counts.items() if c >= self.k), reverse=True
-        ) or [max(counts)]
+            return {}, None, None, (0, "")
+        # shards that might hold a newer version we cannot see: mapped
+        # positions whose OSD is down/unreachable, plus shards that
+        # errored (their stamp is unknown)
+        unseen = sum(
+            1 for s in range(self.km)
+            if acting[s] is not None and s not in versions
+        )
 
-        for target in candidates:
+        ordered = sorted(counts, reverse=True)
+        last = ordered[-1]
+        for target in ordered:
+            if counts[target] < self.k and target != last:
+                if counts[target] + unseen >= self.k and not allow_incomplete:
+                    # might have reached k commits (the missing holders
+                    # may be among the unreachable shards): serving an
+                    # older version could violate read-after-ack
+                    raise ObjectIncomplete(
+                        f"{oid}: newest version {target} has only "
+                        f"{counts[target]} reachable holders (+{unseen} "
+                        f"unreachable); refusing possibly-stale read"
+                    )
+                # provably never acked (< k commits possible): the write
+                # died mid-flight below min_size — roll back to the
+                # previous version
+                self.perf.inc("rolled_back_version_skipped")
+                continue
             holders = [
                 s for s in up_shards
                 if versions.get(s) == target and s not in failed
@@ -833,7 +944,7 @@ class ECBackend:
                 s: chunks[s] for s in holders
                 if s in chunks and versions.get(s) == target
             }
-            if len(have) >= self.k or target == candidates[-1]:
+            if len(have) >= self.k or target == last:
                 if len(chunks) != len(have):
                     self.perf.inc("stale_shards_dropped")
                 size = next(
@@ -844,7 +955,15 @@ class ECBackend:
                     (hinfos[s] for s in holders if s in hinfos), None
                 )
                 return have, size, hinfo, target
-        return {}, None, None, 0  # unreachable: loop always returns
+            if not allow_incomplete:
+                # the candidate had >= k stamped holders but fewer than k
+                # produced chunks (read failures mid-gather): it may have
+                # been acked, so do not fall through to older data
+                raise ObjectIncomplete(
+                    f"{oid}: version {target} assembled only "
+                    f"{len(have)}/{self.k} chunks"
+                )
+        return {}, None, None, (0, "")  # unreachable: loop always returns
 
     async def read(self, oid: str) -> bytes:
         """objects_read_and_reconstruct: minimum shards, degraded fallback."""
@@ -886,18 +1005,17 @@ class ECBackend:
             if self._shard_up(acting, s)
         ]
         replies = await self._read_shards(oid, up, acting, extents=[(0, 0)])
-        best = None  # (version, size, hinfo)
+        best = None  # (version_tuple, size, hinfo)
         for r in replies.values():
             attrs = r.attrs_read.get(oid) or {}
             if attrs.get(SIZE_KEY) is None:
                 continue
-            ver = attrs.get(VERSION_KEY) or 0
+            ver = vt(attrs.get(VERSION_KEY))
             if best is None or ver > best[0]:
                 best = (ver, attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY))
         if best is None:
             return 0, None
-        if best[0] > self._versions.get(oid, 0):
-            self._versions[oid] = best[0]
+        self._learn_version(oid, best[0])
         return best[1], best[2]
 
     async def read_range(self, oid: str, offset: int, length: int) -> bytes:
@@ -941,18 +1059,35 @@ class ECBackend:
         Appends extend the cumulative hash info; overwrites clear the chunk
         hashes like the reference's ec_overwrites mode.
         """
-        # pin the whole write span: overlapping RMW ops must serialize or
-        # they would read each other's pre-commit bytes (ExtentCache role)
-        lo_pin, _ = self.sinfo.offset_len_to_stripe_bounds(offset, max(1, len(data)))
-        hi_pin = self.sinfo.logical_to_next_stripe_offset(offset + len(data))
-        async with self.extent_cache.pin(oid, lo_pin, hi_pin) as pin:
-            try:
-                await self._write_range_pinned(oid, offset, data, pin)
-            except Exception:
-                # a partially-acked write leaves shard state ahead of the
-                # cache: cached pre-write bytes would serve stale reads
-                self.extent_cache.invalidate(oid)
-                raise
+        # serialize per object: version-assignment + fan-out + commit wait
+        # must not interleave with another write's (in-order pipeline)
+        async with self._object_lock(oid):
+            # pin the write span: publishes committed bytes for read-through
+            lo_pin, _ = self.sinfo.offset_len_to_stripe_bounds(
+                offset, max(1, len(data))
+            )
+            hi_pin = self.sinfo.logical_to_next_stripe_offset(offset + len(data))
+            for attempt in range(self._WRITE_RETRIES):
+                async with self.extent_cache.pin(oid, lo_pin, hi_pin) as pin:
+                    try:
+                        await self._write_range_pinned(oid, offset, data, pin)
+                        return
+                    except WriteConflict as wc:
+                        # a racing primary won: its committed state may
+                        # overlap ours, so replay the WHOLE RMW (re-stat,
+                        # re-read, re-merge) on top of the winner
+                        self._learn_version(oid, wc.winner)
+                        self.extent_cache.invalidate(oid)
+                        self.perf.inc("write_conflict_retry")
+                    except Exception:
+                        # a partially-acked write leaves shard state ahead
+                        # of the cache: cached pre-write bytes would serve
+                        # stale reads
+                        self.extent_cache.invalidate(oid)
+                        raise
+            raise IOError(
+                f"write_range {oid}: lost {self._WRITE_RETRIES} races"
+            )
 
     async def _write_range_pinned(
         self, oid: str, offset: int, data: bytes, pin
@@ -995,8 +1130,7 @@ class ECBackend:
                 else 0,
             )
 
-        version = max(self._versions.values(), default=0) + 1
-        self._versions[oid] = version
+        version = self._next_version(oid)
         acting = self.acting_set(oid)
         up = [
             s
@@ -1013,7 +1147,7 @@ class ECBackend:
             "expected": {f"osd.{acting[s]}" for s in up},
             "done": done,
         }
-        entry = LogEntry(version=version, oid=oid, op="append",
+        entry = LogEntry(version=version[0], oid=oid, op="append",
                          prior_size=size)
         self.log.append(entry)
         for s in range(self.km):
@@ -1046,8 +1180,7 @@ class ECBackend:
             raise IOError(f"cannot remove {oid}: no shards up")
         if oid not in self._versions:
             await self._stat(oid)
-        version = max(self._versions.values(), default=0) + 1
-        self._versions[oid] = version
+        version = self._next_version(oid)
         self._tid += 1
         tid = self._tid
         done = asyncio.get_event_loop().create_future()
@@ -1303,7 +1436,7 @@ class ECBackend:
         minimum = self.ec.minimum_to_decode([shard], up_shards)
         chunks, logical_size, hinfo_d, vmax = await self._gather_consistent(
             oid, sorted(minimum.keys()), acting, op_class="recovery",
-            up_shards=up_shards,
+            up_shards=up_shards, allow_incomplete=True,
         )
         if len(chunks) < self.k:
             raise IOError(f"cannot recover {oid}@{shard}: too few sources")

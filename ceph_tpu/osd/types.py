@@ -84,7 +84,10 @@ class ECSubWrite:
     tid: int
     oid: str
     transaction: Transaction
-    at_version: int
+    #: object version tuple ``(counter, writer)`` — the eversion analogue
+    #: with a writer tiebreak so two primaries racing the same counter
+    #: produce *distinct, totally ordered* versions (no same-version mix)
+    at_version: tuple
     log_entries: List[LogEntry] = dataclasses.field(default_factory=list)
     #: QoS class for the OSD op queue ("client" | "recovery" | "scrub")
     op_class: str = "client"
@@ -96,6 +99,11 @@ class ECSubWriteReply:
     tid: int
     committed: bool = False
     applied: bool = False
+    #: set when a client-class write was refused as stale: the shard's
+    #: currently-applied version tuple, so the writer can detect the
+    #: conflict and retry at a higher version instead of believing a
+    #: commit that never applied
+    current_version: object = None
 
 
 @dataclasses.dataclass
