@@ -1,11 +1,13 @@
-"""Client for a multi-process cluster: ECBackend over TCP.
+"""Client for a multi-process cluster: a thin Objecter over TCP.
 
-The primary-side EC engine (placement, write pipeline, reconstruct) runs
-in the client process -- exactly the reference's model where librados'
-Objecter computes placement client-side and the *primary OSD* runs
-ECBackend; our minimized design already fuses those roles in ECBackend
-(see osd/ecbackend.py), so pointing it at a TCPMessenger yields the
-remote cluster client.
+Round-3 architecture (the reference's): the client computes placement
+(the librados Objecter role, src/osdc/Objecter.cc:2784 _calc_target) and
+sends ONE op per I/O to the primary OSD daemon, which hosts the EC
+engine and fans out sub-ops to the acting set
+(src/osd/PrimaryLogPG.cc do_op; src/osd/ECBackend.cc:1976 fan-out).
+If the primary dies mid-op the Objecter probes it, marks it down and
+retries against the next up shard -- primary failover without any
+client-side chunk work.
 """
 
 from __future__ import annotations
@@ -14,12 +16,13 @@ import json
 from typing import Dict, Optional, Tuple
 
 from ceph_tpu.msg.tcp import TCPMessenger
-from ceph_tpu.osd.ecbackend import ECBackend
+from ceph_tpu.osd.objecter import Objecter
+from ceph_tpu.osd.placement import CrushPlacement
 from ceph_tpu.plugins import registry as registry_mod
 
 
 class RemoteClient:
-    def __init__(self, backend: ECBackend, messenger: TCPMessenger,
+    def __init__(self, backend: Objecter, messenger: TCPMessenger,
                  n_osds: int):
         self.backend = backend
         self.messenger = messenger
@@ -33,6 +36,8 @@ class RemoteClient:
         name: str = "client",
         hosts=None,
         keyring=None,
+        pool: str = "ecpool",
+        op_timeout: float = 30.0,
     ) -> "RemoteClient":
         if isinstance(addr_map, str):
             with open(addr_map) as f:
@@ -45,15 +50,16 @@ class RemoteClient:
         messenger = TCPMessenger(name, addr_map, keyring=keyring)
         await messenger.start()
 
+        # the client needs only the profile's k+m for placement; chunk
+        # math happens on the primary OSD
         profile = dict(profile)
         plugin = profile.pop("plugin", "jerasure")
         ec = registry_mod.instance().factory(plugin, profile)
-        from ceph_tpu.osd.placement import CrushPlacement
-
-        placement = CrushPlacement(n_osds, ec.get_chunk_count(), hosts=hosts)
-        backend = ECBackend(
-            ec, list(range(n_osds)), messenger, name=name,
-            placement=placement,
+        km = ec.get_chunk_count()
+        placement = CrushPlacement(n_osds, km, hosts=hosts)
+        backend = Objecter(
+            messenger, km, n_osds, placement=placement, name=name,
+            pool=pool, op_timeout=op_timeout,
         )
         return cls(backend, messenger, n_osds)
 

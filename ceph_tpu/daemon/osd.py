@@ -35,10 +35,27 @@ async def serve(args) -> None:
         keyring = KeyRing.load(args.keyring)
     messenger = TCPMessenger(name, addr_map, keyring=keyring)
     await messenger.start()
-    OSDShard(
+    shard = OSDShard(
         args.id, messenger, op_queue=args.op_queue,
         objectstore=args.objectstore, data_path=args.data_path,
     )
+    if args.cluster_conf:
+        # host a primary engine for the cluster's pool: THIS daemon (not
+        # the client) owns placement, version authority and sub-op fan-out
+        # for objects whose acting set it leads (the PrimaryLogPG role)
+        with open(args.cluster_conf) as f:
+            conf = json.load(f)
+        profile = dict(conf["profile"])
+        plugin = profile.pop("plugin", "jerasure")
+        from ceph_tpu.osd.placement import CrushPlacement
+        from ceph_tpu.plugins import registry as registry_mod
+
+        ec = registry_mod.instance().factory(plugin, profile)
+        n_osds = sum(1 for k in addr_map if k.startswith("osd."))
+        placement = CrushPlacement(
+            n_osds, ec.get_chunk_count(), hosts=conf.get("hosts")
+        )
+        shard.host_pool(conf.get("pool", "ecpool"), ec, n_osds, placement)
     print(f"{name} up", flush=True)
 
     stop = asyncio.Event()
@@ -58,6 +75,9 @@ def main(argv=None) -> int:
     ap.add_argument("--op-queue", default="wpq")
     ap.add_argument("--keyring", default="",
                     help="keyring file enabling cephx-style auth")
+    ap.add_argument("--cluster-conf", default="",
+                    help="cluster.json with the pool profile: this OSD "
+                         "hosts a primary engine for the pool")
     args = ap.parse_args(argv)
     try:
         asyncio.run(serve(args))

@@ -39,10 +39,11 @@ class ClusterState:
         for osd in self.cluster.osds:
             for soid in osd.store.list_objects():
                 oids.add(soid.rsplit("@", 1)[0])
+        ec = self.cluster.ec
         return {
             "num_objects": len(oids),
-            "k": b.k,
-            "m": b.m,
+            "k": ec.get_data_chunk_count(),
+            "m": ec.get_chunk_count() - ec.get_data_chunk_count(),
             "client_perf": b.perf.snapshot(),
         }
 

@@ -120,7 +120,13 @@ class TCPMessenger:
         )
 
     def adopt_task(self, name: str, task: "asyncio.Task") -> None:
+        # completed tasks prune themselves (per-op tasks would otherwise
+        # accumulate without bound on a long-lived daemon)
         self._tasks[name] = task
+        task.add_done_callback(
+            lambda t, name=name: self._tasks.pop(name, None)
+            if self._tasks.get(name) is t else None
+        )
 
     async def _dispatch_loop(self, name: str) -> None:
         queue = self._local_queues[name]

@@ -15,10 +15,17 @@ from typing import Dict, List, Optional
 
 from ceph_tpu.osd.ecbackend import ECBackend, OSDShard
 from ceph_tpu.osd.messenger import FaultInjector, Messenger
+from ceph_tpu.osd.objecter import Objecter
 from ceph_tpu.plugins import registry as registry_mod
 
 
 class ECCluster:
+    """Round-3 architecture: every OSD hosts a primary engine for the
+    pool (``OSDShard.host_pool``); ``self.backend`` is a thin Objecter
+    that routes each op to the object's current primary OSD, which fans
+    out sub-ops -- the reference's client/primary split (SURVEY.md §3.2).
+    """
+
     def __init__(
         self,
         n_osds: int,
@@ -30,6 +37,7 @@ class ECCluster:
         op_queue: str = "wpq",
         objectstore: str = "memstore",
         data_path: str = "",
+        pool: str = "ecpool",
     ):
         self.messenger = Messenger(fault)
         self.osds: List[OSDShard] = [
@@ -48,8 +56,31 @@ class ECCluster:
                 n_osds, self.ec.get_chunk_count(), hosts=hosts
             )
         self.placement = placement
-        self.backend = ECBackend(
-            self.ec, self.osds, self.messenger, placement=placement
+        self.pool = pool
+        # one primary engine per OSD; in-process they share the codec and
+        # the placement object (weight updates propagate to everyone)
+        for osd in self.osds:
+            osd.host_pool(pool, self.ec, n_osds, placement)
+        self.backend = Objecter(
+            self.messenger, self.ec.get_chunk_count(), n_osds,
+            placement=placement, pool=pool,
+        )
+
+    def primary_backend(self, oid: str) -> ECBackend:
+        """The hosted primary engine currently serving ``oid`` (test and
+        introspection hook)."""
+        acting = self.backend.acting_set(oid)
+        for s in range(self.backend.km):
+            if self.backend._shard_up(acting, s):
+                return self.osds[acting[s]].pools[self.pool]
+        raise IOError(f"no up primary for {oid}")
+
+    def new_client(self, name: str) -> Objecter:
+        """A second client handle on the same cluster (librados: another
+        Rados instance)."""
+        return Objecter(
+            self.messenger, self.ec.get_chunk_count(), len(self.osds),
+            placement=self.placement, name=name, pool=self.pool,
         )
 
     # -- client surface ----------------------------------------------------

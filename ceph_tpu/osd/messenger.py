@@ -90,8 +90,14 @@ class Messenger:
 
     def adopt_task(self, name: str, task: "asyncio.Task") -> None:
         """Track an auxiliary task (e.g. a daemon's tick loop) so shutdown
-        cancels it with the dispatch loops."""
+        cancels it with the dispatch loops.  Completed tasks prune
+        themselves -- per-op tasks (client ops, notify acks) would
+        otherwise accumulate without bound."""
         self._tasks[name] = task
+        task.add_done_callback(
+            lambda t, name=name: self._tasks.pop(name, None)
+            if self._tasks.get(name) is t else None
+        )
 
     # -- failure control (thrasher hooks) ----------------------------------
 

@@ -1,0 +1,305 @@
+"""Objecter: thin client-side op router to the primary OSD.
+
+Reference: src/osdc/Objecter.{h,cc} -- the librados client computes
+placement from the osdmap (``_calc_target``, Objecter.cc:2784), sends ONE
+op to the primary OSD of the object's PG (``_send_op`` :3223), and
+retries/redirects when the map changes or the primary dies.  The primary
+OSD hosts the EC engine (``OSDShard.host_pool`` -> ``ECBackend``) and
+fans out sub-ops to the acting set; this class never touches chunks.
+
+Failover: while waiting for a reply the Objecter probes the primary; an
+unreachable primary is marked down and the op is resent to the next up
+shard of the acting set (the reference's analogue: a new osdmap epoch
+promotes a new primary and the Objecter re-targets).  WriteConflict
+refusals -- possible only transiently around a failover, when an engine
+with a cold version view serves its first write -- are retried once (the
+refusal teaches the engine the winning version).
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional
+
+from ceph_tpu.osd.ecbackend import ObjectIncomplete
+from ceph_tpu.utils.perf import PerfCounters
+
+#: error type names coming back over the wire -> local exception classes
+_EXCEPTIONS = {
+    "ObjectIncomplete": ObjectIncomplete,
+    "FileNotFoundError": FileNotFoundError,
+    "KeyError": KeyError,
+    "ValueError": ValueError,
+    "RuntimeError": RuntimeError,
+}
+
+#: op kinds that must NOT be silently resent after a primary died with the
+#: op possibly executed: a CAS (or a cls method wrapping one) that applied
+#: on the dead primary would report a false failure when replayed against
+#: the new authority.  The reference dedups via reqids persisted in the pg
+#: log; until an equivalent exists these surface an indeterminate-outcome
+#: error instead of lying (librados analogue: ETIMEDOUT, caller re-checks).
+_NON_IDEMPOTENT = frozenset({"omap_cas", "exec"})
+
+
+class OpIndeterminate(IOError):
+    """The primary died after the op was sent; it may or may not have
+    executed.  The caller must re-check state before retrying."""
+
+
+def deliver_notify_event(messenger, name: str, callbacks: Dict, src: str,
+                         msg: dict) -> None:
+    """Run a watch callback as its own task, then ack the watch authority
+    (shared by the Objecter and a standalone client-side ECBackend --
+    librados semantics: notify completes when handlers have run)."""
+
+    async def run_cb():
+        cb = callbacks.get(msg["oid"])
+        if cb is not None:
+            try:
+                res = cb(msg["oid"], msg.get("payload"))
+                if asyncio.iscoroutine(res):
+                    await res
+            except Exception:  # noqa: BLE001 -- a watcher callback crash
+                # must not lose the ack
+                import traceback
+
+                traceback.print_exc()
+        await messenger.send_message(name, src, {
+            "op": "notify_ack", "notify_id": msg["notify_id"],
+            "watcher": name,
+        })
+
+    messenger.adopt_task(
+        f"{name}.watchcb{msg['notify_id']}",
+        asyncio.get_event_loop().create_task(run_cb()),
+    )
+
+
+class Objecter:
+    """Routes each client op to the object's current primary OSD."""
+
+    def __init__(
+        self,
+        messenger,
+        km: int,
+        n_osds: int,
+        placement=None,
+        name: str = "client",
+        pool: str = "",
+        op_timeout: float = 30.0,
+    ):
+        self.messenger = messenger
+        self.km = km
+        self.n_osds = n_osds
+        self.placement = placement
+        self.name = name
+        self.pool = pool
+        self.op_timeout = op_timeout
+        self.perf = PerfCounters(name)
+        self._tid = 0
+        self._pending: Dict[int, asyncio.Future] = {}
+        #: oid -> callback for watch/notify events (events are sent by the
+        #: watch authority OSD straight to this client)
+        self._watch_callbacks: Dict[str, object] = {}
+        #: optional monitor-traffic hook (command replies, map broadcasts)
+        self.mon_hook = None
+        messenger.register(name, self.dispatch)
+
+    # -- placement (the _calc_target role) ---------------------------------
+
+    def acting_set(self, oid: str) -> List[Optional[int]]:
+        if self.placement is not None:
+            return self.placement.acting(oid)
+        from ceph_tpu.osd.placement import fallback_acting
+
+        return fallback_acting(oid, self.n_osds, self.km)
+
+    def _shard_up(self, acting, s: int) -> bool:
+        return acting[s] is not None and not self.messenger.is_down(
+            f"osd.{acting[s]}"
+        )
+
+    def primary_of(self, oid: str) -> str:
+        """The object's current primary: the first up shard of the acting
+        set (the reference's primary is acting[0]; on its death a map
+        change promotes the next shard)."""
+        acting = self.acting_set(oid)
+        for s in range(self.km):
+            if self._shard_up(acting, s):
+                return f"osd.{acting[s]}"
+        raise IOError(f"no up OSD to serve {oid}")
+
+    # -- dispatch ----------------------------------------------------------
+
+    async def dispatch(self, src: str, msg) -> None:
+        if not isinstance(msg, dict):
+            return
+        op = msg.get("op")
+        if op == "client_reply":
+            fut = self._pending.get(msg.get("tid"))
+            if fut is not None and not fut.done():
+                fut.set_result(msg)
+            return
+        if op == "notify_event":
+            deliver_notify_event(
+                self.messenger, self.name, self._watch_callbacks, src, msg
+            )
+            return
+        if self.mon_hook is not None:
+            await self.mon_hook(msg)
+
+    # -- op submission with primary failover -------------------------------
+
+    async def _probe(self, entity: str) -> bool:
+        probe = getattr(self.messenger, "probe", None)
+        if probe is not None:
+            try:
+                return await probe(entity, timeout=1.0)
+            except TypeError:
+                return await probe(entity)
+        return not self.messenger.is_down(entity)
+
+    async def _submit(self, kind: str, oid: str, timeout: float = None,
+                      **fields):
+        """Send one op to the primary; fail over to the next up shard if
+        the primary becomes unreachable before replying."""
+        deadline = asyncio.get_event_loop().time() + (
+            timeout if timeout is not None else self.op_timeout
+        )
+        conflict_retries = 1
+        while True:
+            self._tid += 1
+            tid = self._tid
+            fut = asyncio.get_event_loop().create_future()
+            self._pending[tid] = fut
+            msg = dict(fields, op="client_op", tid=tid, kind=kind, oid=oid,
+                       pool=self.pool)
+            try:
+                primary = self.primary_of(oid)
+                await self.messenger.send_message(self.name, primary, msg)
+                reply = await self._await_reply(fut, primary, deadline)
+            finally:
+                self._pending.pop(tid, None)
+            if reply is None:
+                # primary unreachable: the messenger marked it down, so
+                # primary_of() now promotes the next up shard
+                self.perf.inc("primary_failover")
+                if kind in _NON_IDEMPOTENT:
+                    raise OpIndeterminate(
+                        f"{kind} {oid}: primary {primary} died with the op "
+                        "in flight; it may have executed -- re-check state"
+                    )
+                if asyncio.get_event_loop().time() >= deadline:
+                    raise IOError(f"{kind} {oid}: op timed out")
+                continue
+            if reply["ok"]:
+                self.perf.inc(kind)
+                return reply.get("result")
+            etype = reply.get("etype", "IOError")
+            if etype == "WriteConflict" and conflict_retries > 0:
+                # the engine learned the winning version from the refusal;
+                # one replay lands on top of it (see ECBackend.write)
+                conflict_retries -= 1
+                self.perf.inc("write_conflict_retry")
+                continue
+            exc = _EXCEPTIONS.get(etype, IOError)
+            raise exc(reply.get("error", f"{kind} {oid} failed"))
+
+    async def _await_reply(self, fut, primary: str, deadline: float):
+        """Wait for the reply in probe-sized slices; None when the primary
+        is found dead (caller fails over)."""
+        loop = asyncio.get_event_loop()
+        while True:
+            remain = deadline - loop.time()
+            if remain <= 0:
+                return None
+            try:
+                return await asyncio.wait_for(
+                    asyncio.shield(fut), timeout=min(1.0, remain)
+                )
+            except asyncio.TimeoutError:
+                if self.messenger.is_down(primary):
+                    return None
+                if not await self._probe(primary):
+                    return None
+
+    # -- I/O surface (librados IoCtx ops, one round trip each) -------------
+
+    async def write(self, oid: str, data: bytes) -> None:
+        await self._submit("write", oid, data=bytes(data))
+
+    async def read(self, oid: str) -> bytes:
+        return await self._submit("read", oid)
+
+    async def write_range(self, oid: str, offset: int, data: bytes) -> None:
+        await self._submit("write_range", oid, offset=offset,
+                           data=bytes(data))
+
+    async def read_range(self, oid: str, offset: int, length: int) -> bytes:
+        return await self._submit("read_range", oid, offset=offset,
+                                  length=length)
+
+    async def remove_object(self, oid: str) -> None:
+        await self._submit("remove", oid)
+
+    async def stat(self, oid: str):
+        """(logical size, hinfo dict | None) from the primary."""
+        size, hinfo = await self._submit("stat", oid)
+        return size, hinfo
+
+    async def deep_scrub(self, oid: str) -> dict:
+        return await self._submit("scrub", oid)
+
+    async def recover_shard(self, oid: str, shard: int,
+                            target_osd: int) -> None:
+        await self._submit("recover", oid, shard=shard, target=target_osd)
+
+    # -- metadata plane ----------------------------------------------------
+
+    async def omap_set(self, oid: str, kvs: Dict[str, bytes]) -> None:
+        await self._submit("omap_set", oid, kvs=dict(kvs))
+
+    async def omap_get(self, oid: str, keys=None) -> Dict[str, bytes]:
+        return await self._submit(
+            "omap_get", oid, keys=list(keys) if keys is not None else None
+        )
+
+    async def omap_rm(self, oid: str, keys) -> None:
+        await self._submit("omap_rm", oid, keys=list(keys))
+
+    async def omap_clear(self, oid: str) -> None:
+        await self._submit("omap_clear", oid)
+
+    async def omap_cas(self, oid: str, key: str, expect, new):
+        ok, cur = await self._submit(
+            "omap_cas", oid, key=key, expect=expect, new=new
+        )
+        return ok, cur
+
+    async def exec(self, oid: str, cls: str, method: str, inp: bytes = b""):
+        ret, out = await self._submit(
+            "exec", oid, cls=cls, method=method, inp=bytes(inp)
+        )
+        return ret, out
+
+    async def watch(self, oid: str, callback) -> None:
+        self._watch_callbacks[oid] = callback
+        try:
+            await self._submit("watch", oid, watcher=self.name)
+        except Exception:
+            self._watch_callbacks.pop(oid, None)
+            raise
+
+    async def unwatch(self, oid: str) -> None:
+        self._watch_callbacks.pop(oid, None)
+        await self._submit("unwatch", oid, watcher=self.name)
+
+    async def notify(self, oid: str, payload=None, timeout: float = 5.0):
+        return await self._submit(
+            "notify", oid, payload=payload,
+            timeout_ms=int(timeout * 1000),
+            # the authority gathers acks for up to ``timeout``; give the
+            # round trip headroom past that
+            timeout=timeout + 4.0,
+        )

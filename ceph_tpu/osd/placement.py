@@ -20,6 +20,20 @@ from ceph_tpu.crush.hash import crush_hash32
 from ceph_tpu.crush.map import ITEM_NONE, erasure_rule, weight_fp
 
 
+def fallback_acting(oid: str, n_osds: int, km: int) -> List[int]:
+    """CRUSH-lite: deterministic permutation seeded by the object name.
+    Used when no CrushPlacement is attached (unit-test clusters)."""
+    if n_osds < km:
+        raise RuntimeError("not enough OSDs for the acting set")
+    seed = int.from_bytes(
+        hashlib.blake2b(oid.encode(), digest_size=8).digest(), "big"
+    )
+    order = sorted(
+        range(n_osds), key=lambda i: (seed * (i + 1)) % (2**61 - 1)
+    )
+    return order[:km]
+
+
 class CrushPlacement:
     """CRUSH-backed acting-set computation for an EC pool."""
 

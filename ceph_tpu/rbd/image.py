@@ -150,7 +150,7 @@ class Image:
             boundary = new_size % osz
             if boundary:
                 oid = _data_oid(self.name, new_size // osz)
-                obj_size, _ = await self.backend._stat(oid)
+                obj_size, _ = await self.backend.stat(oid)
                 if obj_size > boundary:
                     await self.backend.write_range(
                         oid, boundary, b"\0" * (obj_size - boundary)

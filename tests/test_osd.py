@@ -404,7 +404,7 @@ def test_failed_partial_write_falls_back_to_complete_version():
         await c.write("obj", committed)
         acting = c.backend.acting_set("obj")
         # forge a partial v+1 write: only shard 0's OSD applies it
-        v_next = c.backend._versions["obj"] + 1
+        v_next = c.primary_backend("obj")._versions["obj"] + 1
         osd = c.osds[acting[0]]
         soid = shard_oid("obj", 0)
         torn = ECSubWrite(

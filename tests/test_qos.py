@@ -203,16 +203,16 @@ def test_cluster_mclock_queue_serves_ops():
 def test_rmw_read_served_from_extent_cache():
     async def run():
         cluster = _mk_cluster()
-        sw = cluster.backend.sinfo.stripe_width
+        sw = cluster.primary_backend("obj").sinfo.stripe_width
         base = bytes(range(256)) * ((3 * sw) // 256 + 1)
         base = base[: 3 * sw]
         await cluster.write("obj", base)
         # partial overwrite mid-object: RMW reads, then publishes the span
         await cluster.backend.write_range("obj", 10, b"A" * 20)
-        hits0 = cluster.backend.extent_cache.hits
+        hits0 = cluster.primary_backend("obj").extent_cache.hits
         # second overlapping RMW should hit the cache for its read
         await cluster.backend.write_range("obj", 15, b"B" * 10)
-        assert cluster.backend.extent_cache.hits > hits0
+        assert cluster.primary_backend("obj").extent_cache.hits > hits0
         expect = bytearray(base)
         expect[10:30] = b"A" * 20
         expect[15:25] = b"B" * 10
@@ -225,7 +225,7 @@ def test_rmw_read_served_from_extent_cache():
 def test_concurrent_overlapping_rmw_serializes():
     async def run():
         cluster = _mk_cluster()
-        sw = cluster.backend.sinfo.stripe_width
+        sw = cluster.primary_backend("obj").sinfo.stripe_width
         await cluster.write("obj", b"\0" * (2 * sw))
         await asyncio.gather(
             cluster.backend.write_range("obj", 0, b"X" * 100),
@@ -259,7 +259,7 @@ def test_stale_recovery_push_does_not_clobber_newer_write():
         osd = cluster.osds[acting[0]]
         soid = shard_oid(oid, 0)
         before = osd.store.read(soid)
-        ver = cluster.backend._versions[oid]
+        ver = cluster.primary_backend(oid)._versions[oid]
         stale = ECSubWrite(
             from_shard=0,
             tid=10_000,

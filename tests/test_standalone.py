@@ -149,6 +149,45 @@ def test_process_cluster_write_while_down_then_revive(cluster):
     asyncio.run(run())
 
 
+def test_process_cluster_primary_failover(cluster):
+    """Kill the PRIMARY OSD (not just a shard holder) without telling the
+    client; the next op must discover the death mid-op, fail over to the
+    next up shard's OSD -- which becomes the new primary and serves the
+    op -- and a later revival of the old primary (with a cold version
+    view) must still serve writes correctly.
+
+    Reference behavior: a new osdmap epoch promotes a new primary and the
+    Objecter re-targets (src/osdc/Objecter.cc _calc_target on map change).
+    """
+
+    async def run():
+        c = await _connect(cluster)
+        payload1 = b"before-failover" * 200
+        await c.write("fo-obj", payload1)
+        primary = c.backend.primary_of("fo-obj")
+        victim = int(primary.split(".")[1])
+        # SIGKILL the primary; the client does NOT probe -- the op itself
+        # must discover the death and fail over
+        assert vstart.kill_osd(cluster, victim, sig=signal.SIGKILL)
+        payload2 = b"after-failover" * 220
+        await c.write("fo-obj", payload2)
+        new_primary = c.backend.primary_of("fo-obj")
+        assert new_primary != primary
+        assert await c.read("fo-obj") == payload2
+        # revive the old primary: its engine restarts cold; the client's
+        # next op routes back to it and it must relearn the version
+        # sequence from shard attrs instead of regressing it
+        vstart.revive_osd(cluster, victim)
+        await c.probe_osds()
+        payload3 = b"after-revival" * 240
+        await c.write("fo-obj", payload3)
+        assert c.backend.primary_of("fo-obj") == primary
+        assert await c.read("fo-obj") == payload3
+        await c.close()
+
+    asyncio.run(run())
+
+
 def test_process_cluster_persistent_store_survives_restart(tmp_path):
     run_dir = str(tmp_path / "run")
     vstart.start_cluster(run_dir, 4, PROFILE, objectstore="filestore",

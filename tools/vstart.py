@@ -104,7 +104,8 @@ def spawn_osd(run_dir, osd_id, objectstore="memstore", op_queue="wpq",
            "--addr-map", os.path.join(run_dir, "addr_map.json"),
            "--objectstore", objectstore,
            "--data-path", data_path,
-           "--op-queue", op_queue]
+           "--op-queue", op_queue,
+           "--cluster-conf", os.path.join(run_dir, "cluster.json")]
     if auth:
         cmd += ["--keyring", os.path.join(run_dir, "keyring")]
     proc = subprocess.Popen(
