@@ -56,6 +56,8 @@ async def serve(args) -> None:
             n_osds, ec.get_chunk_count(), hosts=conf.get("hosts")
         )
         shard.host_pool(conf.get("pool", "ecpool"), ec, n_osds, placement)
+        # daemons run peering-driven auto recovery by default (OSD::tick)
+        shard.start_tick()
     print(f"{name} up", flush=True)
 
     stop = asyncio.Event()

@@ -79,12 +79,15 @@ def encode_message(msg: object) -> bytes:
         for e in msg.log_entries:
             _encode_log_entry(enc, e)
         enc.string(msg.op_class)
+        enc.value(msg.rollback)
+        enc.value(msg.prev_version)
     elif isinstance(msg, ECSubWriteReply):
         enc.u8(_MSG_EC_SUB_WRITE_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
         enc.value(msg.committed).value(msg.applied)
         enc.value(tuple(msg.current_version) if isinstance(
             msg.current_version, (tuple, list)) else msg.current_version)
+        enc.value(msg.missed)
     elif isinstance(msg, ECSubRead):
         enc.u8(_MSG_EC_SUB_READ)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -122,13 +125,14 @@ def decode_message(data: bytes) -> object:
         return ECSubWrite(
             from_shard=from_shard, tid=tid, oid=oid, transaction=txn,
             at_version=at_version, log_entries=entries,
-            op_class=dec.string(),
+            op_class=dec.string(), rollback=dec.value(),
+            prev_version=dec.value(),
         )
     if kind == _MSG_EC_SUB_WRITE_REPLY:
         return ECSubWriteReply(
             from_shard=dec.varint(), tid=dec.varint(),
             committed=dec.value(), applied=dec.value(),
-            current_version=dec.value(),
+            current_version=dec.value(), missed=dec.value(),
         )
     if kind == _MSG_EC_SUB_READ:
         return ECSubRead(

@@ -97,6 +97,86 @@ class ECCluster:
     async def read_range(self, oid: str, offset: int, length: int) -> bytes:
         return await self.backend.read_range(oid, offset, length)
 
+    # -- auto recovery (peering-driven; qa wait_for_clean surface) ---------
+
+    def start_auto_recovery(self, interval: float = None) -> None:
+        """Enable background peering + recovery on every OSD (daemons run
+        this by default; the in-process harness opts in so unit tests can
+        hold a cluster in a degraded state deliberately)."""
+        for osd in self.osds:
+            osd.start_tick(interval)
+
+    async def degraded_report(self) -> List[str]:
+        """Objects with a missing/stale placed copy relative to the
+        authoritative (assemblable) version -- the PG_DEGRADED accounting
+        the qa helpers' wait_for_clean polls.  Mirrors the peering
+        authority rules so 'clean' here == 'no actions' there."""
+        from ceph_tpu.osd.ecbackend import VERSION_KEY, shard_oid, vt
+
+        km = self.backend.km
+        k = self.ec.get_data_chunk_count()
+        degraded = []
+        oids = set()
+        metas = set()
+        for osd in self.osds:
+            if self.messenger.is_down(osd.name):
+                continue
+            for stored in osd.store.list_objects():
+                base, _, tag = stored.rpartition("@")
+                if not base:
+                    continue
+                (metas if tag == "meta" else oids).add(base)
+        for oid in sorted(oids):
+            acting = self.backend.acting_set(oid)
+            counts: Dict[tuple, int] = {}
+            unseen = 0
+            placed: Dict[int, tuple] = {}
+            for s in range(km):
+                if acting[s] is None:
+                    continue
+                osd = self.osds[acting[s]]
+                if self.messenger.is_down(osd.name):
+                    unseen += 1
+                    continue
+                try:
+                    v = vt(osd.store.getattr(shard_oid(oid, s), VERSION_KEY))
+                except FileNotFoundError:
+                    placed[s] = None
+                    continue
+                placed[s] = v
+                counts[v] = counts.get(v, 0) + 1
+            if not counts:
+                continue
+            authoritative = None
+            for v in sorted(counts, reverse=True):
+                if counts[v] >= k:
+                    authoritative = v
+                    break
+                if counts[v] + unseen >= k:
+                    break
+            if authoritative is None:
+                continue  # incomplete/debris: not recoverable right now
+            if any(cur != authoritative for cur in placed.values()):
+                degraded.append(oid)
+        for oid in sorted(metas):
+            acting = self.backend.acting_set(oid)
+            vers = []
+            for s in range(km):
+                if acting[s] is None:
+                    continue
+                osd = self.osds[acting[s]]
+                if self.messenger.is_down(osd.name):
+                    continue
+                try:
+                    vers.append(
+                        osd.store.getattr(f"{oid}@meta", "_meta_version") or 0
+                    )
+                except FileNotFoundError:
+                    vers.append(0)
+            if vers and min(vers) != max(vers):
+                degraded.append(f"{oid}@meta")
+        return degraded
+
     # -- failure control (thrasher surface) --------------------------------
 
     def kill_osd(self, osd_id: int) -> None:

@@ -176,6 +176,48 @@ class OSDShard:
         self.pools[pool] = backend
         return backend
 
+    # -- background tick: peering-driven recovery (OSD::tick role) ---------
+
+    def start_tick(self, interval: float = None) -> None:
+        """Start the background tick loop (reference OSD::tick,
+        src/osd/OSD.cc): each tick runs a peering pass over the hosted
+        pools, auto-recovering missing/stale shards.  Idempotent."""
+        if getattr(self, "_tick_task", None) is not None:
+            return
+        if interval is None:
+            from ceph_tpu.utils.config import get_config
+
+            interval = float(get_config().get_val("osd_tick_interval"))
+        self._tick_interval = interval
+        self._tick_task = asyncio.get_event_loop().create_task(
+            self._tick_loop()
+        )
+        self.messenger.adopt_task(f"{self.name}.tick", self._tick_task)
+
+    async def _tick_loop(self) -> None:
+        while True:
+            try:
+                await self.peering_tick()
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 -- a failed pass must not
+                # kill the loop; state is retried next tick
+                import sys
+                import traceback
+
+                traceback.print_exc(file=sys.stderr)
+            await asyncio.sleep(self._tick_interval)
+
+    async def peering_tick(self) -> int:
+        """One peering round over every hosted pool; returns the number
+        of recovery actions attempted."""
+        if self.frozen or self.messenger.is_down(self.name):
+            return 0
+        total = 0
+        for backend in self.pools.values():
+            total += await backend.peering_pass()
+        return total
+
     def _op_cost(self, msg) -> int:
         if isinstance(msg, ECSubWrite):
             return max(
@@ -252,7 +294,32 @@ class OSDShard:
         op = msg["op"]
         oid = msg.get("oid", "")
         soid = f"{oid}@meta"
-        if op == "meta_get":
+        if op == "pg_list":
+            # peering scan: report every shard object this OSD holds with
+            # its version stamp (the role of the peering Query/log+missing
+            # exchange, reference src/osd/PG.cc GetInfo/GetLog).  Shard
+            # entries are (oid, shard, (counter, writer)); meta replicas
+            # report shard -1 with their meta version.
+            objects = []
+            for stored in self.store.list_objects():
+                base, _, tag = stored.rpartition("@")
+                if not base:
+                    continue
+                if tag == "meta":
+                    mv = self.store.getattr(stored, "_meta_version") or 0
+                    objects.append((base, -1, (mv, "")))
+                else:
+                    try:
+                        shard = int(tag)
+                    except ValueError:
+                        continue
+                    ver = vt(self.store.getattr(stored, VERSION_KEY))
+                    objects.append((base, shard, tuple(ver)))
+            await self.messenger.send_message(self.name, src, {
+                "op": "pg_list_reply", "tid": msg["tid"],
+                "from": self.name, "objects": objects,
+            })
+        elif op == "meta_get":
             try:
                 omap = self.store.omap_get(soid)
                 ver = self.store.getattr(soid, "_meta_version") or 0
@@ -491,8 +558,37 @@ class OSDShard:
 
         soid = shard_oid(msg.oid, msg.from_shard)
         new_vt = vt(msg.at_version)
-        cur_vt = vt(self._applied_version.get(soid))
-        if new_vt < cur_vt:
+        cur_vt = self._applied_version.get(soid)
+        if cur_vt is None:
+            # fresh process (daemon restart): the applied version lives in
+            # the object's xattr, not just this map — the gate must
+            # survive restarts on persistent stores
+            try:
+                cur_vt = vt(self.store.getattr(soid, VERSION_KEY))
+            except FileNotFoundError:
+                cur_vt = vt(None)
+        if (
+            msg.prev_version is not None
+            and cur_vt[0] != vt(msg.prev_version)[0]
+            and new_vt >= cur_vt
+        ):
+            # incremental (RMW extent) write, but this shard is not on the
+            # base version it was computed against: it missed history
+            # (down/revived hollow).  Applying just the extent would stamp
+            # the new version over mostly-stale bytes.  Skip; the shard
+            # stays behind until peering recovers it (pg_missing_t role).
+            self.perf.inc("sub_write_missed_base")
+            await self.messenger.send_message(self.name, src, ECSubWriteReply(
+                from_shard=msg.from_shard, tid=msg.tid,
+                committed=False, applied=False, missed=True,
+            ))
+            return
+        if msg.rollback and msg.op_class == "recovery":
+            # peering proved this shard's newer copy a torn write (held by
+            # < k shards): the primary rolls it back to the authoritative
+            # version, bypassing the stale gate (divergent-entry rollback)
+            self.perf.inc("sub_write_rollback")
+        elif new_vt < cur_vt:
             # dequeued behind a newer write to the same object (priority
             # reordering or a racing primary).  Applying would clobber
             # newer bytes with stale ones.
@@ -703,7 +799,8 @@ class ECBackend:
         if isinstance(msg, dict):
             op = msg.get("op")
             if op in ("meta_get_reply", "meta_apply_reply",
-                      "omap_cas_reply", "watch_reply", "notify_reply"):
+                      "omap_cas_reply", "watch_reply", "notify_reply",
+                      "pg_list_reply"):
                 state = self._pending.get(msg.get("tid"))
                 if state is not None:
                     state["replies"][src] = msg
@@ -727,6 +824,18 @@ class ECBackend:
         if isinstance(msg, ECSubWriteReply):
             state = self._pending.get(msg.tid)
             if state is None:
+                return
+            if msg.missed:
+                # the shard skipped an incremental write (missed base):
+                # degrade the fan-out as if it were down — it must not
+                # count toward the quorum, and _await_commits verifies
+                # enough real appliers remain
+                state["expected"].discard(src)
+                if (
+                    state["committed"] >= state["expected"]
+                    and not state["done"].done()
+                ):
+                    state["done"].set_result(True)
                 return
             if not msg.committed and msg.current_version is not None:
                 # stale-write refusal: a racing primary won this object.
@@ -926,6 +1035,13 @@ class ECBackend:
                 if state["committed"] >= state["expected"]:
                     done.set_result(True)
             await asyncio.wait_for(done, timeout=30)
+            # shards may have dropped out mid-op (missed-base skips): the
+            # write only durably exists if enough shards actually applied
+            if len(state["committed"]) < min_acks:
+                raise IOError(
+                    f"write {oid}: only {len(state['committed'])} shards "
+                    f"applied (need {min_acks})"
+                )
         finally:
             del self._pending[tid]
 
@@ -1237,6 +1353,9 @@ class ECBackend:
         from ceph_tpu.osd.ectransaction import get_write_plan
 
         size, hinfo_d = await self._stat(oid)
+        # the version counter this RMW is computed on top of: shards not
+        # on this base missed history and must skip the extent write
+        base_version = self._versions.get(oid, 0)
         plan = get_write_plan(self.sinfo, size, offset, len(data))
         start, span = plan.will_write
 
@@ -1303,6 +1422,7 @@ class ECBackend:
             sub = ECSubWrite(
                 from_shard=s, tid=tid, oid=oid, transaction=txn,
                 at_version=version, log_entries=[entry],
+                prev_version=base_version,
             )
             await self.messenger.send_message(
                 self.name, f"osd.{acting[s]}", sub
@@ -1570,10 +1690,36 @@ class ECBackend:
     # -- recovery ----------------------------------------------------------
 
     async def recover_shard(
-        self, oid: str, shard: int, target_osd: int
+        self, oid: str, shard: int, target_osd: int, rollback: bool = False
     ) -> None:
-        """Reconstruct one lost shard and push it to a replacement OSD
-        (the READING->WRITING recovery state machine, ECBackend.h:256-300)."""
+        """Reconstruct one lost/stale shard and push it to the target OSD
+        in bounded windows (the READING->WRITING recovery state machine,
+        ECBackend.h:256-300, chunked like get_recovery_chunk_size :213 so
+        a 64 MiB object never needs 64 MiB of primary memory).  A client
+        write landing mid-recovery changes the object version; that is
+        detected at the next window's gather and the recovery restarts.
+        ``rollback=True`` lets the final stamp overwrite a torn
+        higher-versioned copy (peering's divergent-entry rollback)."""
+        from ceph_tpu.utils.config import get_config
+
+        window = max(1, int(get_config().get_val("osd_recovery_max_chunk")))
+        for attempt in range(3):
+            if await self._recover_shard_once(
+                oid, shard, target_osd, window, rollback
+            ):
+                self.perf.inc("recover")
+                return
+            self.perf.inc("recover_restart")
+        raise IOError(
+            f"recovery of {oid}@{shard} kept losing to concurrent writes"
+        )
+
+    async def _recover_shard_once(
+        self, oid: str, shard: int, target_osd: int, window: int,
+        rollback: bool,
+    ) -> bool:
+        """One windowed recovery attempt; False = restart (the object's
+        version moved under us)."""
         acting = self.acting_set(oid)
         up_shards = [
             s
@@ -1582,48 +1728,226 @@ class ECBackend:
             and self._shard_up(acting, s)
         ]
         minimum = self.ec.minimum_to_decode([shard], up_shards)
+        src = sorted(minimum.keys())
+        cs = self.sinfo.chunk_size
+        # per-source-chunk bytes per round, whole per-stripe chunks only
+        # (a stripe decodes independently for every technique)
+        win = max(cs, (window // self.k) // cs * cs)
         chunks, logical_size, hinfo_d, vmax = await self._gather_consistent(
-            oid, sorted(minimum.keys()), acting, op_class="recovery",
+            oid, src, acting, extents=[(0, win)], op_class="recovery",
             up_shards=up_shards, allow_incomplete=True,
         )
         if len(chunks) < self.k:
             raise IOError(f"cannot recover {oid}@{shard}: too few sources")
-        rec = ecutil.decode_shards(self.ec, chunks, [shard])
+        if logical_size is None:
+            raise IOError(f"cannot recover {oid}@{shard}: no size metadata")
+        chunk_total = self.sinfo.aligned_logical_offset_to_chunk_offset(
+            self.sinfo.logical_to_next_stripe_offset(logical_size)
+        )
         soid = shard_oid(oid, shard)
-        txn = (
-            Transaction()
-            .write(soid, 0, rec[shard].tobytes())
-            # the target may hold a LONGER stale chunk (it missed a
-            # shrinking overwrite while down): writing without truncating
-            # would leave stale tail bytes under the new version stamp
-            .truncate(soid, len(rec[shard]))
-            .setattr(soid, ecutil.HINFO_KEY, hinfo_d)
-            .setattr(soid, SIZE_KEY, logical_size)
-            .setattr(soid, VERSION_KEY, vmax)
+        off = 0
+        while True:
+            rec = ecutil.decode_shards(self.ec, chunks, [shard])
+            piece = rec[shard].tobytes()
+            last = off + len(piece) >= chunk_total
+            if not last and not piece:
+                # sources hold less data than the size metadata claims
+                # (inconsistent mid-write state): restart, don't spin
+                return False
+            txn = Transaction().write(soid, off, piece)
+            if last:
+                # attrs (incl. the version stamp) land ONLY on the final
+                # window: a half-recovered shard must never claim the
+                # authoritative version.  Truncate drops any longer stale
+                # tail from a shrinking overwrite the target missed.
+                txn = (
+                    txn.truncate(soid, chunk_total)
+                    .setattr(soid, ecutil.HINFO_KEY, hinfo_d)
+                    .setattr(soid, SIZE_KEY, logical_size)
+                    .setattr(soid, VERSION_KEY, vmax)
+                )
+            tid = self._new_tid()
+            done = asyncio.get_event_loop().create_future()
+            self._pending[tid] = {
+                "committed": set(),
+                "expected": {f"osd.{target_osd}"},
+                "done": done,
+            }
+            sub = ECSubWrite(
+                from_shard=shard,
+                tid=tid,
+                oid=oid,
+                transaction=txn,
+                # the consistent sources' version, NOT this primary's
+                # possibly cold _versions map: a lower number would be
+                # silently no-op'd by the target's stale-write gate
+                at_version=vmax,
+                op_class="recovery",
+                rollback=rollback,
+            )
+            await self.messenger.send_message(
+                self.name, f"osd.{target_osd}", sub
+            )
+            # min_acks=1: the push has exactly one target; if it died,
+            # fail loudly instead of reporting a recovery that never ran
+            await self._await_commits(oid, tid, done, min_acks=1)
+            self.perf.inc("recover_window")
+            if last:
+                return True
+            off += len(piece)
+            chunks, _, _, v2 = await self._gather_consistent(
+                oid, src, acting, extents=[(off, win)], op_class="recovery",
+                up_shards=up_shards, allow_incomplete=True,
+            )
+            if v2 != vmax or len(chunks) < self.k:
+                return False
+
+    # -- peering (PG.h:2122 Peering + start_recovery_ops role) -------------
+
+    def _peering_authoritative(self, counts: Dict[tuple, int],
+                               unseen: int) -> Optional[tuple]:
+        """Pick the version to recover toward from placed-copy counts.
+
+        Newest version with >= k placed holders wins (assemblable).  A
+        newer version with fewer holders is either *possibly acked*
+        (holders + unreporting placed positions could reach k) -- then we
+        must NOT recover toward older data, return None and wait -- or
+        *provably torn* (could never have reached k commits), in which
+        case its copies are divergent log entries to roll back.  This is
+        the log-authority computation of peering
+        (doc/dev/osd_internals/log_based_pg.rst)."""
+        for v in sorted(counts, reverse=True):
+            if counts[v] >= self.k:
+                return v
+            if counts[v] + unseen >= self.k:
+                return None  # possibly acked, unassemblable now: wait
+        return None  # nothing assemblable (debris, e.g. remove leftovers)
+
+    async def peering_pass(self, max_active: int = None) -> int:
+        """One peering + recovery round for objects whose PRIMARY this
+        engine's OSD currently is.
+
+        Scans every up OSD's holdings (``pg_list``), computes the
+        authoritative version per object, and background-recovers every
+        missing/stale/torn placed copy in bounded windows with bounded
+        concurrency.  Returns the number of recovery actions attempted
+        (0 == clean from this primary's perspective).  Reference:
+        src/osd/PG.cc peering -> PG::activate -> start_recovery_ops."""
+        from ceph_tpu.utils.config import get_config
+
+        if max_active is None:
+            max_active = int(get_config().get_val("osd_recovery_max_active"))
+        n_osds = len(self.osds)
+        up_osds = [
+            f"osd.{i}" for i in range(n_osds)
+            if not self.messenger.is_down(f"osd.{i}")
+        ]
+        replies = await self._meta_roundtrip(
+            up_osds, {"op": "pg_list"}, timeout=3.0
         )
-        tid = self._new_tid()
-        done = asyncio.get_event_loop().create_future()
-        self._pending[tid] = {
-            "committed": set(),
-            "expected": {f"osd.{target_osd}"},
-            "done": done,
-        }
-        sub = ECSubWrite(
-            from_shard=shard,
-            tid=tid,
-            oid=oid,
-            transaction=txn,
-            # the consistent sources' version, NOT this primary's possibly
-            # cold _versions map: a lower number would be silently no-op'd
-            # by the target's stale-write gate while acking success
-            at_version=vmax,
-            op_class="recovery",
+        # have[oid][shard][osd_name] = version tuple; meta[oid][osd] = ver
+        have: Dict[str, Dict[int, Dict[str, tuple]]] = {}
+        meta: Dict[str, Dict[str, int]] = {}
+        for osd_name, r in replies.items():
+            for base, shard, ver in r.get("objects", []):
+                if shard == -1:
+                    meta.setdefault(base, {})[osd_name] = ver[0]
+                else:
+                    have.setdefault(base, {}).setdefault(shard, {})[
+                        osd_name
+                    ] = vt(tuple(ver))
+
+        def is_my_object(acting) -> bool:
+            for s in range(self.km):
+                if self._shard_up(acting, s):
+                    return f"osd.{acting[s]}" == self.name
+            return False
+
+        actions = []  # (oid, shard, target_osd, rollback)
+        for oid in sorted(have):
+            acting = self.acting_set(oid)
+            if not is_my_object(acting):
+                continue  # another OSD is this object's primary
+            shardmap = have[oid]
+            # placed copies only: a copy on a non-acting OSD (remap
+            # leftover) cannot feed _gather_consistent
+            counts: Dict[tuple, int] = {}
+            unseen = 0
+            placed: Dict[int, Optional[tuple]] = {}
+            for s in range(self.km):
+                if acting[s] is None:
+                    continue
+                holder = f"osd.{acting[s]}"
+                if holder not in replies:
+                    unseen += 1
+                    continue
+                v = shardmap.get(s, {}).get(holder)
+                placed[s] = v
+                if v is not None:
+                    counts[v] = counts.get(v, 0) + 1
+            if not counts:
+                continue
+            authoritative = self._peering_authoritative(counts, unseen)
+            if authoritative is None:
+                self.perf.inc("peering_wait")
+                continue
+            for s, cur in placed.items():
+                if cur == authoritative:
+                    continue
+                actions.append(
+                    (oid, s, acting[s],
+                     cur is not None and cur > authoritative)
+                )
+
+        meta_actions = []  # (oid, stale_targets)
+        for oid, holders in meta.items():
+            acting = self.acting_set(oid)
+            if not is_my_object(acting):
+                continue
+            newest = max(holders.values())
+            try:
+                targets = self._meta_targets(oid)
+            except IOError:
+                continue
+            stale = [t for t in targets if holders.get(t, 0) < newest]
+            if stale:
+                meta_actions.append((oid, stale))
+
+        if not actions and not meta_actions:
+            return 0
+        sem = asyncio.Semaphore(max_active)
+
+        async def recover_one(oid, s, target, rb):
+            async with sem:
+                try:
+                    await self.recover_shard(oid, s, target, rollback=rb)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 -- a failed recovery
+                    # stays pending; the next peering pass retries
+                    self.perf.inc("recover_failed")
+
+        async def recover_meta(oid, stale):
+            async with sem:
+                try:
+                    # full-state re-apply: replicas converge in one step
+                    omap = await self._meta_read(oid)
+                    ver = self._meta_versions.get(oid, 0)
+                    await self._meta_roundtrip(stale, {
+                        "op": "meta_apply", "oid": oid,
+                        "version": ver, "omap": omap,
+                    })
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001
+                    self.perf.inc("recover_failed")
+
+        await asyncio.gather(
+            *(recover_one(*a) for a in actions),
+            *(recover_meta(*m) for m in meta_actions),
         )
-        await self.messenger.send_message(self.name, f"osd.{target_osd}", sub)
-        # min_acks=1: the push has exactly one target; if it died, fail
-        # loudly instead of reporting a recovery that never happened
-        await self._await_commits(oid, tid, done, min_acks=1)
-        self.perf.inc("recover")
+        self.perf.inc("peering_pass")
+        return len(actions) + len(meta_actions)
 
     # -- client-op service (the PrimaryLogPG do_op role) -------------------
 

@@ -91,6 +91,21 @@ class ECSubWrite:
     log_entries: List[LogEntry] = dataclasses.field(default_factory=list)
     #: QoS class for the OSD op queue ("client" | "recovery" | "scrub")
     op_class: str = "client"
+    #: peering-authorized rollback: lets a recovery push OVERWRITE a
+    #: higher-versioned shard copy.  Set only when the primary's peering
+    #: pass proved the newer version a torn write (held by < k shards
+    #: with every mapped shard reporting) — the PG-log divergent-entry
+    #: rollback role (reference doc/dev/osd_internals/log_based_pg.rst)
+    rollback: bool = False
+    #: base-version gate for INCREMENTAL writes (RMW extent writes): the
+    #: version counter this write was computed on top of.  A shard whose
+    #: applied counter differs missed history (e.g. it was down and
+    #: revived hollow) — applying just the extent would stamp the new
+    #: version over an object mostly made of stale/absent bytes, so the
+    #: shard must skip the write and wait for recovery instead (the PG
+    #: missing-set role, reference src/osd/PG.h pg_missing_t).  None for
+    #: full-rewrite transactions, which are safe on any base.
+    prev_version: object = None
 
 
 @dataclasses.dataclass
@@ -104,6 +119,11 @@ class ECSubWriteReply:
     #: conflict and retry at a higher version instead of believing a
     #: commit that never applied
     current_version: object = None
+    #: the shard skipped an incremental write because its base version
+    #: did not match ``prev_version`` (it missed history): it must NOT be
+    #: counted toward the write's k-commit quorum, and it stays on the
+    #: old version until peering recovers it
+    missed: bool = False
 
 
 @dataclasses.dataclass

@@ -53,6 +53,13 @@ OPTIONS: Dict[str, Option] = {
              "stripes fused per device dispatch in the batching shim"),
         _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
              "max bytes per recovery window"),
+        _opt("osd_recovery_max_active", int, 3, LEVEL_ADVANCED,
+             "max concurrent object recoveries per OSD"),
+        _opt("osd_tick_interval", float, 5.0, LEVEL_ADVANCED,
+             "seconds between OSD background ticks (peering/scrub)"),
+        _opt("osd_scrub_objects_per_tick", int, 4, LEVEL_ADVANCED,
+             "deep-scrub at most this many objects per background tick "
+             "(rate limit; 0 disables background scrub)"),
         _opt("ms_inject_socket_failures", int, 0, LEVEL_DEV,
              "inject a message drop roughly every N messages"),
         _opt("ms_inject_internal_delays", float, 0.0, LEVEL_DEV,
