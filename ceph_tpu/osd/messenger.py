@@ -111,9 +111,13 @@ class Messenger:
         return name in self._down
 
     async def shutdown(self) -> None:
-        for task in self._tasks.values():
+        # Snapshot: the adopt_task done-callbacks prune self._tasks as each
+        # cancelled task completes, so iterating the live dict here races
+        # with its own mutation (dictionary-changed-size RuntimeError).
+        tasks = list(self._tasks.values())
+        for task in tasks:
             task.cancel()
-        for task in self._tasks.values():
+        for task in tasks:
             try:
                 await task
             except (asyncio.CancelledError, Exception):

@@ -1,0 +1,113 @@
+"""Messenger-bus unit tests.
+
+Regression coverage for the round-3 shutdown race: adopt_task()'s
+self-pruning done-callback mutates Messenger._tasks while shutdown()
+iterates it (reference analogue: AsyncMessenger::shutdown draining its
+worker set, src/msg/async/AsyncMessenger.h:74).
+"""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.messenger import FaultInjector, Messenger
+
+
+def test_shutdown_with_self_pruning_tasks():
+    """Churn many short-lived adopted tasks through shutdown.
+
+    Before the fix, shutdown() iterated self._tasks.values() while each
+    cancelled task's done-callback popped itself from the dict ->
+    RuntimeError: dictionary changed size during iteration.
+    """
+
+    async def scenario():
+        bus = Messenger()
+
+        async def op(i):
+            await asyncio.sleep(0.001 * (i % 7))
+
+        async def sleeper():
+            await asyncio.sleep(3600)
+
+        for i in range(64):
+            bus.adopt_task(f"op-{i}", asyncio.get_event_loop().create_task(op(i)))
+        for i in range(8):
+            bus.adopt_task(
+                f"tick-{i}", asyncio.get_event_loop().create_task(sleeper())
+            )
+        # Let a prefix of the ops complete (their callbacks prune the dict),
+        # then shut down while the rest are mid-flight.
+        await asyncio.sleep(0.002)
+        await bus.shutdown()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_shutdown_twice_is_idempotent():
+    async def scenario():
+        bus = Messenger()
+
+        async def dispatcher(src, msg):
+            pass
+
+        bus.register("osd.0", dispatcher)
+        await bus.shutdown()
+        await bus.shutdown()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_adopted_task_prunes_on_completion():
+    async def scenario():
+        bus = Messenger()
+
+        async def quick():
+            return 1
+
+        t = asyncio.get_event_loop().create_task(quick())
+        bus.adopt_task("q", t)
+        await t
+        await asyncio.sleep(0)  # let done-callback run
+        assert "q" not in bus._tasks
+        # A newer task under the same name must not be pruned by the old
+        # task's callback.
+        t2 = asyncio.get_event_loop().create_task(asyncio.sleep(0.05))
+        bus.adopt_task("q", t2)
+        assert bus._tasks.get("q") is t2
+        await bus.shutdown()
+        return True
+
+    assert asyncio.run(scenario())
+
+
+def test_fault_injector_drop_counts():
+    fi = FaultInjector(drop_probability=1.0)
+    assert fi.maybe_drop()
+    assert fi.dropped == 1
+    fi2 = FaultInjector(drop_probability=0.0)
+    assert not fi2.maybe_drop()
+
+
+def test_messages_to_down_entities_vanish():
+    async def scenario():
+        bus = Messenger()
+        got = []
+
+        async def dispatcher(src, msg):
+            got.append((src, msg))
+
+        bus.register("osd.1", dispatcher)
+        bus.mark_down("osd.1")
+        await bus.send_message("client", "osd.1", "hello")
+        await asyncio.sleep(0.01)
+        bus.mark_up("osd.1")
+        await bus.send_message("client", "osd.1", "world")
+        await asyncio.sleep(0.01)
+        await bus.shutdown()
+        return got
+
+    got = asyncio.run(scenario())
+    assert got == [("client", "world")]
