@@ -37,42 +37,50 @@ ITERS = 3
 ERASURES = [1, 6]  # fixed 2-erasure signature for decode
 
 
-def _tool_encode_gibps(ec, payload, batch, iters) -> float:
+def _tool_encode_gibps(ec, stripes, iters) -> float:
+    """Host-to-host encode throughput over ``stripes`` (a list of payload
+    arrays; pass DISTINCT random buffers for the honest headline so neither
+    the content-addressed H2D cache nor the relay's upload compression can
+    elide transfer work)."""
     want = set(range(ec.get_chunk_count()))
+    nbytes = sum(s.nbytes for s in stripes)
     if hasattr(ec, "encode_batch"):
-        stripes = [payload] * batch
         ec.encode_batch(stripes[:1])  # warm: compile + matrix upload
         t0 = time.perf_counter()
         for _ in range(iters):
             ec.encode_batch(stripes)
         dt = time.perf_counter() - t0
-        return iters * batch * payload.nbytes / dt / (1 << 30)
-    ec.encode(want, payload)  # warm tables
+        return iters * nbytes / dt / (1 << 30)
+    ec.encode(want, stripes[0])  # warm tables
     t0 = time.perf_counter()
-    for _ in range(iters * batch):
-        ec.encode(want, payload)
+    for _ in range(iters):
+        for s in stripes:
+            ec.encode(want, s)
     dt = time.perf_counter() - t0
-    return iters * batch * payload.nbytes / dt / (1 << 30)
+    return iters * nbytes / dt / (1 << 30)
 
 
-def _tool_decode_gibps(ec, payload, batch, iters) -> float:
+def _tool_decode_gibps(ec, stripes, iters) -> float:
     want = set(range(ec.get_chunk_count()))
-    encoded = ec.encode(want, payload)
-    chunks = {c: a for c, a in encoded.items() if c not in ERASURES}
+    maps = []
+    for s in stripes:
+        encoded = ec.encode(want, s)
+        maps.append({c: a for c, a in encoded.items() if c not in ERASURES})
+    nbytes = sum(s.nbytes for s in stripes)
     if hasattr(ec, "decode_batch"):
-        maps = [dict(chunks)] * batch
         ec.decode_batch(maps[:1])  # warm
         t0 = time.perf_counter()
         for _ in range(iters):
             ec.decode_batch(maps)
         dt = time.perf_counter() - t0
-        return iters * batch * payload.nbytes / dt / (1 << 30)
-    ec.decode(want, chunks)  # warm
+        return iters * nbytes / dt / (1 << 30)
+    ec.decode(want, maps[0])  # warm
     t0 = time.perf_counter()
-    for _ in range(iters * batch):
-        ec.decode(want, chunks)
+    for _ in range(iters):
+        for m in maps:
+            ec.decode(want, m)
     dt = time.perf_counter() - t0
-    return iters * batch * payload.nbytes / dt / (1 << 30)
+    return iters * nbytes / dt / (1 << 30)
 
 
 def _tunnel_bandwidths() -> tuple:
@@ -167,13 +175,29 @@ def main() -> int:
     registry = registry_mod.instance()
     registry.disable_dlclose = True
     profile = {"technique": "reed_sol_van", "k": str(K), "m": str(M)}
-    payload = np.full(SIZE, ord("X"), dtype=np.uint8)  # reference payload
+    # Honest headline payloads: DISTINCT random buffers, H2D cache OFF
+    # (closes the round-2 advisor's bench-honesty finding: constant 'X'
+    # payload + content-addressed cache elided transfer work).
+    rng = np.random.RandomState(1234)
+    stripes = [
+        rng.randint(0, 256, size=SIZE, dtype=np.uint8) for _ in range(BATCH)
+    ]
+    const_payload = np.full(SIZE, ord("X"), dtype=np.uint8)  # reference fill
 
     # -- TPU plugin at the tool surface (host-to-host, honest) -------------
+    import os
+
     tpu_ec = registry.factory("tpu", dict(profile), "")
-    enc = _tool_encode_gibps(tpu_ec, payload, BATCH, ITERS)
-    dec = _tool_decode_gibps(tpu_ec, payload, BATCH, ITERS)
+    os.environ["CEPH_TPU_NO_H2D_CACHE"] = "1"
+    try:
+        enc = _tool_encode_gibps(tpu_ec, stripes, ITERS)
+        dec = _tool_decode_gibps(tpu_ec, stripes, ITERS)
+    finally:
+        del os.environ["CEPH_TPU_NO_H2D_CACHE"]
     combined = 2 / (1 / enc + 1 / dec)
+    # Secondary: the reference benchmark's own semantics (constant 'X'
+    # buffer re-encoded each iteration, caches allowed) for comparison.
+    enc_cached = _tool_encode_gibps(tpu_ec, [const_payload] * BATCH, ITERS)
 
     # -- CPU baseline plugin, same surface ---------------------------------
     cpu_prof = dict(profile)
@@ -184,8 +208,8 @@ def main() -> int:
     except Exception:
         pass
     cpu_ec = registry.factory("jerasure", cpu_prof, "")
-    cpu_enc = _tool_encode_gibps(cpu_ec, payload, BATCH, max(1, ITERS))
-    cpu_dec = _tool_decode_gibps(cpu_ec, payload, BATCH, max(1, ITERS))
+    cpu_enc = _tool_encode_gibps(cpu_ec, stripes, max(1, ITERS))
+    cpu_dec = _tool_decode_gibps(cpu_ec, stripes, max(1, ITERS))
     cpu_combined = 2 / (1 / cpu_enc + 1 / cpu_dec)
 
     # -- context fields ----------------------------------------------------
@@ -200,6 +224,7 @@ def main() -> int:
         "vs_baseline": round(combined / cpu_combined, 3) if cpu_combined else None,
         "tool_encode_GiBs": round(enc, 3),
         "tool_decode_GiBs": round(dec, 3),
+        "tool_encode_constpayload_cached_GiBs": round(enc_cached, 3),
         "cpu_plugin_GiBs": round(cpu_combined, 3),
         "tunnel_h2d_GiBs": round(h2d, 3),
         "tunnel_d2h_GiBs": round(d2h, 3),
