@@ -45,7 +45,7 @@ def _tool_encode_gibps(ec, stripes, iters) -> float:
     want = set(range(ec.get_chunk_count()))
     nbytes = sum(s.nbytes for s in stripes)
     if hasattr(ec, "encode_batch"):
-        ec.encode_batch(stripes[:1])  # warm: compile + matrix upload
+        ec.encode_batch(stripes)  # warm: compile the timed rung + matrix upload
         t0 = time.perf_counter()
         for _ in range(iters):
             ec.encode_batch(stripes)
@@ -68,7 +68,7 @@ def _tool_decode_gibps(ec, stripes, iters) -> float:
         maps.append({c: a for c, a in encoded.items() if c not in ERASURES})
     nbytes = sum(s.nbytes for s in stripes)
     if hasattr(ec, "decode_batch"):
-        ec.decode_batch(maps[:1])  # warm
+        ec.decode_batch(maps)  # warm: compile the timed rung
         t0 = time.perf_counter()
         for _ in range(iters):
             ec.decode_batch(maps)
@@ -126,7 +126,7 @@ def _device_resident_gibps() -> float:
     bits = matrix_to_bitmatrix(Mmat, W)
     rng = np.random.RandomState(0)
     data_np = rng.randint(0, 256, size=(K, 8 * CHUNK)).astype(np.uint8)
-    iters = 32
+    iters = 512
 
     if on_tpu:
         from ceph_tpu.ops.pallas_gf import _matrix_encode_call, prep_matrix_w8
@@ -134,7 +134,7 @@ def _device_resident_gibps() -> float:
         Bp = jnp.asarray(prep_matrix_w8(bits, K))
 
         def step(d32):
-            p = _matrix_encode_call(Bp, d32, K, M, 4096)
+            p = _matrix_encode_call(Bp, d32, K, M, 16384)
             return d32.at[0, :].set(p[0, :] ^ d32[0, :])
 
         init = jax.device_put(jnp.asarray(data_np.view(np.int32)))
@@ -188,12 +188,16 @@ def main() -> int:
     import os
 
     tpu_ec = registry.factory("tpu", dict(profile), "")
+    prior_cache_env = os.environ.get("CEPH_TPU_NO_H2D_CACHE")
     os.environ["CEPH_TPU_NO_H2D_CACHE"] = "1"
     try:
         enc = _tool_encode_gibps(tpu_ec, stripes, ITERS)
         dec = _tool_decode_gibps(tpu_ec, stripes, ITERS)
     finally:
-        del os.environ["CEPH_TPU_NO_H2D_CACHE"]
+        if prior_cache_env is None:
+            os.environ.pop("CEPH_TPU_NO_H2D_CACHE", None)
+        else:
+            os.environ["CEPH_TPU_NO_H2D_CACHE"] = prior_cache_env
     combined = 2 / (1 / enc + 1 / dec)
     # Secondary: the reference benchmark's own semantics (constant 'X'
     # buffer re-encoded each iteration, caches allowed) for comparison.

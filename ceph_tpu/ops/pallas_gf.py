@@ -104,7 +104,7 @@ def matrix_encode_w8(
     data: np.ndarray | jax.Array,
     k: int,
     m: int,
-    tile: int = 4096,
+    tile: int = 16384,
 ) -> np.ndarray:
     """bitmatrix [m*8, k*8] (jerasure layout) x data [k, N] uint8 -> [m, N].
 

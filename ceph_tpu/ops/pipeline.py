@@ -201,7 +201,7 @@ class DeviceStream:
             from ceph_tpu.ops.pallas_gf import _matrix_encode_call
 
             return _matrix_encode_call(self._B, d, self.k, self.rows_out,
-                                       min(4096, n4))
+                                       min(16384, n4))
         if self._mode == "pallas16":
             from ceph_tpu.ops.pallas_gf import _matrix_encode_w16_call
 

@@ -1,0 +1,177 @@
+"""Split-cost probes for the packed-lane kernel: where does the time go?
+
+probe_extract   planes extraction only (16 shift/and/f32-convert per lane),
+                cheap non-MXU reduction to force materialization
+probe_mxu       dots+merge only, planes pre-extracted on device (input is
+                the [2,8k,T] f32 plane tensor; no extraction in-kernel)
+probe_full      production kernel (reference point)
+
+Also sweeps tile width for the production kernel.
+"""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.matrices import reed_sol
+from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
+from ceph_tpu.ops.pallas_gf import _matrix_encode_call, prep_matrix_w8
+
+K, M, W = 8, 4, 8
+ITERS = 512
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def _extract_kernel(x_ref, o_ref, *, k: int, m: int):
+    x = x_ref[:]
+    mask = jnp.int32(0x00010001)
+    lo = jnp.concatenate(
+        [((x >> s) & mask).astype(jnp.float32) for s in range(8)], axis=0
+    )
+    hi = jnp.concatenate(
+        [((x >> (8 + s)) & mask).astype(jnp.float32) for s in range(8)], axis=0
+    )
+    # cheap merge, no MXU: fold 8k rows into m rows by strided XOR of casts
+    acc = lo[: m, :] + hi[: m, :]
+    for r in range(m, 8 * k, m):
+        acc = acc + lo[r:r + m, :] + hi[r:r + m, :]
+    o_ref[:] = acc.astype(jnp.int32)
+
+
+def _mxu_kernel(b_ref, p_ref, o_ref, *, k: int, m: int):
+    dn = (((1,), (0,)), ((), ()))
+    lo = p_ref[0]
+    hi = p_ref[1]
+    accL = jax.lax.dot_general(
+        b_ref[:], lo, dn, precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    accH = jax.lax.dot_general(
+        b_ref[:], hi, dn, precision=jax.lax.Precision.HIGHEST,
+        preferred_element_type=jnp.float32,
+    ).astype(jnp.int32)
+    z = accL + (accH << 8)
+    pb = z & jnp.int32(0x01010101)
+    t = pb.shape[-1]
+    ob = pb.reshape(m, 8, t)
+    packed = ob[:, 0, :]
+    for l in range(1, 8):
+        packed = packed | (ob[:, l, :] << l)
+    o_ref[:] = packed
+
+
+def timeit(fn, init, iters=ITERS, feedback=True):
+    @jax.jit
+    def many(d):
+        def body(c, _):
+            p = fn(c)
+            if feedback:
+                return c.at[0, :].set(p[0, :] ^ c[0, :]), ()
+            return c, ()
+
+        d, _ = jax.lax.scan(body, d, None, length=iters)
+        return d
+
+    w = many(init)
+    jax.block_until_ready(w)
+    t0 = time.perf_counter()
+    w = many(w)
+    jax.block_until_ready(w)
+    return (time.perf_counter() - t0) / iters
+
+
+def main():
+    Mmat = reed_sol.vandermonde_coding_matrix(K, M, W)
+    bits = matrix_to_bitmatrix(Mmat, W)
+    Bp = jnp.asarray(prep_matrix_w8(bits, K))
+    rng = np.random.RandomState(0)
+    chunk = 8 << 20
+    data_np = rng.randint(0, 256, size=(K, chunk), dtype=np.uint8)
+    d32 = jax.device_put(jnp.asarray(data_np.view(np.int32)))
+    n4 = d32.shape[1]
+    nbytes = data_np.nbytes
+
+    # full kernel, tile sweep
+    for tile in (2048, 4096, 8192, 16384):
+        fn = lambda d, t=tile: _matrix_encode_call(Bp, d, K, M, t)
+        dt = timeit(fn, d32)
+        print(f"full  tile={tile:6d}  {nbytes / dt / (1<<30):7.2f} GiB/s", flush=True)
+
+    # extraction-only
+    tile = 4096
+
+    @jax.jit
+    def extract(d):
+        return pl.pallas_call(
+            functools.partial(_extract_kernel, k=K, m=M),
+            out_shape=jax.ShapeDtypeStruct((M, n4), jnp.int32),
+            grid=(_cdiv(n4, tile),),
+            in_specs=[pl.BlockSpec((K, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec((M, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+        )(d)
+
+    dt = timeit(extract, d32)
+    print(f"extract-only      {nbytes / dt / (1<<30):7.2f} GiB/s", flush=True)
+
+    # mxu-only: input is the pre-extracted plane tensor [2, 8K, T] f32
+    planes_np = np.zeros((2, 8 * K, n4), np.float32)
+    x = data_np.view(np.int32).astype(np.int64)
+    for s in range(8):
+        planes_np[0, s * K:(s + 1) * K, :] = ((x >> s) & 0x00010001)
+        planes_np[1, s * K:(s + 1) * K, :] = ((x >> (8 + s)) & 0x00010001)
+    # NB plane-major rows must match prep order (s*k + j): rows above are
+    # [s,K-block] == s*K + j. matches.
+    planes = jax.device_put(jnp.asarray(planes_np))
+
+    @jax.jit
+    def mxu(p):
+        return pl.pallas_call(
+            functools.partial(_mxu_kernel, k=K, m=M),
+            out_shape=jax.ShapeDtypeStruct((M, n4), jnp.int32),
+            grid=(_cdiv(n4, tile),),
+            in_specs=[
+                pl.BlockSpec((M * 8, K * 8), lambda i: (0, 0),
+                             memory_space=pltpu.VMEM),
+                pl.BlockSpec((2, 8 * K, tile), lambda i: (0, 0, i),
+                             memory_space=pltpu.VMEM),
+            ],
+            out_specs=pl.BlockSpec((M, tile), lambda i: (0, i),
+                                   memory_space=pltpu.VMEM),
+        )(Bp, p)
+
+    @jax.jit
+    def mxu_loop(p):
+        def body(c, _):
+            o = mxu(c)
+            return c.at[0, 0, :].set(o[0, :].astype(jnp.float32) + c[0, 0, :]), ()
+
+        p, _ = jax.lax.scan(body, p, None, length=ITERS)
+        return p
+
+    w = mxu_loop(planes)
+    jax.block_until_ready(w)
+    t0 = time.perf_counter()
+    w = mxu_loop(w)
+    jax.block_until_ready(w)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(f"mxu-only (per data-byte equiv) {nbytes / dt / (1<<30):7.2f} GiB/s",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()

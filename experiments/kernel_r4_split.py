@@ -1,0 +1,130 @@
+"""Software-pipelining probe: split each tile into independent half-chains
+so Mosaic's scheduler can overlap the VPU plane extraction of one half with
+the MXU dots of the other. Also checks DEFAULT-precision correctness (the
+2-field values 65536/65537 are not bf16-representable, so DEFAULT should
+MISMATCH -- documenting why HIGHEST is required)."""
+
+from __future__ import annotations
+
+import functools
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.matrices import reed_sol
+from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
+from ceph_tpu.ops.pallas_gf import _matrix_encode_call, prep_matrix_w8
+
+K, M, W = 8, 4, 8
+ITERS = 512
+
+
+def _cdiv(a, b):
+    return -(-a // b)
+
+
+def _half(b_ref, x, prec):
+    mask = jnp.int32(0x00010001)
+    lo = jnp.concatenate(
+        [((x >> s) & mask).astype(jnp.float32) for s in range(8)], axis=0
+    )
+    hi = jnp.concatenate(
+        [((x >> (8 + s)) & mask).astype(jnp.float32) for s in range(8)], axis=0
+    )
+    dn = (((1,), (0,)), ((), ()))
+    accL = jax.lax.dot_general(
+        b_ref[:], lo, dn, precision=prec, preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+    accH = jax.lax.dot_general(
+        b_ref[:], hi, dn, precision=prec, preferred_element_type=jnp.float32
+    ).astype(jnp.int32)
+    return accL + (accH << 8)
+
+
+def _kernel_split(b_ref, x_ref, o_ref, *, k: int, m: int, parts: int, prec):
+    x = x_ref[:]
+    t = x.shape[-1]
+    h = t // parts
+    zs = [_half(b_ref, x[:, i * h:(i + 1) * h], prec) for i in range(parts)]
+    z = jnp.concatenate(zs, axis=-1)
+    pb = z & jnp.int32(0x01010101)
+    ob = pb.reshape(m, 8, t)
+    packed = ob[:, 0, :]
+    for l in range(1, 8):
+        packed = packed | (ob[:, l, :] << l)
+    o_ref[:] = packed
+
+
+def run(name, call, d32, ref, nbytes):
+    out = np.asarray(jax.device_get(call(d32)))
+    ok = bool((out == ref).all())
+
+    @jax.jit
+    def many(d):
+        def body(c, _):
+            p = call(c)
+            return c.at[0, :].set(p[0, :] ^ c[0, :]), ()
+
+        d, _ = jax.lax.scan(body, d, None, length=ITERS)
+        return d
+
+    w = many(d32)
+    jax.block_until_ready(w)
+    t0 = time.perf_counter()
+    w = many(w)
+    jax.block_until_ready(w)
+    dt = (time.perf_counter() - t0) / ITERS
+    print(
+        f"{name:28s} {'bit-exact' if ok else 'MISMATCH '} "
+        f"{nbytes / dt / (1<<30):7.2f} GiB/s", flush=True,
+    )
+
+
+def main():
+    Mmat = reed_sol.vandermonde_coding_matrix(K, M, W)
+    bits = matrix_to_bitmatrix(Mmat, W)
+    Bp = jnp.asarray(prep_matrix_w8(bits, K))
+    rng = np.random.RandomState(0)
+    chunk = 8 << 20
+    data_np = rng.randint(0, 256, size=(K, chunk), dtype=np.uint8)
+    d32 = jax.device_put(jnp.asarray(data_np.view(np.int32)))
+    n4 = d32.shape[1]
+    ref = np.asarray(jax.device_get(_matrix_encode_call(Bp, d32, K, M, 4096)))
+
+    for parts, tile, prec_name, prec in (
+        (2, 8192, "HIGHEST", jax.lax.Precision.HIGHEST),
+        (4, 16384, "HIGHEST", jax.lax.Precision.HIGHEST),
+        (8, 16384, "HIGHEST", jax.lax.Precision.HIGHEST),
+        (1, 16384, "DEFAULT", jax.lax.Precision.DEFAULT),
+    ):
+        @jax.jit
+        def call(d, parts=parts, tile=tile, prec=prec):
+            return pl.pallas_call(
+                functools.partial(
+                    _kernel_split, k=K, m=M, parts=parts, prec=prec
+                ),
+                out_shape=jax.ShapeDtypeStruct((M, n4), jnp.int32),
+                grid=(_cdiv(n4, tile),),
+                in_specs=[
+                    pl.BlockSpec((M * 8, K * 8), lambda i: (0, 0),
+                                 memory_space=pltpu.VMEM),
+                    pl.BlockSpec((K, tile), lambda i: (0, i),
+                                 memory_space=pltpu.VMEM),
+                ],
+                out_specs=pl.BlockSpec((M, tile), lambda i: (0, i),
+                                       memory_space=pltpu.VMEM),
+            )(Bp, d)
+
+        run(f"split{parts} tile={tile} {prec_name}", call, d32, ref,
+            data_np.nbytes)
+
+
+if __name__ == "__main__":
+    main()
