@@ -32,12 +32,112 @@ from ceph_tpu.plugins.interface import ErasureCodeProfile
 
 
 class _TpuMixin:
-    """Routes codec math through the persistent device pipeline."""
+    """Routes codec math through the persistent device pipeline.
+
+    Profile keys ``mesh_shard`` / ``mesh_sub`` / ``mesh_data`` (all default
+    1) additionally shard the device work over a jax.sharding.Mesh: the
+    GF(2) contraction runs SPMD over the ``shard`` axis with psum over ICI
+    (the fan-out/gather role of the reference's ECBackend,
+    src/osd/ECBackend.cc:1976-2030) and chunk columns ride the ``sub`` axis
+    (sub-chunk parallelism, ErasureCodeInterface.h:251-300).  A pool
+    profile like ``plugin=tpu mesh_shard=4`` therefore exercises XLA
+    collectives inside the storage write/read path.  Mesh mode requires a
+    matrix technique with w=8 and k divisible by mesh_shard.
+    """
 
     _device_codec: DeviceCodec | None = None
+    _mesh_codec = None
+    _mesh_spec = (1, 1, 1)
 
     def _engine(self):
         return xla_gf  # fallback path for shapes the pipeline can't take
+
+    def init(self, profile: ErasureCodeProfile) -> None:
+        self._mesh_spec = (
+            int(profile.get("mesh_data", 1) or 1),
+            int(profile.get("mesh_shard", 1) or 1),
+            int(profile.get("mesh_sub", 1) or 1),
+        )
+        super().init(profile)
+        if self._mesh_active():
+            import errno
+
+            from ceph_tpu.plugins.interface import ErasureCodeError
+
+            if getattr(self, "matrix", None) is None:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    "mesh_shard/mesh_sub need a matrix technique "
+                    "(reed_sol_van / reed_sol_r6_op / cauchy as matrix)",
+                )
+            if self.w != 8:
+                raise ErasureCodeError(
+                    errno.EINVAL, f"mesh mode supports w=8, not w={self.w}"
+                )
+            if self.k % self._mesh_spec[1]:
+                raise ErasureCodeError(
+                    errno.EINVAL,
+                    f"k={self.k} must be divisible by "
+                    f"mesh_shard={self._mesh_spec[1]}",
+                )
+
+    def _mesh_active(self) -> bool:
+        return any(n > 1 for n in self._mesh_spec)
+
+    def _mesh(self):
+        if self._mesh_codec is None:
+            from ceph_tpu.parallel.distributed import (
+                DistributedCodec,
+                make_mesh,
+            )
+
+            nd, ns, nb = self._mesh_spec
+            mesh = make_mesh(n_data=nd, n_shard=ns, n_sub=nb)
+            self._mesh_codec = DistributedCodec(self.matrix, self.w, mesh)
+        return self._mesh_codec
+
+    # -- mesh (SPMD) data path --------------------------------------------
+
+    def _mesh_encode_many(self, stacks: List[np.ndarray]) -> List[np.ndarray]:
+        """Encode a list of [k, bs] stripes in one sharded dispatch; pads
+        the column axis to the sub-axis size and the batch axis to the
+        data-axis size (GF parity is column-independent, so zero padding is
+        exact and trimmed on the way out)."""
+        nd, ns, nb = self._mesh_spec
+        bs = stacks[0].shape[1]
+        arr = np.stack(stacks)  # [B, k, bs]
+        padn = (-bs) % nb
+        if padn:
+            arr = np.pad(arr, ((0, 0), (0, 0), (0, padn)))
+        padb = (-arr.shape[0]) % nd
+        if padb:
+            arr = np.pad(arr, ((0, padb), (0, 0), (0, 0)))
+        parity = np.asarray(self._mesh().encode(arr))
+        return [parity[i, :, :bs] for i in range(len(stacks))]
+
+    def _mesh_decode_many(
+        self, sig: Sequence[int], erased: Sequence[int],
+        survivor_stacks: List[np.ndarray],
+    ) -> List[np.ndarray]:
+        """Reconstruct erased chunks for stripes sharing one erasure
+        signature: host-side row inversion (the ISA decode-table role),
+        device-side sharded GF(2) contraction."""
+        from ceph_tpu.ops.pipeline import matrix_reconstruct_rows
+
+        nd, ns, nb = self._mesh_spec
+        _, rows = matrix_reconstruct_rows(
+            self.matrix, self.k, self.m, self.w, list(sig), list(erased)
+        )
+        bs = survivor_stacks[0].shape[1]
+        arr = np.stack(survivor_stacks)  # [B, k, bs]
+        padn = (-bs) % nb
+        if padn:
+            arr = np.pad(arr, ((0, 0), (0, 0), (0, padn)))
+        padb = (-arr.shape[0]) % nd
+        if padb:
+            arr = np.pad(arr, ((0, padb), (0, 0), (0, 0)))
+        rec = np.asarray(self._mesh().reconstruct(rows, arr))
+        return [rec[i, :, :bs] for i in range(len(survivor_stacks))]
 
     def _dc(self) -> DeviceCodec:
         if self._device_codec is None:
@@ -63,11 +163,29 @@ class _TpuMixin:
     # -- sync contract (one fused dispatch per call) -----------------------
 
     def jerasure_encode(self, data: np.ndarray) -> np.ndarray:
+        if self._mesh_active():
+            return self._mesh_encode_many([np.ascontiguousarray(data)])[0]
         if self._pipeline_ok(data.shape[1]):
             return self._dc().encode(np.ascontiguousarray(data))
         return super().jerasure_encode(data)
 
     def jerasure_decode(self, have, blocksize):
+        if self._mesh_active():
+            km = self.k + self.m
+            available = sorted(have.keys())
+            erased = [i for i in range(km) if i not in have]
+            out = {c: np.asarray(a, dtype=np.uint8) for c, a in have.items()}
+            if not erased:
+                return out
+            if len(available) < self.k:
+                raise ValueError("not enough chunks to decode")
+            sel = available[:self.k]
+            rec = self._mesh_decode_many(
+                available, erased, [np.stack([out[c] for c in sel])]
+            )[0]
+            for j, e in enumerate(erased):
+                out[e] = rec[j]
+            return out
         if self._pipeline_ok(blocksize):
             return self._dc().decode(have, blocksize)
         return super().jerasure_decode(have, blocksize)
@@ -83,6 +201,23 @@ class _TpuMixin:
         prepared = [self.encode_prepare(_to_u8(s)) for s in stripes]
         k, m = self.k, self.m
         blocksize = len(prepared[0][0])
+        if self._mesh_active():
+            # sub-group by blocksize: one stacked sharded dispatch per size
+            by_size: Dict[int, List[int]] = {}
+            for idx, p in enumerate(prepared):
+                by_size.setdefault(len(p[0]), []).append(idx)
+            out: List[Dict[int, np.ndarray]] = [None] * len(prepared)  # type: ignore
+            for idxs in by_size.values():
+                codings = self._mesh_encode_many(
+                    [np.stack([prepared[i][j] for j in range(k)])
+                     for i in idxs]
+                )
+                for i, coding in zip(idxs, codings):
+                    enc = dict(prepared[i])
+                    for j in range(m):
+                        enc[k + j] = coding[j]
+                    out[i] = enc
+            return out
         if not self._pipeline_ok(blocksize):
             out = []
             for p in prepared:
@@ -114,6 +249,14 @@ class _TpuMixin:
         prepared = self.encode_prepare(_to_u8(data))
         k, m = self.k, self.m
         blocksize = len(prepared[0])
+        if self._mesh_active():
+            coding = self._mesh_encode_many(
+                [np.stack([prepared[j] for j in range(k)])]
+            )[0]
+            enc = dict(prepared)
+            for i in range(m):
+                enc[k + i] = coding[i]
+            return lambda: enc
         if not self._pipeline_ok(blocksize):
             result = self.encode(set(range(k + m)), data)
             return lambda: result
@@ -159,6 +302,33 @@ class _TpuMixin:
                         c: np.asarray(a, dtype=np.uint8)
                         for c, a in chunk_maps[i].items()
                     }
+                continue
+            if self._mesh_active():
+                sel = sorted(sig)[:self.k]
+                by_size: Dict[int, List[int]] = {}
+                for i in idxs:
+                    by_size.setdefault(
+                        len(next(iter(chunk_maps[i].values()))), []
+                    ).append(i)
+                for sized_idxs in by_size.values():
+                    recs = self._mesh_decode_many(
+                        list(sig), erased,
+                        [
+                            np.stack([
+                                np.asarray(chunk_maps[i][c], dtype=np.uint8)
+                                for c in sel
+                            ])
+                            for i in sized_idxs
+                        ],
+                    )
+                    for pos, i in enumerate(sized_idxs):
+                        full = {
+                            c: np.asarray(a, dtype=np.uint8)
+                            for c, a in chunk_maps[i].items()
+                        }
+                        for j, e in enumerate(erased):
+                            full[e] = recs[pos][j]
+                        results[i] = full
                 continue
             if not self._pipeline_ok(blocksize):
                 for i in idxs:

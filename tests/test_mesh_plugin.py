@@ -1,0 +1,133 @@
+"""Mesh-sharded TPU plugin: ICI collectives inside the storage path.
+
+The pool profile ``plugin=tpu mesh_shard=N [mesh_sub=M]`` makes the codec
+run its GF(2) contraction SPMD over a jax.sharding.Mesh (psum over the
+shard axis = the fan-out/gather role of ECBackend.cc:1976-2030), so the
+write/degraded-read/recovery paths of the storage engine exercise XLA
+collectives.  Runs on the 8-virtual-CPU-device mesh from conftest.py.
+"""
+
+import asyncio
+import os
+
+import numpy as np
+import pytest
+
+from ceph_tpu.plugins import registry as registry_mod
+from ceph_tpu.plugins.interface import ErasureCodeError
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _factory(profile):
+    return registry_mod.instance().factory(profile.pop("plugin"), profile, "")
+
+
+def test_mesh_encode_bit_exact_vs_jerasure():
+    prof = {"technique": "reed_sol_van", "k": "4", "m": "2",
+            "mesh_shard": "4", "mesh_sub": "2"}
+    tpu = _factory({"plugin": "tpu", **prof})
+    cpu = _factory({"plugin": "jerasure", **prof})
+    want = set(range(6))
+    rng = np.random.RandomState(7)
+    for size in (4096, 24_000, 100_001):  # odd size: pad+trim path
+        payload = rng.randint(0, 256, size=size, dtype=np.uint8)
+        a = tpu.encode(want, payload)
+        b = cpu.encode(want, payload)
+        for c in want:
+            assert np.array_equal(a[c], b[c]), f"chunk {c} size {size}"
+
+
+def test_mesh_decode_all_two_erasure_signatures():
+    prof = {"technique": "reed_sol_van", "k": "4", "m": "2",
+            "mesh_shard": "2"}
+    tpu = _factory({"plugin": "tpu", **prof})
+    rng = np.random.RandomState(8)
+    payload = rng.randint(0, 256, size=16384, dtype=np.uint8)
+    want = set(range(6))
+    enc = tpu.encode(want, payload)
+    import itertools
+
+    for erased in itertools.combinations(range(6), 2):
+        have = {c: a for c, a in enc.items() if c not in erased}
+        dec = tpu.decode(want, have)
+        for c in want:
+            assert np.array_equal(dec[c], enc[c]), f"erased={erased} chunk={c}"
+
+
+def test_mesh_encode_batch_and_decode_batch():
+    prof = {"technique": "reed_sol_van", "k": "8", "m": "4",
+            "mesh_shard": "4", "mesh_sub": "2"}
+    tpu = _factory({"plugin": "tpu", **prof})
+    cpu = _factory({"plugin": "jerasure",
+                    "technique": "reed_sol_van", "k": "8", "m": "4"})
+    rng = np.random.RandomState(9)
+    # mixed sizes: the mesh batch paths must sub-group by blocksize
+    stripes = [rng.randint(0, 256, size=sz, dtype=np.uint8)
+               for sz in (32768, 16000, 32768, 16000, 8192)]
+    encs = tpu.encode_batch(stripes)
+    want = set(range(12))
+    for s, enc in zip(stripes, encs):
+        ref = cpu.encode(want, s)
+        for c in want:
+            assert np.array_equal(enc[c], ref[c])
+    maps = [{c: a for c, a in enc.items() if c not in (0, 9)} for enc in encs]
+    decs = tpu.decode_batch(maps)
+    for enc, dec in zip(encs, decs):
+        for c in want:
+            assert np.array_equal(dec[c], enc[c])
+
+
+def test_mesh_profile_validation():
+    with pytest.raises(ErasureCodeError):
+        _factory({"plugin": "tpu", "technique": "reed_sol_van",
+                  "k": "3", "m": "2", "mesh_shard": "2"})  # k % shard != 0
+    with pytest.raises(ErasureCodeError):
+        _factory({"plugin": "tpu", "technique": "cauchy_good",
+                  "k": "4", "m": "2", "mesh_shard": "2"})  # bitmatrix tech
+    with pytest.raises(ErasureCodeError):
+        _factory({"plugin": "tpu", "technique": "reed_sol_van", "w": "16",
+                  "k": "4", "m": "2", "mesh_shard": "2"})  # w != 8
+
+
+def test_mesh_plugin_through_storage_engine():
+    """ECCluster with a mesh-sharded pool profile: write -> kill ->
+    degraded read -> revive -> auto-recovery, all device work SPMD over
+    the virtual mesh (VERDICT r3 item 3: the storage path, not a
+    standalone codec)."""
+    from ceph_tpu.osd.cluster import ECCluster
+
+    async def main():
+        c = ECCluster(
+            8,
+            {"technique": "reed_sol_van", "k": "4", "m": "2",
+             "mesh_shard": "4", "mesh_sub": "2"},
+            plugin="tpu",
+        )
+        payloads = {f"obj{i}": os.urandom(20_000 + 137 * i) for i in range(4)}
+        for oid, p in payloads.items():
+            await c.write(oid, p)
+        victim = c.backend.acting_set("obj0")[0]
+        c.kill_osd(victim)
+        # writes during degradation so the victim's shards really go stale
+        for oid in list(payloads)[:2]:
+            payloads[oid] = os.urandom(22_000)
+            await c.write(oid, payloads[oid])
+        for oid, p in payloads.items():  # degraded reads reconstruct on mesh
+            assert await c.read(oid) == p
+        c.revive_osd(victim)
+        c.start_auto_recovery(interval=0.05)
+        assert await c.degraded_report(), "expected stale shards"
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + 30.0
+        while await c.degraded_report():
+            if loop.time() > deadline:
+                raise AssertionError("cluster never went clean")
+            await asyncio.sleep(0.1)
+        for oid, p in payloads.items():
+            assert await c.read(oid) == p
+        await c.shutdown()
+
+    run(main())
