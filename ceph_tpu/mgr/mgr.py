@@ -65,6 +65,15 @@ class ClusterState:
                 degraded.append(oid)
         return degraded
 
+    def scrub_inconsistent(self) -> List[str]:
+        """Objects whose last deep scrub found inconsistencies and which
+        have not yet re-scrubbed clean (ScrubStore aggregation role)."""
+        out = set()
+        for osd in self.cluster.osds:
+            for backend in osd.pools.values():
+                out.update(backend.scrub_errors)
+        return sorted(out)
+
     def dump(self) -> dict:
         osds = self.osd_stats()
         n_up = sum(1 for s in osds.values() if s["up"])
@@ -73,6 +82,7 @@ class ClusterState:
             "osd_stats": osds,
             "pools": self.pool_stats(),
             "degraded_objects": self.degraded_objects(),
+            "scrub_inconsistent": self.scrub_inconsistent(),
         }
 
 
@@ -92,6 +102,13 @@ def health_checks(state: dict) -> dict:
             "severity": "HEALTH_WARN",
             "summary":
                 f"{len(degraded)} objects have shards on down OSDs",
+        }
+    inconsistent = state.get("scrub_inconsistent") or []
+    if inconsistent:
+        checks["OSD_SCRUB_ERRORS"] = {
+            "severity": "HEALTH_ERR",
+            "summary":
+                f"{len(inconsistent)} objects have scrub inconsistencies",
         }
     status = "HEALTH_OK"
     for c in checks.values():

@@ -179,20 +179,30 @@ class ECCluster:
 
     # -- failure control (thrasher surface) --------------------------------
 
+    def _notify_peering(self) -> None:
+        """OSD up/down/weight events wake every peering loop immediately
+        (event-driven peering; the reference re-peers on OSDMap change)."""
+        for osd in self.osds:
+            osd.request_peering()
+
     def kill_osd(self, osd_id: int) -> None:
         self.messenger.mark_down(f"osd.{osd_id}")
+        self._notify_peering()
 
     def revive_osd(self, osd_id: int) -> None:
         self.messenger.mark_up(f"osd.{osd_id}")
+        self._notify_peering()
 
     def out_osd(self, osd_id: int) -> None:
         """Mark an OSD out: CRUSH remaps its shards (weight -> 0)."""
         if self.placement is not None:
             self.placement.mark_out(osd_id)
+        self._notify_peering()
 
     def in_osd(self, osd_id: int, weight: float = 1.0) -> None:
         if self.placement is not None:
             self.placement.mark_in(osd_id, weight)
+        self._notify_peering()
 
     # -- monitor-backed cluster (mon quorum owns the osdmap) ---------------
 
@@ -243,6 +253,7 @@ class ECCluster:
                     for osd_s, w in m["weights"].items():
                         backend.placement.weights[int(osd_s)] = w
                     backend.placement.epoch += 1  # invalidate pg cache
+                    self._notify_peering()  # re-peer on every map epoch
 
         self._osdmap_epoch = 0
         backend.mon_hook = mon_hook

@@ -139,6 +139,8 @@ class OSDShard:
         else:
             self.opq = WeightedPriorityQueue()
         self._op_event = asyncio.Event()
+        #: background-scrub rotating cursor (PG scrub scheduling role)
+        self._scrub_cursor = 0
         #: simulates a hung daemon: alive on the wire but never responding
         #: (what OSD heartbeats exist to catch, reference OSD.cc:4612
         #: handle_osd_ping / HeartbeatMap suicide timeouts)
@@ -189,10 +191,20 @@ class OSDShard:
 
             interval = float(get_config().get_val("osd_tick_interval"))
         self._tick_interval = interval
+        self._peer_event = asyncio.Event()
         self._tick_task = asyncio.get_event_loop().create_task(
             self._tick_loop()
         )
         self.messenger.adopt_task(f"{self.name}.tick", self._tick_task)
+
+    def request_peering(self) -> None:
+        """Wake the peering loop NOW (event-driven peering: OSDMap epoch
+        change, OSD up/down -- the reference re-peers on every map change,
+        src/osd/PG.cc peering state machine, instead of waiting out a
+        timer).  No-op until start_tick has run."""
+        ev = getattr(self, "_peer_event", None)
+        if ev is not None:
+            ev.set()
 
     async def _tick_loop(self) -> None:
         while True:
@@ -206,17 +218,103 @@ class OSDShard:
                 import traceback
 
                 traceback.print_exc(file=sys.stderr)
-            await asyncio.sleep(self._tick_interval)
+            # sleep until the next scheduled tick OR a peering event
+            # (up/down/map change) -- whichever comes first
+            try:
+                await asyncio.wait_for(
+                    self._peer_event.wait(), timeout=self._tick_interval
+                )
+            except asyncio.TimeoutError:
+                pass
+            self._peer_event.clear()
 
     async def peering_tick(self) -> int:
-        """One peering round over every hosted pool; returns the number
-        of recovery actions attempted."""
+        """One peering round over every hosted pool, then a rate-limited
+        background deep-scrub slice; returns the number of recovery
+        actions attempted."""
         if self.frozen or self.messenger.is_down(self.name):
             return 0
         total = 0
         for backend in self.pools.values():
             total += await backend.peering_pass()
+        total += await self.scrub_tick()
         return total
+
+    def _scrub_base_list(self):
+        """Base-oid list for the scrub cursor; rebuilt only when the
+        cursor wraps (a fresh listing every tick would pay O(objects)
+        to pick osd_scrub_objects_per_tick of them)."""
+        cached = getattr(self, "_scrub_bases", None)
+        if cached is None or self._scrub_cursor == 0 or                 self._scrub_cursor >= len(cached):
+            cached = sorted({
+                base
+                for stored in self.store.list_objects()
+                for base, _, tag in [stored.rpartition("@")]
+                if base and tag.isdigit()
+            })
+            self._scrub_bases = cached
+            self._scrub_cursor = min(self._scrub_cursor, len(cached))                 if cached else 0
+        return cached
+
+    async def scrub_tick(self) -> int:
+        """Background deep-scrub scheduler (reference: PG scrub
+        reservation/scheduling, src/osd/PG.cc): each tick deep-scrubs up
+        to ``osd_scrub_objects_per_tick`` objects this OSD is currently
+        PRIMARY for (rotating cursor over the local store), tagged with
+        the mClock ``scrub`` op class, and feeds any inconsistency
+        straight into shard recovery -- the cluster heals silent
+        corruption with no manual call (qa test-erasure-eio role)."""
+        from ceph_tpu.utils.config import get_config
+
+        limit = int(get_config().get_val("osd_scrub_objects_per_tick"))
+        if limit <= 0 or not self.pools:
+            return 0
+        # error records for objects this OSD no longer leads pin mgr
+        # health forever (the new primary re-detects real damage): drop
+        for backend in self.pools.values():
+            for e_oid in list(backend.scrub_errors):
+                e_acting = backend.acting_set(e_oid)
+                lead = None
+                for sh in range(backend.km):
+                    if backend._shard_up(e_acting, sh):
+                        lead = f"osd.{e_acting[sh]}"
+                        break
+                if lead != self.name:
+                    backend.scrub_errors.pop(e_oid, None)
+        bases = self._scrub_base_list()
+        if not bases:
+            return 0
+        repaired = 0
+        scanned = 0
+        n = len(bases)
+        start = self._scrub_cursor % n
+        for i in range(n):
+            if scanned >= limit:
+                break
+            base = bases[(start + i) % n]
+            self._scrub_cursor = (start + i + 1) % n
+            for backend in self.pools.values():
+                acting = backend.acting_set(base)
+                primary = None
+                for sh in range(backend.km):
+                    if backend._shard_up(acting, sh):
+                        primary = f"osd.{acting[sh]}"
+                        break
+                if primary != self.name:
+                    continue
+                scanned += 1
+                try:
+                    report = await backend.deep_scrub(base)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 -- scrub must not kill
+                    # the tick (e.g. a degraded object mid-recovery)
+                    self.perf.inc("scrub_failed")
+                    break
+                if not report["ok"]:
+                    repaired += await backend.scrub_repair(base, report)
+                break
+        return repaired
 
     def _op_cost(self, msg) -> int:
         if isinstance(msg, ECSubWrite):
@@ -294,7 +392,87 @@ class OSDShard:
         op = msg["op"]
         oid = msg.get("oid", "")
         soid = f"{oid}@meta"
+        if op == "pg_log_info":
+            # O(1) peering poll: log head/tail only.  A primary whose
+            # watermark is current skips this OSD entirely (reference
+            # GetInfo, src/osd/PG.cc peering).
+            self.perf.inc("pg_log_info_serve")
+            await self.messenger.send_message(self.name, src, {
+                "op": "pg_log_info_reply", "tid": msg["tid"],
+                "from": self.name,
+                "head_seq": self.pglog.head_seq,
+                "tail_seq": self.pglog.tail_seq,
+            })
+            return
+        if op == "pg_log_entries":
+            # delta peering: entries above the requester's watermark
+            # (reference GetLog / missing-set computation).  complete=False
+            # means the log was trimmed past the gap -> backfill.
+            from_seq = int(msg.get("from_seq", 0))
+            complete = self.pglog.covers(from_seq)
+            ents = []
+            if complete:
+                for e in self.pglog.entries_after(from_seq):
+                    base, _, tag = e.oid.rpartition("@")
+                    ents.append((e.seq, base, tag, tuple(e.obj_version)))
+            self.perf.inc("pg_log_entries_serve")
+            await self.messenger.send_message(self.name, src, {
+                "op": "pg_log_entries_reply", "tid": msg["tid"],
+                "from": self.name, "complete": complete,
+                "head_seq": self.pglog.head_seq, "entries": ents,
+            })
+            return
+        if op == "pg_rollback":
+            # divergent-entry rollback: undo this shard's torn entries
+            # locally from the log instead of re-pushing the whole shard
+            # (reference PGLog rollback via EC transaction rollback info,
+            # src/osd/ECTransaction.cc:97).
+            target_soid = msg["soid"]
+            to_version = vt(tuple(msg["to_version"]))
+            ok = self.pglog.rollback_object_to(
+                target_soid, to_version, self.store
+            )
+            if ok:
+                try:
+                    self.store.stat(target_soid)
+                    self._applied_version[target_soid] = to_version
+                except FileNotFoundError:
+                    self._applied_version.pop(target_soid, None)
+                self.perf.inc("pglog_rollback")
+            await self.messenger.send_message(self.name, src, {
+                "op": "pg_rollback_reply", "tid": msg["tid"],
+                "from": self.name, "ok": ok,
+            })
+            return
+        if op == "obj_versions":
+            # targeted peering probe: versions for NAMED objects only
+            # (per-object GetInfo; the clean-path replacement for the
+            # pg_list full scan).
+            out = {}
+            for base in msg.get("oids", []):
+                shards = {}
+                for s in range(msg.get("km", 0)):
+                    so = shard_oid(base, s)
+                    try:
+                        self.store.stat(so)
+                    except FileNotFoundError:
+                        continue
+                    shards[s] = tuple(vt(self.store.getattr(so, VERSION_KEY)))
+                mv = None
+                try:
+                    self.store.stat(f"{base}@meta")
+                    mv = self.store.getattr(f"{base}@meta", "_meta_version") or 0
+                except FileNotFoundError:
+                    pass
+                out[base] = {"shards": shards, "meta": mv}
+            self.perf.inc("obj_versions_serve")
+            await self.messenger.send_message(self.name, src, {
+                "op": "obj_versions_reply", "tid": msg["tid"],
+                "from": self.name, "objects": out,
+            })
+            return
         if op == "pg_list":
+            self.perf.inc("pg_list_serve")
             # peering scan: report every shard object this OSD holds with
             # its version stamp (the role of the peering Query/log+missing
             # exchange, reference src/osd/PG.cc GetInfo/GetLog).  Shard
@@ -348,6 +526,14 @@ class OSDShard:
                     .omap_setkeys(soid, msg["omap"])
                     .setattr(soid, "_meta_version", ver)
                 )
+                # log the apply so delta peering discovers meta staleness
+                # the same way it does chunk staleness (full-state omap
+                # replication is not log-rollbackable; peering re-applies
+                # the newest replica instead)
+                self.pglog.append(
+                    soid, "write", (ver, ""), rollbackable=False,
+                )
+                self.pglog.maybe_trim()
                 self.store.queue_transaction(txn)
             await self.messenger.send_message(self.name, src, {
                 "op": "meta_apply_reply", "tid": msg["tid"],
@@ -554,8 +740,6 @@ class OSDShard:
     async def handle_sub_write(self, src: str, msg: ECSubWrite) -> None:
         """reference ECBackend::handle_sub_write (:922): log the operation,
         then apply the transaction (log_operation + queue_transactions)."""
-        from ceph_tpu.osd.pglog import PGLogEntry
-
         soid = shard_oid(msg.oid, msg.from_shard)
         new_vt = vt(msg.at_version)
         cur_vt = self._applied_version.get(soid)
@@ -612,20 +796,36 @@ class OSDShard:
             await self.messenger.send_message(self.name, src, reply)
             return
         self._applied_version[soid] = new_vt
+        # log_operation before queue_transactions (reference order,
+        # ECBackend.cc:922): snapshot the pre-apply state so a torn write
+        # can be rolled back locally (divergent-entry rollback) and give
+        # the entry this OSD's monotonic sequence for delta peering.
         try:
             prior = self.store.stat(soid)
+            existed = True
         except FileNotFoundError:
             prior = 0
-        if new_vt[0] > self.pglog.head_version:
-            self.pglog.append(
-                PGLogEntry(
-                    version=new_vt[0],
-                    oid=soid,
-                    op="append",
-                    prior_size=prior,
+            existed = False
+        prior_attrs: Dict[str, object] = {}
+        rollbackable = True
+        for top in msg.transaction.ops:
+            if top.op == "setattr" and top.oid == soid:
+                prior_attrs[top.attr_name] = (
+                    self.store.getattr(soid, top.attr_name) if existed
+                    else None
                 )
-            )
-            self.pglog.maybe_trim()
+            elif existed and top.op == "write" and top.offset < prior:
+                rollbackable = False  # overwrites prior bytes: needs push
+            elif existed and top.op == "truncate" and top.offset < prior:
+                rollbackable = False
+            elif top.op in ("remove", "omap_set", "omap_rm", "omap_clear"):
+                rollbackable = False
+        self.pglog.append(
+            soid, "write", new_vt,
+            existed=existed, prior_size=prior,
+            prior_attrs=prior_attrs or None, rollbackable=rollbackable,
+        )
+        self.pglog.maybe_trim()
         self.store.queue_transaction(msg.transaction)
         self.perf.inc("sub_write")
         reply = ECSubWriteReply(
@@ -767,6 +967,18 @@ class ECBackend:
         # CRUSH placement engine (ceph_tpu.osd.placement.CrushPlacement);
         # None falls back to the seeded-permutation CRUSH-lite below.
         self.placement = placement
+        # -- delta peering state (pg_missing_t / peer_info roles) ----------
+        #: last log sequence processed per peer OSD; a peer whose head
+        #: equals its watermark contributes zero peering traffic
+        self._peer_seq: Dict[str, int] = {}
+        #: objects known to need attention (writes that missed shards,
+        #: recoveries pending on down OSDs) -- the pg_missing_t analogue
+        self._dirty: set = set()
+        #: replicated-metadata objects in the same state
+        self._dirty_meta: set = set()
+        #: last inconsistent deep-scrub reports (ScrubStore role);
+        #: cleared when a re-scrub comes back clean
+        self.scrub_errors: Dict[str, dict] = {}
 
     # -- placement (CRUSH-lite) --------------------------------------------
 
@@ -800,7 +1012,9 @@ class ECBackend:
             op = msg.get("op")
             if op in ("meta_get_reply", "meta_apply_reply",
                       "omap_cas_reply", "watch_reply", "notify_reply",
-                      "pg_list_reply"):
+                      "pg_list_reply", "pg_log_info_reply",
+                      "pg_log_entries_reply", "pg_rollback_reply",
+                      "obj_versions_reply"):
                 state = self._pending.get(msg.get("tid"))
                 if state is not None:
                     state["replies"][src] = msg
@@ -967,6 +1181,10 @@ class ECBackend:
         # min_size: an EC pool needs at least k live shards to accept writes
         if len(up) < self.k:
             raise IOError(f"cannot write {oid}: only {len(up)} shards up")
+        placed = [s for s in range(self.km) if acting[s] is not None]
+        if len(up) < len(placed):
+            # writing degraded: the down holders miss this version
+            self._dirty.add(oid)
         tid = self._new_tid()
         done = asyncio.get_event_loop().create_future()
         self._pending[tid] = {
@@ -1021,6 +1239,7 @@ class ECBackend:
         never failed by late deaths.  Shared by every fan-out path (full
         write, RMW write, recovery push)."""
         state = self._pending[tid]
+        orig_expected = set(state["expected"])
         try:
             if not done.done():
                 state["expected"] = {
@@ -1034,7 +1253,12 @@ class ECBackend:
                     )
                 if state["committed"] >= state["expected"]:
                     done.set_result(True)
-            await asyncio.wait_for(done, timeout=30)
+            from ceph_tpu.utils.config import get_config as _gc
+
+            await asyncio.wait_for(
+                done, timeout=float(_gc().get_val(
+                    "osd_client_op_commit_timeout"))
+            )
             # shards may have dropped out mid-op (missed-base skips): the
             # write only durably exists if enough shards actually applied
             if len(state["committed"]) < min_acks:
@@ -1043,6 +1267,11 @@ class ECBackend:
                     f"applied (need {min_acks})"
                 )
         finally:
+            # pg_missing_t bookkeeping: any fan-out that did not reach its
+            # full expected set leaves a shard behind -- remember the
+            # object so event-driven peering probes it without a scan
+            if state["committed"] != orig_expected:
+                self._dirty.add(oid)
             del self._pending[tid]
 
     # -- read path ---------------------------------------------------------
@@ -1400,6 +1629,8 @@ class ECBackend:
         ]
         if len(up) < self.k:
             raise IOError(f"cannot write {oid}: only {len(up)} shards up")
+        if len(up) < len([s for s in range(self.km) if acting[s] is not None]):
+            self._dirty.add(oid)  # down holders miss this version
         tid = self._new_tid()
         done = asyncio.get_event_loop().create_future()
         self._pending[tid] = {
@@ -1439,6 +1670,8 @@ class ECBackend:
         up = [s for s in range(self.km) if self._shard_up(acting, s)]
         if not up:
             raise IOError(f"cannot remove {oid}: no shards up")
+        if len(up) < len([s for s in range(self.km) if acting[s] is not None]):
+            self._dirty.add(oid)  # down holders keep a doomed copy
         if oid not in self._versions:
             await self._stat(oid)
         version = self._next_version(oid)
@@ -1475,7 +1708,7 @@ class ECBackend:
     # own sequence; the acting[0] OSD is the atomicity (CAS) and
     # watch/notify authority.
 
-    def _meta_targets(self, oid: str):
+    def _meta_targets(self, oid: str, mark_dirty: bool = False):
         acting = self.acting_set(oid)
         up = [
             f"osd.{acting[s]}"
@@ -1484,6 +1717,10 @@ class ECBackend:
         ]
         if not up:
             raise IOError(f"no up OSDs for {oid} metadata")
+        if mark_dirty and len(up) < len(
+            [s for s in range(self.km) if acting[s] is not None]
+        ):
+            self._dirty_meta.add(oid)  # down replicas miss this version
         return up
 
     async def _meta_roundtrip(self, targets, payload: dict,
@@ -1526,7 +1763,7 @@ class ECBackend:
         step; concurrent plain writers are last-writer-wins (atomic
         read-modify-write goes through omap_cas / cls methods, as in the
         reference)."""
-        targets = self._meta_targets(oid)
+        targets = self._meta_targets(oid, mark_dirty=True)
         omap = {} if clear else await self._meta_read(oid)
         if rms:
             for k in rms:
@@ -1540,6 +1777,8 @@ class ECBackend:
         })
         if not replies:
             raise IOError(f"metadata write for {oid} reached no OSD")
+        if len(replies) < len(targets):
+            self._dirty_meta.add(oid)  # a replica missed this version
 
     async def omap_set(self, oid: str, kvs: Dict[str, bytes]) -> None:
         await self._meta_write(oid, sets=dict(kvs))
@@ -1662,16 +1901,28 @@ class ECBackend:
             "ok": True,
         }
         chunks: Dict[int, np.ndarray] = {}
+        seen_versions = set()
         for s in up:
             reply = replies.get(s)
             if reply is None or oid in (reply.errors if reply else {}):
                 (report["crc_errors"] if reply else report["missing"]).append(s)
                 continue
+            attrs = reply.attrs_read.get(oid) or {}
+            seen_versions.add(vt(attrs.get(VERSION_KEY)))
             bufs = reply.buffers_read.get(oid)
             if bufs:
                 chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
             else:
                 report["missing"].append(s)
+        if len(seen_versions) > 1:
+            # mixed versions: an in-flight write or a stale shard --
+            # that is peering's jurisdiction, not a scrub inconsistency;
+            # report clean-with-deferral instead of a false parity error
+            # (the reference scrubber blocks on in-progress writes)
+            self.perf.inc("scrub_deferred")
+            report["deferred"] = True
+            self.scrub_errors.pop(oid, None)
+            return report
         dpos = ecutil.data_positions(self.ec)
         if all(p in chunks for p in dpos):
             data = np.stack([chunks[p] for p in dpos])
@@ -1684,8 +1935,44 @@ class ECBackend:
         report["ok"] = not (
             report["crc_errors"] or report["missing"] or report["parity_mismatch"]
         )
+        if report["ok"]:
+            self.scrub_errors.pop(oid, None)
+        else:
+            self.scrub_errors[oid] = report
+            self.perf.inc("scrub_inconsistent")
         self.perf.inc("deep_scrub")
         return report
+
+    async def scrub_repair(self, oid: str, report: dict) -> int:
+        """Repair every shard a deep scrub flagged (crc error / missing /
+        parity mismatch) by reconstructing it from the consistent set and
+        pushing it back -- the scrub-driven auto-repair loop (reference:
+        PG repair + qa/standalone/erasure-code/test-erasure-eio.sh)."""
+        acting = self.acting_set(oid)
+        bad = sorted(
+            set(report["crc_errors"]) | set(report["missing"])
+            | set(report["parity_mismatch"])
+        )
+        repaired = 0
+        for s in bad:
+            if not self._shard_up(acting, s):
+                continue
+            try:
+                await self.recover_shard(oid, s, acting[s], rollback=True)
+                repaired += 1
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 -- a failed repair stays in
+                # scrub_errors/_dirty; the next scrub or peering retries
+                self.perf.inc("scrub_repair_failed")
+                self._dirty.add(oid)
+        if repaired:
+            self.perf.inc("scrub_repair", repaired)
+            # confirm: a clean re-scrub clears the error record
+            report2 = await self.deep_scrub(oid)
+            if report2["ok"]:
+                self.scrub_errors.pop(oid, None)
+        return repaired
 
     # -- recovery ----------------------------------------------------------
 
@@ -1805,7 +2092,9 @@ class ECBackend:
     # -- peering (PG.h:2122 Peering + start_recovery_ops role) -------------
 
     def _peering_authoritative(self, counts: Dict[tuple, int],
-                               unseen: int) -> Optional[tuple]:
+                               unseen: int,
+                               counts_any: Optional[Dict[tuple, int]] = None,
+                               ) -> Optional[tuple]:
         """Pick the version to recover toward from placed-copy counts.
 
         Newest version with >= k placed holders wins (assemblable).  A
@@ -1821,18 +2110,47 @@ class ECBackend:
                 return v
             if counts[v] + unseen >= self.k:
                 return None  # possibly acked, unassemblable now: wait
-        return None  # nothing assemblable (debris, e.g. remove leftovers)
+        # No acting version is assemblable.  Before declaring the object
+        # absent, consult copies on up-but-NON-acting holders (remap
+        # leftovers): if any version could have reached k commits counting
+        # those, the write was real -- wait for remap recovery instead of
+        # destroying the surviving copies.
+        if counts_any:
+            for v, n in counts_any.items():
+                if n + unseen >= self.k:
+                    return None
+        # every observed version is PROVABLY torn (could not have reached
+        # k commits even counting non-acting holders and unreporting
+        # placed holders): the object's authoritative state is "absent".
+        # Divergent creates and remove leftovers roll back / get removed
+        # (the reference rolls back divergent log entries the same way).
+        return (0, "")
 
-    async def peering_pass(self, max_active: int = None) -> int:
-        """One peering + recovery round for objects whose PRIMARY this
-        engine's OSD currently is.
+    async def peering_pass(self, max_active: int = None,
+                           backfill: bool = False) -> int:
+        """One event/delta-driven peering + recovery round for objects
+        whose PRIMARY this engine's OSD currently is.
 
-        Scans every up OSD's holdings (``pg_list``), computes the
-        authoritative version per object, and background-recovers every
-        missing/stale/torn placed copy in bounded windows with bounded
-        concurrency.  Returns the number of recovery actions attempted
-        (0 == clean from this primary's perspective).  Reference:
-        src/osd/PG.cc peering -> PG::activate -> start_recovery_ops."""
+        Three stages mirroring the reference peering state machine
+        (src/osd/PG.cc GetInfo -> GetLog -> GetMissing -> recovery):
+
+        1. **GetInfo**: poll every up OSD's pg-log head/tail (O(1) each).
+           Peers whose head equals this primary's watermark contribute
+           nothing further -- a clean, quiet cluster costs one tiny
+           round-trip per OSD and NO object traffic.
+        2. **GetLog**: for peers that advanced, fetch only the log entries
+           above the watermark; the named objects (plus the engine's own
+           missing-set of writes that skipped down shards) are the only
+           candidates.  A watermark below the peer's log tail means the
+           history was trimmed: fall back to a full ``pg_list`` scan --
+           the reference's log-recovery vs backfill distinction.
+        3. **GetMissing/recover**: probe versions for candidate objects
+           only (``obj_versions``), compute the authoritative version,
+           then roll back divergent (torn) entries via the target's own
+           PG log where possible and push full shards otherwise.
+
+        Returns the number of recovery actions attempted (0 == clean from
+        this primary's perspective)."""
         from ceph_tpu.utils.config import get_config
 
         if max_active is None:
@@ -1842,10 +2160,104 @@ class ECBackend:
             f"osd.{i}" for i in range(n_osds)
             if not self.messenger.is_down(f"osd.{i}")
         ]
+
+        # -- stage 1: GetInfo ---------------------------------------------
+        infos = await self._meta_roundtrip(
+            up_osds, {"op": "pg_log_info"}, timeout=3.0
+        )
+        self.perf.inc("peering_info_poll")
+        candidates = set(self._dirty)
+        meta_candidates = set(self._dirty_meta)
+        pre_heads: Dict[str, int] = {}
+        need_backfill = backfill
+        fetches = []
+        for osd_name, info in infos.items():
+            head, tail = info["head_seq"], info["tail_seq"]
+            pre_heads[osd_name] = head
+            last = self._peer_seq.get(osd_name)
+            if last is not None and head <= last:
+                continue  # quiet peer
+            if last is None:
+                if head == 0:
+                    self._peer_seq[osd_name] = 0  # brand-new OSD
+                    continue
+                need_backfill = True  # unknown history (primary restart
+                continue              # or newly revived peer)
+            if last < tail:
+                need_backfill = True  # log trimmed past the watermark
+                continue
+            fetches.append((osd_name, last))
+
+        # -- stage 2: GetLog deltas (independent peers, one round-trip) ---
+        if not need_backfill and fetches:
+            results = await asyncio.gather(*(
+                self._meta_roundtrip(
+                    [osd_name],
+                    {"op": "pg_log_entries", "from_seq": last},
+                    timeout=3.0,
+                )
+                for osd_name, last in fetches
+            ))
+            for (osd_name, last), r in zip(fetches, results):
+                rep = r.get(osd_name)
+                if rep is None:
+                    continue  # peer died mid-pass; the event retries
+                if not rep["complete"]:
+                    need_backfill = True
+                    break
+                maxseq = last
+                for seq, base, tag, ver in rep["entries"]:
+                    if tag == "meta":
+                        meta_candidates.add(base)
+                    else:
+                        candidates.add(base)
+                    maxseq = max(maxseq, seq)
+                self._peer_seq[osd_name] = maxseq
+                self.perf.inc("peering_delta_entries", len(rep["entries"]))
+
+        if need_backfill:
+            return await self._peering_backfill(up_osds, max_active, pre_heads)
+
+        if not candidates and not meta_candidates:
+            self.perf.inc("peering_pass")
+            return 0
+
+        # -- stage 3: targeted probe --------------------------------------
+        oids = sorted(candidates | meta_candidates)
+        replies = await self._meta_roundtrip(
+            up_osds, {"op": "obj_versions", "oids": oids, "km": self.km},
+            timeout=3.0,
+        )
+        self.perf.inc("peering_probe")
+        have: Dict[str, Dict[int, Dict[str, tuple]]] = {}
+        meta: Dict[str, Dict[str, int]] = {}
+        for osd_name, r in replies.items():
+            for base, info in r.get("objects", {}).items():
+                for sh, ver in info["shards"].items():
+                    have.setdefault(base, {}).setdefault(int(sh), {})[
+                        osd_name
+                    ] = vt(tuple(ver))
+                if info["meta"] is not None and base in meta_candidates:
+                    meta.setdefault(base, {})[osd_name] = info["meta"]
+        # candidate objects with no copies anywhere (e.g. fully removed)
+        for base in candidates:
+            have.setdefault(base, {})
+        return await self._peering_apply(
+            have, meta, set(replies), max_active,
+            tracked=candidates, tracked_meta=meta_candidates,
+        )
+
+    async def _peering_backfill(self, up_osds, max_active,
+                                pre_heads: Dict[str, int]) -> int:
+        """Full-scan peering (the backfill path): every up OSD serializes
+        its holdings via ``pg_list``.  Needed when the log cannot prove
+        completeness -- primary restart, revived peer, trimmed log.  On
+        success the per-peer watermarks jump to the pre-scan log heads, so
+        subsequent passes are delta-driven again."""
+        self.perf.inc("peering_backfill")
         replies = await self._meta_roundtrip(
             up_osds, {"op": "pg_list"}, timeout=3.0
         )
-        # have[oid][shard][osd_name] = version tuple; meta[oid][osd] = ver
         have: Dict[str, Dict[int, Dict[str, tuple]]] = {}
         meta: Dict[str, Dict[str, int]] = {}
         for osd_name, r in replies.items():
@@ -1856,6 +2268,27 @@ class ECBackend:
                     have.setdefault(base, {}).setdefault(shard, {})[
                         osd_name
                     ] = vt(tuple(ver))
+        n = await self._peering_apply(
+            have, meta, set(replies), max_active,
+            tracked=set(have) | self._dirty,
+            tracked_meta=set(meta) | self._dirty_meta,
+        )
+        # entries at or below the pre-scan heads are covered by the scan
+        for osd_name in replies:
+            h = pre_heads.get(osd_name)
+            if h is not None:
+                self._peer_seq[osd_name] = max(
+                    self._peer_seq.get(osd_name, 0), h
+                )
+        return n
+
+    async def _peering_apply(self, have, meta, reporting, max_active,
+                             tracked=frozenset(),
+                             tracked_meta=frozenset()) -> int:
+        """Authoritative-version election + recovery execution over the
+        gathered shard/meta version maps; maintains the engine's dirty
+        sets (objects in ``tracked``/``tracked_meta`` that end the pass
+        clean are dropped; unfinished ones are kept for the next event)."""
 
         def is_my_object(acting) -> bool:
             for s in range(self.km):
@@ -1863,7 +2296,8 @@ class ECBackend:
                     return f"osd.{acting[s]}" == self.name
             return False
 
-        actions = []  # (oid, shard, target_osd, rollback)
+        actions = []  # (oid, shard, target_osd, authoritative, rollback)
+        unfinished: set = set()
         for oid in sorted(have):
             acting = self.acting_set(oid)
             if not is_my_object(acting):
@@ -1874,32 +2308,49 @@ class ECBackend:
             counts: Dict[tuple, int] = {}
             unseen = 0
             placed: Dict[int, Optional[tuple]] = {}
+            placed_down = False
             for s in range(self.km):
                 if acting[s] is None:
                     continue
                 holder = f"osd.{acting[s]}"
-                if holder not in replies:
+                if holder not in reporting:
                     unseen += 1
+                    placed_down = True
                     continue
                 v = shardmap.get(s, {}).get(holder)
                 placed[s] = v
                 if v is not None:
                     counts[v] = counts.get(v, 0) + 1
+            # every copy anywhere (incl. non-acting remap leftovers), one
+            # per distinct shard position, for the absent-object proof
+            counts_any: Dict[tuple, int] = {}
+            for s, holders in shardmap.items():
+                best = max(holders.values(), default=None)
+                if best is not None:
+                    counts_any[best] = counts_any.get(best, 0) + 1
+            if placed_down:
+                unfinished.add(oid)  # probe again when the holder returns
             if not counts:
                 continue
-            authoritative = self._peering_authoritative(counts, unseen)
+            authoritative = self._peering_authoritative(
+                counts, unseen, counts_any
+            )
             if authoritative is None:
                 self.perf.inc("peering_wait")
+                unfinished.add(oid)
                 continue
             for s, cur in placed.items():
                 if cur == authoritative:
                     continue
+                if cur is None and tuple(authoritative) == (0, ""):
+                    continue  # absent object, absent copy: nothing to do
                 actions.append(
-                    (oid, s, acting[s],
+                    (oid, s, acting[s], authoritative,
                      cur is not None and cur > authoritative)
                 )
 
         meta_actions = []  # (oid, stale_targets)
+        unfinished_meta: set = set()
         for oid, holders in meta.items():
             acting = self.acting_set(oid)
             if not is_my_object(acting):
@@ -1908,46 +2359,115 @@ class ECBackend:
             try:
                 targets = self._meta_targets(oid)
             except IOError:
+                unfinished_meta.add(oid)
                 continue
+            if any(
+                acting[s] is not None and not self._shard_up(acting, s)
+                for s in range(self.km)
+            ):
+                unfinished_meta.add(oid)  # a down replica will need this
             stale = [t for t in targets if holders.get(t, 0) < newest]
             if stale:
                 meta_actions.append((oid, stale))
 
-        if not actions and not meta_actions:
-            return 0
-        sem = asyncio.Semaphore(max_active)
+        failed: set = set()
+        if actions or meta_actions:
+            sem = asyncio.Semaphore(max_active)
 
-        async def recover_one(oid, s, target, rb):
-            async with sem:
-                try:
-                    await self.recover_shard(oid, s, target, rollback=rb)
-                except asyncio.CancelledError:
-                    raise
-                except Exception:  # noqa: BLE001 -- a failed recovery
-                    # stays pending; the next peering pass retries
-                    self.perf.inc("recover_failed")
+            async def recover_one(oid, s, target, authoritative, rb):
+                async with sem:
+                    try:
+                        if rb and await self._try_log_rollback(
+                            oid, s, target, authoritative
+                        ):
+                            return
+                        if tuple(authoritative) == (0, ""):
+                            # no assemblable object behind the torn copy:
+                            # nothing to reconstruct, just drop it
+                            await self._remove_shard_copy(oid, s, target)
+                            return
+                        await self.recover_shard(
+                            oid, s, target, rollback=rb
+                        )
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 -- a failed recovery
+                        # stays pending; the next peering pass retries
+                        self.perf.inc("recover_failed")
+                        failed.add(oid)
 
-        async def recover_meta(oid, stale):
-            async with sem:
-                try:
-                    # full-state re-apply: replicas converge in one step
-                    omap = await self._meta_read(oid)
-                    ver = self._meta_versions.get(oid, 0)
-                    await self._meta_roundtrip(stale, {
-                        "op": "meta_apply", "oid": oid,
-                        "version": ver, "omap": omap,
-                    })
-                except asyncio.CancelledError:
-                    raise
-                except Exception:  # noqa: BLE001
-                    self.perf.inc("recover_failed")
+            async def recover_meta(oid, stale):
+                async with sem:
+                    try:
+                        # full-state re-apply: replicas converge in one step
+                        omap = await self._meta_read(oid)
+                        ver = self._meta_versions.get(oid, 0)
+                        await self._meta_roundtrip(stale, {
+                            "op": "meta_apply", "oid": oid,
+                            "version": ver, "omap": omap,
+                        })
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001
+                        self.perf.inc("recover_failed")
+                        failed.add(oid)
 
-        await asyncio.gather(
-            *(recover_one(*a) for a in actions),
-            *(recover_meta(*m) for m in meta_actions),
-        )
+            await asyncio.gather(
+                *(recover_one(*a) for a in actions),
+                *(recover_meta(*m) for m in meta_actions),
+            )
+
+        # dirty-set maintenance (pg_missing_t bookkeeping)
+        for oid in tracked:
+            if oid in unfinished or oid in failed:
+                self._dirty.add(oid)
+            else:
+                self._dirty.discard(oid)
+        for oid in tracked_meta:
+            if oid in unfinished_meta or oid in failed:
+                self._dirty_meta.add(oid)
+            else:
+                self._dirty_meta.discard(oid)
         self.perf.inc("peering_pass")
         return len(actions) + len(meta_actions)
+
+    async def _remove_shard_copy(self, oid: str, s: int,
+                                 target: int) -> None:
+        """Remove a provably-torn or leftover shard copy whose object has
+        no assemblable authoritative version (divergent create / remove
+        leftover): the rollback target is non-existence."""
+        soid = shard_oid(oid, s)
+        tid = self._new_tid()
+        done = asyncio.get_event_loop().create_future()
+        self._pending[tid] = {
+            "committed": set(),
+            "expected": {f"osd.{target}"},
+            "done": done,
+        }
+        sub = ECSubWrite(
+            from_shard=s, tid=tid, oid=oid,
+            transaction=Transaction().remove(soid),
+            at_version=(0, ""), op_class="recovery", rollback=True,
+        )
+        await self.messenger.send_message(self.name, f"osd.{target}", sub)
+        await self._await_commits(oid, tid, done, min_acks=1)
+        self.perf.inc("remove_torn_copy")
+
+    async def _try_log_rollback(self, oid: str, s: int, target: int,
+                                to_version: tuple) -> bool:
+        """Ask the divergent shard's OSD to roll its torn entries back
+        from its own PG log (truncate + attr restore); True on success.
+        False (missing/trimmed/overwrite history) -> caller re-pushes the
+        shard.  Reference: divergent-entry rollback,
+        src/osd/PGLog.h / ECTransaction rollback records."""
+        r = await self._meta_roundtrip(
+            [f"osd.{target}"],
+            {"op": "pg_rollback", "soid": shard_oid(oid, s),
+             "to_version": tuple(to_version)},
+            timeout=3.0,
+        )
+        rep = r.get(f"osd.{target}")
+        return bool(rep and rep.get("ok"))
 
     # -- client-op service (the PrimaryLogPG do_op role) -------------------
 

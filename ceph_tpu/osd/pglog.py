@@ -1,12 +1,25 @@
-"""PG-log-lite: bounded per-object op log with append rollback.
+"""PG log: per-OSD sequence-numbered op log powering delta peering and
+divergent-entry rollback.
 
-Reference: src/osd/PGLog.{h,cc} and the EC-specific rollback design
+Reference: src/osd/PGLog.{h,cc} and the EC rollback design
 (doc/dev/osd_internals/erasure_coding/ecbackend.rst:9-27, ECSubWrite
-trim_to/roll_forward_to ECMsgTypes.h:33-35): EC writes are logged with
-enough metadata (prior append sizes) that a divergent shard can ROLL BACK
-an uncommitted append by truncating, instead of needing the other shards.
-This is the storage-system checkpoint/resume mechanism: after a restart a
-shard replays/trims its log to converge with the authoritative log.
+trim_to/roll_forward_to ECMsgTypes.h:33-35).  Two roles:
+
+* **Delta peering** (the GetLog/missing-set exchange of src/osd/PG.cc):
+  every applied sub-write gets a per-OSD monotonic sequence number; a
+  primary remembers the last sequence it processed per peer and fetches
+  only ``entries_after(watermark)`` -- peering traffic proportional to
+  new writes, zero on a clean cluster.  A watermark below ``tail_seq``
+  means the log was trimmed past the gap: the peer must be backfilled
+  (full scan), the reference's log-vs-backfill distinction.
+
+* **Rollback** (divergent entries): each entry snapshots the pre-apply
+  state (size, version/size/hash attrs, existence) of the shard object.
+  EC writes are creates/appends in the default append-only mode, so a
+  torn write (landed on < k shards) rolls back locally by truncating and
+  restoring attrs -- no network push needed.  Overwrite-style entries
+  (bytes below the prior size modified) are marked non-rollbackable and
+  fall back to a recovery push from the authoritative shards.
 """
 
 from __future__ import annotations
@@ -14,75 +27,117 @@ from __future__ import annotations
 import dataclasses
 from typing import Dict, List, Optional
 
-from ceph_tpu.osd.memstore import MemStore
 from ceph_tpu.osd.types import Transaction
+
+#: attr key holding the version tuple in prior_attrs snapshots (matches
+#: ecbackend.VERSION_KEY; duplicated to avoid the import cycle)
+_VERSION_ATTR = "_version"
 
 
 @dataclasses.dataclass
 class PGLogEntry:
-    version: int
-    oid: str  # shard object id
-    op: str  # "append" | "touch" | "delete"
+    seq: int  # per-OSD monotonic sequence (assigned by PGLog.append)
+    oid: str  # shard object id ("base@shard" or "base@meta")
+    op: str  # "write" | "delete"
+    obj_version: tuple  # version tuple this entry stamped
+    existed: bool = True  # object existed before this entry
     prior_size: int = 0  # rollback point for appends
+    prior_attrs: Optional[Dict[str, object]] = None  # pre-apply attr snapshot
     rollbackable: bool = True
 
 
 class PGLog:
-    """Ordered log with head/tail, divergence trim, and rollback apply."""
+    """Ordered per-OSD log with head/tail, delta queries, trim, and
+    per-object rollback."""
 
     def __init__(self, trim_target: int = 1000):
         self.entries: List[PGLogEntry] = []
-        self.tail_version = 0
+        #: newest sequence dropped by trim (entries <= tail_seq are gone)
+        self.tail_seq = 0
+        self._next_seq = 0
         self.trim_target = trim_target
 
     @property
-    def head_version(self) -> int:
-        return self.entries[-1].version if self.entries else self.tail_version
+    def head_seq(self) -> int:
+        return self.entries[-1].seq if self.entries else self._next_seq
 
-    def append(self, entry: PGLogEntry) -> None:
-        # monotonic, not dense: a shard only logs writes it participates in
-        assert entry.version > self.head_version, "log must be ordered"
-        self.entries.append(entry)
+    def append(self, oid: str, op: str, obj_version: tuple, *,
+               existed: bool = True, prior_size: int = 0,
+               prior_attrs: Optional[dict] = None,
+               rollbackable: bool = True) -> PGLogEntry:
+        self._next_seq += 1
+        e = PGLogEntry(
+            seq=self._next_seq, oid=oid, op=op, obj_version=obj_version,
+            existed=existed, prior_size=prior_size, prior_attrs=prior_attrs,
+            rollbackable=rollbackable,
+        )
+        self.entries.append(e)
+        return e
 
-    def trim(self, to_version: int) -> None:
-        """Drop entries <= to_version (they are durable everywhere);
-        trimmed entries can no longer be rolled back
+    # -- delta peering queries --------------------------------------------
+
+    def entries_after(self, seq: int) -> List[PGLogEntry]:
+        return [e for e in self.entries if e.seq > seq]
+
+    def covers(self, seq: int) -> bool:
+        """True if the log retains every entry above ``seq`` (a primary
+        holding watermark ``seq`` can delta-sync; False -> backfill)."""
+        return seq >= self.tail_seq
+
+    # -- trim --------------------------------------------------------------
+
+    def trim(self, to_seq: int) -> None:
+        """Drop entries <= to_seq (durable everywhere); trimmed entries
+        can no longer be rolled back or delta-served
         (reference ECSubWrite.trim_to)."""
-        keep = [e for e in self.entries if e.version > to_version]
-        if keep != self.entries:
-            self.tail_version = max(self.tail_version, to_version)
+        keep = [e for e in self.entries if e.seq > to_seq]
+        if len(keep) != len(self.entries):
+            self.tail_seq = max(self.tail_seq, to_seq)
             self.entries = keep
 
     def maybe_trim(self) -> None:
         if len(self.entries) > self.trim_target:
-            self.trim(self.entries[-(self.trim_target)].version)
+            self.trim(self.entries[-self.trim_target].seq)
 
-    def rollback_to(self, version: int, store: MemStore) -> List[PGLogEntry]:
-        """Undo entries with version > `version` (newest first), applying the
-        inverse operation to the local store. Returns the rolled-back
-        entries. Raises if any is non-rollbackable (would need backfill)."""
-        doomed = [e for e in self.entries if e.version > version]
-        for e in reversed(doomed):
-            if not e.rollbackable:
-                raise ValueError(
-                    f"entry v{e.version} not rollbackable; needs backfill"
-                )
-            if e.op == "append":
-                store.queue_transaction(
-                    Transaction().truncate(e.oid, e.prior_size)
-                )
-            elif e.op == "touch":
+    # -- rollback ----------------------------------------------------------
+
+    def object_entries(self, oid: str) -> List[PGLogEntry]:
+        return [e for e in self.entries if e.oid == oid]
+
+    def rollback_object_to(self, oid: str, to_version: tuple,
+                           store) -> bool:
+        """Undo this object's entries newer than ``to_version`` by applying
+        their inverses (truncate to prior size, restore attr snapshot,
+        remove a rolled-back create).  Returns True on success; False if
+        the log cannot prove a clean rollback (missing/trimmed history or
+        a non-rollbackable overwrite) -- caller falls back to a recovery
+        push.  Reference: PGLog divergent-entry handling via the rollback
+        info EC transactions record (src/osd/ECTransaction.cc:97)."""
+        to_version = tuple(to_version)
+        doomed = [e for e in self.object_entries(oid)
+                  if tuple(e.obj_version) > to_version]
+        if not doomed or not all(e.rollbackable for e in doomed):
+            return False
+        # the oldest doomed entry must sit exactly on the rollback target,
+        # else history between them was trimmed and the snapshot is wrong
+        oldest = min(doomed, key=lambda e: e.seq)
+        if oldest.existed:
+            prior_ver = (oldest.prior_attrs or {}).get(_VERSION_ATTR)
+            if tuple(prior_ver or ()) != to_version:
+                return False
+        elif to_version != (0, ""):
+            # a create entry proves rollback only to NON-EXISTENCE; if the
+            # authoritative version is real history this shard never had
+            # (it was down for it), only a recovery push can restore it
+            return False
+        for e in sorted(doomed, key=lambda e: e.seq, reverse=True):
+            if not e.existed:
                 store.queue_transaction(Transaction().remove(e.oid))
-            elif e.op == "delete":
-                raise ValueError("delete rollback requires a backfill source")
-        self.entries = [e for e in self.entries if e.version <= version]
-        return doomed
-
-    def merge_authoritative(
-        self, auth_head: int, store: MemStore
-    ) -> List[PGLogEntry]:
-        """Converge on the authoritative head: roll back any local entries
-        beyond it (the divergent-shard path after a primary change)."""
-        if self.head_version <= auth_head:
-            return []
-        return self.rollback_to(auth_head, store)
+                continue
+            txn = Transaction().truncate(e.oid, e.prior_size)
+            for key, val in (e.prior_attrs or {}).items():
+                txn = txn.setattr(e.oid, key, val)
+            store.queue_transaction(txn)
+        keep_ids = {id(e) for e in doomed}
+        self.entries = [e for e in self.entries if id(e) not in keep_ids]
+        return True

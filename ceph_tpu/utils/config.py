@@ -57,6 +57,10 @@ OPTIONS: Dict[str, Option] = {
              "max concurrent object recoveries per OSD"),
         _opt("osd_tick_interval", float, 5.0, LEVEL_ADVANCED,
              "seconds between OSD background ticks (peering/scrub)"),
+        _opt("osd_client_op_commit_timeout", float, 30.0, LEVEL_ADVANCED,
+             "seconds a primary waits for sub-write commit acks before "
+             "failing the op (fault-injection tests shrink this to "
+             "manufacture torn writes)"),
         _opt("osd_scrub_objects_per_tick", int, 4, LEVEL_ADVANCED,
              "deep-scrub at most this many objects per background tick "
              "(rate limit; 0 disables background scrub)"),

@@ -213,23 +213,48 @@ def test_perf_dump():
 
 
 def test_pglog_rollback():
-    from ceph_tpu.osd.pglog import PGLog, PGLogEntry
+    from ceph_tpu.osd.pglog import PGLog
 
     st = MemStore()
     log = PGLog()
-    st.queue_transaction(Transaction().write("o@0", 0, b"AAAA"))
-    log.append(PGLogEntry(version=1, oid="o@0", op="append", prior_size=0))
-    st.queue_transaction(Transaction().write("o@0", 4, b"BBBB"))
-    log.append(PGLogEntry(version=2, oid="o@0", op="append", prior_size=4))
+    st.queue_transaction(
+        Transaction().write("o@0", 0, b"AAAA").setattr("o@0", "_version", (1, ""))
+    )
+    log.append("o@0", "write", (1, ""), existed=False, prior_size=0)
+    st.queue_transaction(
+        Transaction().write("o@0", 4, b"BBBB").setattr("o@0", "_version", (2, ""))
+    )
+    log.append("o@0", "write", (2, ""), existed=True, prior_size=4,
+               prior_attrs={"_version": (1, "")})
     assert st.read("o@0") == b"AAAABBBB"
-    # divergent second append: roll back to authoritative head v1
-    rolled = log.merge_authoritative(1, st)
-    assert [e.version for e in rolled] == [2]
+    # divergent second append: roll back to authoritative version (1, "")
+    assert log.rollback_object_to("o@0", (1, ""), st)
     assert st.read("o@0") == b"AAAA"
-    assert log.head_version == 1
-    # trim makes old entries non-rollbackable
-    log.trim(1)
-    assert log.entries == [] and log.tail_version == 1
+    assert st.getattr("o@0", "_version") == (1, "")
+    assert [tuple(e.obj_version) for e in log.object_entries("o@0")] == [(1, "")]
+    # rollback of a torn CREATE removes the object outright
+    st.queue_transaction(
+        Transaction().write("n@0", 0, b"CC").setattr("n@0", "_version", (1, ""))
+    )
+    log.append("n@0", "write", (1, ""), existed=False)
+    assert log.rollback_object_to("n@0", (0, ""), st)
+    assert not st.exists("n@0")
+    # an overwrite entry is non-rollbackable -> False (caller re-pushes)
+    log.append("o@0", "write", (3, ""), existed=True, prior_size=4,
+               prior_attrs={"_version": (1, "")}, rollbackable=False)
+    assert not log.rollback_object_to("o@0", (1, ""), st)
+    # trimmed history cannot prove a rollback either
+    log2 = PGLog()
+    log2.append("p@0", "write", (5, ""), existed=True, prior_size=8,
+                prior_attrs={"_version": (3, "")})
+    assert not log2.rollback_object_to("p@0", (4, ""), st)  # gap: 5's prior is 3
+    # delta queries
+    log3 = PGLog(trim_target=2)
+    for i in range(1, 6):
+        log3.append(f"q{i}@0", "write", (i, ""))
+    assert [e.seq for e in log3.entries_after(3)] == [4, 5]
+    log3.maybe_trim()
+    assert log3.covers(log3.tail_seq) and not log3.covers(0)
 
 
 def test_shard_pglog_records_writes():
@@ -240,8 +265,11 @@ def test_shard_pglog_records_writes():
         await cluster.write("b", b"y" * 2000)
         acting = cluster.backend.acting_set("a")
         shard0 = cluster.osds[acting[0]]
-        assert shard0.pglog.head_version >= 1
+        assert shard0.pglog.head_seq >= 1
         assert any(e.oid == "a@0" for e in shard0.pglog.entries)
+        ent = next(e for e in shard0.pglog.entries if e.oid == "a@0")
+        assert not ent.existed and ent.rollbackable
+        assert "_version" in (ent.prior_attrs or {})
         await cluster.shutdown()
 
     run(main())
