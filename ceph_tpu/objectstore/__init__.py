@@ -10,11 +10,14 @@ ObjectStore the EC path uses (SURVEY.md L2):
   (src/os/filestore/FileStore.cc + FileJournal)
 * ``kstore``    -- everything in a KeyValueDB (src/os/kstore/KStore.cc);
   pairs with the ``lsm`` KeyValueDB for persistence
+* ``blockstore`` -- raw-block data + LSM metadata + deferred-write WAL,
+  the BlueStore-class production engine (src/os/bluestore/BlueStore.cc)
 """
 
 from __future__ import annotations
 
 from ceph_tpu.osd.memstore import MemStore
+from ceph_tpu.objectstore.blockstore import BlockStore
 from ceph_tpu.objectstore.filestore import FileStore
 from ceph_tpu.objectstore.kstore import KStore
 
@@ -30,7 +33,11 @@ def create(kind: str, path: str = ""):
         if not path:
             raise ValueError("kstore needs a data path")
         return KStore(path)
+    if kind == "blockstore":
+        if not path:
+            raise ValueError("blockstore needs a data path")
+        return BlockStore(path)
     raise ValueError(f"unknown objectstore backend {kind!r}")
 
 
-__all__ = ["create", "MemStore", "FileStore", "KStore"]
+__all__ = ["create", "MemStore", "FileStore", "KStore", "BlockStore"]

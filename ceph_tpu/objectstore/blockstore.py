@@ -1,0 +1,354 @@
+"""BlockStore: raw-block backend -- the BlueStore-analogue engine.
+
+Reference: src/os/bluestore/BlueStore.cc (role, not design): data lives on
+a raw block "device" (a flat file) carved into fixed allocation units;
+ALL metadata -- onodes (size, xattrs, extent map), omap, deferred-write
+records -- lives in the LSM KeyValueDB (ceph_tpu/kv/lsm.py), whose WAL
+makes every ObjectStore transaction atomic (the one-RocksDB-WriteBatch
+contract, BlueStore::queue_transactions).
+
+Write strategy (BlueStore's two paths, simplified to allocation-unit
+granularity):
+
+* **COW big writes**: new/changed units are written to FRESHLY allocated
+  units *before* the KV commit references them, so a crash mid-write
+  leaves the old onode pointing at intact old data (BlueStore's
+  write-new-blob path).
+* **Deferred small overwrites**: a sub-threshold overwrite of an already
+  allocated unit rides INSIDE the KV transaction as a deferred record
+  (phys offset + bytes), is applied in place after the commit, and is
+  replayed idempotently at mount -- BlueStore's deferred-write WAL
+  (bluestore_prefer_deferred_size).
+
+The allocator is an in-memory free-set rebuilt at mount by scanning
+onode extent maps + pending deferred records -- BlueStore's
+NCB/allocation-from-onodes recovery mode rather than a persisted
+freelist.
+
+KV prefixes: "O" onodes, "M" omap ("<oid>\\x00<key>"), "D" deferred
+records keyed by monotonic sequence.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional
+
+from ceph_tpu.kv import lsm as lsm_mod
+from ceph_tpu.kv.keyvaluedb import KVTransaction
+from ceph_tpu.osd.types import Transaction
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+
+class BlockStore:
+    def __init__(self, path: str, alloc_unit: int = 64 * 1024,
+                 deferred_threshold: int = 32 * 1024):
+        if not path:
+            raise ValueError("blockstore needs a data path")
+        os.makedirs(path, exist_ok=True)
+        self.alloc_unit = alloc_unit
+        self.deferred_threshold = min(deferred_threshold, alloc_unit)
+        self.db = lsm_mod.LSMStore(os.path.join(path, "kv"))
+        self.db.open()
+        self.block_path = os.path.join(path, "block")
+        if not os.path.exists(self.block_path):
+            with open(self.block_path, "wb"):
+                pass
+        self._dev = open(self.block_path, "r+b")
+        self._free: set = set()
+        self._high_water = 0
+        self._deferred_seq = 0
+        self._onode_cache: Dict[str, dict] = {}
+        self._mount()
+
+    # -- mount / crash recovery -------------------------------------------
+
+    def _mount(self) -> None:
+        """Replay deferred writes, rebuild the allocator from onodes."""
+        used = set()
+        for oid, raw in self.db.get_iterator("O"):
+            onode = Decoder(raw).value()
+            used.update(onode["extents"].values())
+        replayed = KVTransaction()
+        n_deferred = 0
+        for seq, raw in self.db.get_iterator("D"):
+            rec = Decoder(raw).value()
+            # idempotent in-place replay (BlueStore deferred replay)
+            self._dev_write(rec["pofs"], rec["data"])
+            replayed.rmkey("D", seq)
+            n_deferred += 1
+            self._deferred_seq = max(self._deferred_seq, int(seq) + 1)
+        if n_deferred:
+            self._dev.flush()
+            self.db.submit_transaction(replayed)
+        self._high_water = (max(used) + 1) if used else 0
+        self._free = set(range(self._high_water)) - used
+
+    def umount(self) -> None:
+        self.db.close()
+        self._dev.close()
+
+    # -- device helpers ----------------------------------------------------
+
+    def _dev_write(self, pofs: int, data: bytes) -> None:
+        self._dev.seek(pofs)
+        self._dev.write(data)
+
+    def _dev_read(self, unit: int) -> bytes:
+        self._dev.seek(unit * self.alloc_unit)
+        buf = self._dev.read(self.alloc_unit)
+        return buf.ljust(self.alloc_unit, b"\x00")
+
+    def _alloc(self) -> int:
+        if self._free:
+            u = min(self._free)
+            self._free.discard(u)
+            return u
+        u = self._high_water
+        self._high_water += 1
+        return u
+
+    # -- onode helpers -----------------------------------------------------
+
+    def _get_onode(self, oid: str) -> Optional[dict]:
+        if oid in self._onode_cache:
+            return self._onode_cache[oid]
+        raw = self.db.get("O", oid)
+        if raw is None:
+            return None
+        onode = Decoder(raw).value()
+        # extent keys round-trip as strings; normalize to int logical units
+        onode["extents"] = {int(k): v for k, v in onode["extents"].items()}
+        self._onode_cache[oid] = onode
+        return onode
+
+    @staticmethod
+    def _onode_bytes(onode: dict) -> bytes:
+        enc = dict(onode)
+        enc["extents"] = {str(k): v for k, v in onode["extents"].items()}
+        return Encoder().value(enc).bytes()
+
+    # -- transaction path --------------------------------------------------
+
+    def queue_transaction(self, txn: Transaction) -> None:
+        """Stage data writes (COW units to the device first), then one
+        atomic KV batch carrying onodes + omap + deferred records, then
+        apply deferred in-place writes."""
+        batch = KVTransaction()
+        onodes: Dict[str, Optional[dict]] = {}
+        deferred: List[dict] = []
+        freed: List[int] = []
+
+        def onode_for(oid: str) -> dict:
+            if oid in onodes and onodes[oid] is not None:
+                return onodes[oid]  # type: ignore[return-value]
+            cur = None if onodes.get(oid, "?") is None else self._get_onode(oid)
+            if cur is None:
+                cur = {"size": 0, "attrs": {}, "extents": {}}
+            else:
+                cur = {"size": cur["size"], "attrs": dict(cur["attrs"]),
+                       "extents": dict(cur["extents"])}
+            onodes[oid] = cur
+            return cur
+
+        def write_units(onode: dict, offset: int, data: bytes) -> None:
+            au = self.alloc_unit
+            end = offset + len(data)
+            u0, u1 = offset // au, (end - 1) // au
+            for u in range(u0, u1 + 1):
+                lo = max(offset, u * au)
+                hi = min(end, (u + 1) * au)
+                piece = data[lo - offset:hi - offset]
+                old_phys = onode["extents"].get(u)
+                full_unit = (lo == u * au and hi == (u + 1) * au)
+                if (
+                    old_phys is not None and not full_unit
+                    and len(piece) <= self.deferred_threshold
+                ):
+                    # deferred small overwrite: bytes ride the KV commit
+                    deferred.append({
+                        "pofs": old_phys * au + (lo - u * au),
+                        "data": piece,
+                    })
+                    continue
+                # COW: merge with old unit content (zeros for holes),
+                # write to a freshly allocated unit
+                if full_unit:
+                    buf = piece
+                else:
+                    base = (
+                        bytearray(self._dev_read(old_phys))
+                        if old_phys is not None
+                        else bytearray(au)
+                    )
+                    if old_phys is not None:
+                        # earlier ops in THIS txn may have staged deferred
+                        # pieces against this unit that are not on the
+                        # device yet: fold them into the merge base
+                        p0 = old_phys * au
+                        for rec in deferred:
+                            if p0 <= rec["pofs"] < p0 + au:
+                                off = rec["pofs"] - p0
+                                base[off:off + len(rec["data"])] = rec["data"]
+                    base[lo - u * au:hi - u * au] = piece
+                    buf = bytes(base)
+                new_phys = self._alloc()
+                self._dev_write(new_phys * au, buf)
+                onode["extents"][u] = new_phys
+                if old_phys is not None:
+                    freed.append(old_phys)
+
+        def truncate_to(onode: dict, size: int) -> None:
+            au = self.alloc_unit
+            old_size = onode["size"]
+            if size < old_size:
+                keep_units = (size + au - 1) // au if size else 0
+                for u in list(onode["extents"]):
+                    if u >= keep_units:
+                        freed.append(onode["extents"].pop(u))
+                # zero the stale tail of the last kept unit via COW so a
+                # later re-grow reads zeros there
+                if size % au and (size // au) in onode["extents"]:
+                    u = size // au
+                    base = bytearray(self._dev_read(onode["extents"][u]))
+                    base[size % au:] = bytes(au - size % au)
+                    new_phys = self._alloc()
+                    self._dev_write(new_phys * au, bytes(base))
+                    freed.append(onode["extents"][u])
+                    onode["extents"][u] = new_phys
+            onode["size"] = size
+
+        for op in txn.ops:
+            if op.op == "write":
+                onode = onode_for(op.oid)
+                write_units(onode, op.offset, op.data)
+                onode["size"] = max(onode["size"], op.offset + len(op.data))
+            elif op.op == "truncate":
+                truncate_to(onode_for(op.oid), op.offset)
+            elif op.op == "setattr":
+                onode_for(op.oid)["attrs"][op.attr_name] = op.attr_value
+            elif op.op == "remove":
+                cur = onode_for(op.oid)
+                freed.extend(cur["extents"].values())
+                onodes[op.oid] = None
+                for k in self._omap_db_keys(op.oid):
+                    batch.rmkey("M", f"{op.oid}\x00{k}")
+            elif op.op == "omap_set":
+                onode_for(op.oid)  # touch/create like the other stores
+                for k, v in op.attr_value.items():
+                    batch.set("M", f"{op.oid}\x00{k}", v)
+            elif op.op == "omap_rm":
+                onode_for(op.oid)
+                for k in op.attr_value:
+                    batch.rmkey("M", f"{op.oid}\x00{k}")
+            elif op.op == "omap_clear":
+                onode_for(op.oid)
+                for k in self._omap_db_keys(op.oid):
+                    batch.rmkey("M", f"{op.oid}\x00{k}")
+            else:
+                raise ValueError(f"unknown txn op {op.op!r}")
+
+        # data first, then the metadata commit that references it
+        self._dev.flush()
+        for oid, onode in onodes.items():
+            if onode is None:
+                batch.rmkey("O", oid)
+                self._onode_cache.pop(oid, None)
+            else:
+                batch.set("O", oid, self._onode_bytes(onode))
+                self._onode_cache[oid] = onode
+        cleanup = KVTransaction()
+        for rec in deferred:
+            key = f"{self._deferred_seq:016d}"
+            self._deferred_seq += 1
+            batch.set("D", key, Encoder().value(rec).bytes())
+            cleanup.rmkey("D", key)
+        self.db.submit_transaction(batch)
+        # deferred applies land in place only after their records are
+        # durable; a crash between is covered by mount-time replay
+        if deferred:
+            for rec in deferred:
+                self._dev_write(rec["pofs"], rec["data"])
+            self._dev.flush()
+            self.db.submit_transaction(cleanup)
+        self._free.update(freed)
+
+    # -- reads -------------------------------------------------------------
+
+    def read(self, oid: str, offset: int = 0, length: int = -1) -> bytes:
+        onode = self._get_onode(oid)
+        if onode is None:
+            raise FileNotFoundError(oid)
+        size = onode["size"]
+        if length < 0:
+            length = max(0, size - offset)
+        end = min(offset + length, size)
+        if end <= offset:
+            return b""
+        au = self.alloc_unit
+        out = bytearray(end - offset)
+        for u in range(offset // au, (end - 1) // au + 1):
+            phys = onode["extents"].get(u)
+            if phys is None:
+                continue  # hole: zeros
+            unit = self._dev_read(phys)
+            lo = max(offset, u * au)
+            hi = min(end, (u + 1) * au)
+            out[lo - offset:hi - offset] = unit[lo - u * au:hi - u * au]
+        return bytes(out)
+
+    def getattr(self, oid: str, name: str):
+        onode = self._get_onode(oid)
+        if onode is None:
+            raise FileNotFoundError(oid)
+        return onode["attrs"].get(name)
+
+    def _omap_db_keys(self, oid: str) -> List[str]:
+        prefix = oid + "\x00"
+        return [
+            k[len(prefix):]
+            for k, _ in self.db.get_iterator("M")
+            if k.startswith(prefix)
+        ]
+
+    def omap_get(self, oid: str, keys: Optional[List[str]] = None
+                 ) -> Dict[str, bytes]:
+        if self._get_onode(oid) is None:
+            raise FileNotFoundError(oid)
+        out = {}
+        prefix = oid + "\x00"
+        for k, v in self.db.get_iterator("M"):
+            if k.startswith(prefix):
+                name = k[len(prefix):]
+                if keys is None or name in keys:
+                    out[name] = v
+        return out
+
+    def stat(self, oid: str) -> int:
+        onode = self._get_onode(oid)
+        if onode is None:
+            raise FileNotFoundError(oid)
+        return onode["size"]
+
+    def exists(self, oid: str) -> bool:
+        return self._get_onode(oid) is not None
+
+    def list_objects(self) -> List[str]:
+        return sorted(k for k, _ in self.db.get_iterator("O"))
+
+    # -- fault injection (store_test corrupt hook) -------------------------
+
+    def corrupt(self, oid: str, offset: int) -> None:
+        onode = self._get_onode(oid)
+        if onode is None:
+            raise FileNotFoundError(oid)
+        au = self.alloc_unit
+        phys = onode["extents"].get(offset // au)
+        if phys is None:
+            return
+        pofs = phys * au + offset % au
+        self._dev.seek(pofs)
+        b = self._dev.read(1)
+        self._dev.seek(pofs)
+        self._dev.write(bytes([b[0] ^ 0xFF]))
+        self._dev.flush()

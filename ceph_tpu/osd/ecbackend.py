@@ -395,13 +395,20 @@ class OSDShard:
         if op == "pg_log_info":
             # O(1) peering poll: log head/tail only.  A primary whose
             # watermark is current skips this OSD entirely (reference
-            # GetInfo, src/osd/PG.cc peering).
+            # GetInfo, src/osd/PG.cc peering).  "nonempty" distinguishes a
+            # brand-new OSD from one RESTARTED on a persistent store whose
+            # in-memory log is empty but whose holdings need a backfill
+            # comparison (memoized once true; a stale true only costs an
+            # extra backfill).
+            if not getattr(self, "_store_nonempty", False):
+                self._store_nonempty = bool(self.store.list_objects())
             self.perf.inc("pg_log_info_serve")
             await self.messenger.send_message(self.name, src, {
                 "op": "pg_log_info_reply", "tid": msg["tid"],
                 "from": self.name,
                 "head_seq": self.pglog.head_seq,
                 "tail_seq": self.pglog.tail_seq,
+                "nonempty": self._store_nonempty,
             })
             return
         if op == "pg_log_entries":
@@ -2094,6 +2101,7 @@ class ECBackend:
     def _peering_authoritative(self, counts: Dict[tuple, int],
                                unseen: int,
                                counts_any: Optional[Dict[tuple, int]] = None,
+                               all_visible: bool = False,
                                ) -> Optional[tuple]:
         """Pick the version to recover toward from placed-copy counts.
 
@@ -2119,11 +2127,17 @@ class ECBackend:
             for v, n in counts_any.items():
                 if n + unseen >= self.k:
                     return None
+        if not all_visible:
+            # an unreporting OSD anywhere in the cluster could hide
+            # committed copies (e.g. remap sources that died): the torn
+            # proof is incomplete -- wait, never destroy
+            return None
         # every observed version is PROVABLY torn (could not have reached
         # k commits even counting non-acting holders and unreporting
-        # placed holders): the object's authoritative state is "absent".
-        # Divergent creates and remove leftovers roll back / get removed
-        # (the reference rolls back divergent log entries the same way).
+        # placed holders, with every cluster OSD visible): the object's
+        # authoritative state is "absent".  Divergent creates and remove
+        # leftovers roll back / get removed (the reference rolls back
+        # divergent log entries the same way).
         return (0, "")
 
     async def peering_pass(self, max_active: int = None,
@@ -2178,11 +2192,11 @@ class ECBackend:
             if last is not None and head <= last:
                 continue  # quiet peer
             if last is None:
-                if head == 0:
-                    self._peer_seq[osd_name] = 0  # brand-new OSD
+                if head == 0 and not info.get("nonempty"):
+                    self._peer_seq[osd_name] = 0  # brand-new empty OSD
                     continue
-                need_backfill = True  # unknown history (primary restart
-                continue              # or newly revived peer)
+                need_backfill = True  # unknown history (daemon restart on
+                continue              # a persistent store, revived peer)
             if last < tail:
                 need_backfill = True  # log trimmed past the watermark
                 continue
@@ -2333,7 +2347,8 @@ class ECBackend:
             if not counts:
                 continue
             authoritative = self._peering_authoritative(
-                counts, unseen, counts_any
+                counts, unseen, counts_any,
+                all_visible=len(reporting) >= len(self.osds),
             )
             if authoritative is None:
                 self.perf.inc("peering_wait")

@@ -138,6 +138,6 @@ class PGLog:
             for key, val in (e.prior_attrs or {}).items():
                 txn = txn.setattr(e.oid, key, val)
             store.queue_transaction(txn)
-        keep_ids = {id(e) for e in doomed}
-        self.entries = [e for e in self.entries if id(e) not in keep_ids]
+        doomed_ids = {id(e) for e in doomed}
+        self.entries = [e for e in self.entries if id(e) not in doomed_ids]
         return True

@@ -13,7 +13,7 @@ from ceph_tpu.osd.types import Transaction
 from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
 
 
-@pytest.fixture(params=["memstore", "filestore", "kstore"])
+@pytest.fixture(params=["memstore", "filestore", "kstore", "blockstore"])
 def store(request, tmp_path):
     s = os_mod.create(request.param, str(tmp_path / "store"))
     yield s
@@ -131,7 +131,7 @@ def test_large_object_multi_stripe(store):
 # -- persistence + crash recovery (filestore / kstore) ---------------------
 
 
-@pytest.mark.parametrize("kind", ["filestore", "kstore"])
+@pytest.mark.parametrize("kind", ["filestore", "kstore", "blockstore"])
 def test_store_survives_remount(kind, tmp_path):
     path = str(tmp_path / "store")
     s = os_mod.create(kind, path)
@@ -200,7 +200,7 @@ def test_factory_rejects_unknown_and_pathless():
 # -- EC cluster over persistent stores -------------------------------------
 
 
-@pytest.mark.parametrize("kind", ["filestore", "kstore"])
+@pytest.mark.parametrize("kind", ["filestore", "kstore", "blockstore"])
 def test_cluster_on_persistent_store(kind, tmp_path):
     import asyncio
 
@@ -273,3 +273,109 @@ def test_kstore_truncate_then_remove_leaves_no_orphan_stripes(tmp_path):
     s.queue_transaction(Transaction().write("o", 100_000, b"x"))
     assert s.read("o", 65_000, 1_000) == b"\0" * 1_000
     s.umount()
+
+
+# -- blockstore (BlueStore-analogue) specifics ------------------------------
+
+
+def test_blockstore_deferred_replay_on_mount(tmp_path):
+    """A deferred small overwrite whose in-place apply never happened
+    (crash after the KV commit) must replay at mount (the BlueStore
+    deferred-write WAL semantics)."""
+    from ceph_tpu.kv.keyvaluedb import KVTransaction
+    from ceph_tpu.utils.encoding import Encoder
+
+    s = os_mod.create("blockstore", str(tmp_path / "bs"))
+    s.queue_transaction(Transaction().write("o", 0, b"A" * 100_000))
+    onode = s._get_onode("o")
+    phys0 = onode["extents"][0]
+    s.umount()
+    # simulate: deferred record durable in KV, in-place write lost
+    s2 = os_mod.create("blockstore", str(tmp_path / "bs"))
+    rec = {"pofs": phys0 * s2.alloc_unit + 10, "data": b"XYZ"}
+    batch = KVTransaction().set("D", f"{10**15:016d}",
+                                Encoder().value(rec).bytes())
+    s2.db.submit_transaction(batch)
+    s2.umount()
+    s3 = os_mod.create("blockstore", str(tmp_path / "bs"))
+    data = s3.read("o")
+    assert data[10:13] == b"XYZ" and data[:10] == b"A" * 10
+    # replayed records are consumed
+    assert not list(s3.db.get_iterator("D"))
+    s3.umount()
+
+
+def test_blockstore_small_overwrite_is_deferred_and_durable(tmp_path):
+    s = os_mod.create("blockstore", str(tmp_path / "bs"))
+    s.queue_transaction(Transaction().write("o", 0, b"B" * 200_000))
+    s.queue_transaction(Transaction().write("o", 5000, b"hello"))
+    assert s.read("o", 5000, 5) == b"hello"
+    s.umount()
+    s2 = os_mod.create("blockstore", str(tmp_path / "bs"))
+    assert s2.read("o", 5000, 5) == b"hello"
+    assert s2.read("o", 0, 5) == b"BBBBB"
+    s2.umount()
+
+
+def test_blockstore_cow_frees_and_reuses_units(tmp_path):
+    s = os_mod.create("blockstore", str(tmp_path / "bs"))
+    au = s.alloc_unit
+    s.queue_transaction(Transaction().write("a", 0, b"1" * (2 * au)))
+    used_before = set(s._get_onode("a")["extents"].values())
+    # full-unit COW overwrite: old units return to the free set
+    s.queue_transaction(Transaction().write("a", 0, b"2" * (2 * au)))
+    assert used_before & s._free == used_before
+    # a new object reuses freed units instead of growing the device
+    s.queue_transaction(Transaction().write("b", 0, b"3" * (2 * au)))
+    assert set(s._get_onode("b")["extents"].values()) <= used_before
+    s.umount()
+    # allocator rebuilds from onodes at mount
+    s2 = os_mod.create("blockstore", str(tmp_path / "bs"))
+    live = set(s2._get_onode("a")["extents"].values()) | set(
+        s2._get_onode("b")["extents"].values()
+    )
+    assert s2._free == set(range(s2._high_water)) - live
+    assert s2.read("a") == b"2" * (2 * au)
+    s2.umount()
+
+
+def test_blockstore_truncate_shrink_regrow_reads_zeros(tmp_path):
+    s = os_mod.create("blockstore", str(tmp_path / "bs"))
+    s.queue_transaction(Transaction().write("o", 0, b"Z" * 100_000))
+    s.queue_transaction(Transaction().truncate("o", 40_000))
+    s.queue_transaction(Transaction().truncate("o", 90_000))
+    data = s.read("o")
+    assert data[:40_000] == b"Z" * 40_000
+    assert data[40_000:] == bytes(50_000)
+    s.umount()
+
+
+def test_blockstore_cluster_crash_remount(tmp_path):
+    """EC cluster on blockstore: abandon without umount (crash), remount,
+    every object still readable (the store_test crash family)."""
+    import asyncio
+
+    from ceph_tpu.osd.cluster import ECCluster
+
+    payloads = {f"o{i}": os.urandom(30_000 + i) for i in range(4)}
+
+    async def write_phase():
+        c = ECCluster(
+            6, {"plugin": "jerasure", "k": "3", "m": "2"},
+            objectstore="blockstore", data_path=str(tmp_path / "cl"),
+        )
+        for oid, p in payloads.items():
+            await c.write(oid, p)
+        await c.shutdown()  # crash: no store umount
+
+    async def read_phase():
+        c = ECCluster(
+            6, {"plugin": "jerasure", "k": "3", "m": "2"},
+            objectstore="blockstore", data_path=str(tmp_path / "cl"),
+        )
+        for oid, p in payloads.items():
+            assert await c.read(oid) == p
+        await c.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(write_phase())
+    asyncio.new_event_loop().run_until_complete(read_phase())
