@@ -72,17 +72,77 @@ class IoCtx:
     def __init__(self, rados: Rados, cluster: ECCluster):
         self._rados = rados
         self._cluster = cluster
+        #: self-managed snapshot state (librados: the APPLICATION owns the
+        #: snap context -- rados_ioctx_selfmanaged_snap_* -- exactly as
+        #: librbd keeps snap ids in its own header object)
+        self._snap_seq = 0
+        self._snaps: List[int] = []  # live snap ids, newest first
+        self.snap_read: Optional[int] = None  # set_snap_read target
+
+    # -- self-managed snapshots (librados selfmanaged_snap_* surface) ------
+
+    def _snapc(self) -> Optional[dict]:
+        if not self._snaps:
+            return None
+        return {"seq": self._snap_seq, "snaps": list(self._snaps)}
+
+    def selfmanaged_snap_create(self) -> int:
+        """Allocate a snap id; subsequent writes preserve pre-snap state
+        via COW clones (reference rados_ioctx_selfmanaged_snap_create)."""
+        self._snap_seq += 1
+        self._snaps.insert(0, self._snap_seq)
+        return self._snap_seq
+
+    def selfmanaged_snap_remove(self, snapid: int) -> None:
+        """Drop a snap id and trim clones it alone kept alive (the
+        SnapMapper/snap-trimmer role, run client-side: trims fan out
+        concurrently, one round per object)."""
+        import asyncio as _aio
+
+        if snapid in self._snaps:
+            self._snaps.remove(snapid)
+        backend = self._cluster.backend
+        live = list(self._snaps)
+        heads = [o for o in self.list_objects() if "~" not in o]
+
+        async def trim_all():
+            await _aio.gather(
+                *(backend.snap_trim(oid, live) for oid in heads)
+            )
+
+        self._rados._run(trim_all())
+
+    def selfmanaged_snap_rollback(self, oid: str, snapid: int) -> None:
+        self._rados._run(
+            self._cluster.backend.snap_rollback(
+                oid, snapid, snapc=self._snapc()
+            )
+        )
+
+    def set_snap_read(self, snapid: Optional[int]) -> None:
+        """Route subsequent reads to the object state at ``snapid``
+        (None = head)."""
+        self.snap_read = snapid
+
+    def list_snaps(self, oid: str) -> dict:
+        return self._rados._run(self._cluster.backend.list_snaps(oid))
 
     # -- sync surface ------------------------------------------------------
 
     def write_full(self, oid: str, data: bytes) -> None:
-        self._rados._run(self._cluster.write(oid, data))
+        self._rados._run(
+            self._cluster.backend.write(oid, data, snapc=self._snapc())
+        )
 
     def read(self, oid: str) -> bytes:
-        return self._rados._run(self._cluster.read(oid))
+        return self._rados._run(
+            self._cluster.backend.read(oid, snap=self.snap_read)
+        )
 
     def remove(self, oid: str) -> None:
-        self._rados._run(self._cluster.backend.remove_object(oid))
+        self._rados._run(
+            self._cluster.backend.remove_object(oid, snapc=self._snapc())
+        )
 
     def stat(self, oid: str) -> int:
         """Logical object size (from the first reachable shard's xattr)."""

@@ -227,6 +227,32 @@ class BlockStore:
                 truncate_to(onode_for(op.oid), op.offset)
             elif op.op == "setattr":
                 onode_for(op.oid)["attrs"][op.attr_name] = op.attr_value
+            elif op.op == "clone":
+                src_exists = (
+                    onodes.get(op.oid) is not None
+                    if op.oid in onodes else self._get_onode(op.oid)
+                )
+                if not src_exists:
+                    raise FileNotFoundError(op.oid)
+                src = onode_for(op.oid)
+                au = self.alloc_unit
+                dst = {"size": src["size"], "attrs": dict(src["attrs"]),
+                       "extents": {}}
+                for u, phys in src["extents"].items():
+                    base = bytearray(self._dev_read(phys))
+                    p0 = phys * au
+                    for rec in deferred:
+                        if p0 <= rec["pofs"] < p0 + au:
+                            off = rec["pofs"] - p0
+                            base[off:off + len(rec["data"])] = rec["data"]
+                    new_phys = self._alloc()
+                    self._dev_write(new_phys * au, bytes(base))
+                    dst["extents"][u] = new_phys
+                # a clone earlier staged under this name is replaced
+                old = onodes.get(op.attr_name)
+                if old:
+                    freed.extend(old["extents"].values())
+                onodes[op.attr_name] = dst
             elif op.op == "remove":
                 cur = onode_for(op.oid)
                 freed.extend(cur["extents"].values())

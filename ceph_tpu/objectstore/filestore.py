@@ -229,6 +229,23 @@ class FileStore:
                 p = self._data_path(op.oid)
                 if not os.path.exists(p):
                     open(p, "wb").close()
+            elif op.op == "clone":
+                import shutil
+
+                sp = self._data_path(op.oid)
+                dp = self._data_path(op.attr_name)
+                if os.path.exists(dp):
+                    # journal-replay idempotency: later ops in the same
+                    # txn mutate the source (truncate/overwrite), so
+                    # re-cloning on replay would capture post-txn bytes
+                    # and destroy the snapshot.  Clone targets are
+                    # create-once (unique snap seq), so an existing dst
+                    # means the op already applied.
+                    continue
+                if not os.path.exists(sp):
+                    raise FileNotFoundError(op.oid)
+                shutil.copyfile(sp, dp)
+                self._write_attrs(op.attr_name, self._read_attrs(op.oid))
             elif op.op == "remove":
                 for p in (self._data_path(op.oid), self._attr_path(op.oid),
                           self._omap_path(op.oid)):

@@ -121,6 +121,22 @@ class KStore:
                         del st[loff:]
             elif op.op == "setattr":
                 meta_for(op.oid)["xattrs"][op.attr_name] = op.attr_value
+            elif op.op == "clone":
+                src_meta = metas[op.oid] if op.oid in metas \
+                    else self._get_meta(op.oid)
+                if src_meta is None:
+                    raise FileNotFoundError(op.oid)
+                dst = op.attr_name
+                metas[dst] = {"size": src_meta["size"],
+                              "xattrs": dict(src_meta["xattrs"])}
+                removed.discard(dst)
+                for n in range(src_meta["size"] // self.stripe_size + 1):
+                    st = stripes.get(op.oid, {}).get(n)
+                    if st is None:
+                        raw = self.db.get("D", self._stripe_key(op.oid, n))
+                        st = bytearray(raw) if raw is not None else None
+                    if st is not None:
+                        stripes.setdefault(dst, {})[n] = bytearray(st)
             elif op.op == "remove":
                 # dead-stripe range must cover the ON-DISK size too: a
                 # shrink staged earlier in this txn would otherwise leave

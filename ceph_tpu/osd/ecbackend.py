@@ -49,10 +49,23 @@ SIZE_KEY = "_size"
 #: chunk to a decode (the PG-log/peering consistency guarantee, reduced
 #: to a read-time check)
 VERSION_KEY = "_version"
+#: per-object snapshot set xattr (the SnapSet role, src/osd/osd_types.h):
+#: {"seq": newest snap context seen, "clones": [{"id", "size"}, ...]}
+SNAPSET_KEY = "_snapset"
+#: head deleted under a snap context but clones survive (the snapdir
+#: object role, src/osd/PrimaryLogPG.cc)
+WHITEOUT_KEY = "_whiteout"
 
 
 def shard_oid(oid: str, shard: int) -> str:
     return f"{oid}@{shard}"
+
+
+def snap_oid(oid: str, clone_id: int) -> str:
+    """Clone object name; '~' is reserved so clones co-place with their
+    head (placement strips the suffix, mirroring how the reference keeps
+    clones in the head's PG via the ghobject snap field)."""
+    return f"{oid}~{clone_id}"
 
 
 def vt(v) -> tuple:
@@ -876,6 +889,8 @@ class OSDShard:
                     ecutil.HINFO_KEY: self.store.getattr(soid, ecutil.HINFO_KEY),
                     SIZE_KEY: self.store.getattr(soid, SIZE_KEY),
                     VERSION_KEY: self.store.getattr(soid, VERSION_KEY),
+                    SNAPSET_KEY: self.store.getattr(soid, SNAPSET_KEY),
+                    WHITEOUT_KEY: self.store.getattr(soid, WHITEOUT_KEY),
                 }
             except FileNotFoundError:
                 pass
@@ -986,17 +1001,25 @@ class ECBackend:
         #: last inconsistent deep-scrub reports (ScrubStore role);
         #: cleared when a re-scrub comes back clean
         self.scrub_errors: Dict[str, dict] = {}
+        #: per-object SnapSet cache learned via _stat:
+        #: {"seq", "clones", "exists", "size"}
+        self._snapsets: Dict[str, dict] = {}
 
     # -- placement (CRUSH-lite) --------------------------------------------
 
     def acting_set(self, oid: str) -> List[int]:
         """Stable pseudorandom placement of the km shards over OSDs.
 
+        Clone objects ("oid~<cloneid>") place WITH their head object --
+        the suffix is stripped before hashing -- so snapshots live in the
+        head's PG exactly like the reference's ghobject snap ids.
+
         With a CrushPlacement attached this is the real thing: oid -> pg ->
         crush indep rule over the map (src/crush/mapper.c crush_choose_indep;
         src/osd/OSDMap.cc _pg_to_raw_osds).  The fallback is a deterministic
         permutation seeded by the object name.
         """
+        oid = oid.split("~", 1)[0]
         if self.placement is not None:
             return self.placement.acting(oid)
         from ceph_tpu.osd.placement import fallback_acting
@@ -1131,8 +1154,13 @@ class ECBackend:
         if seen[0] > self._version_head:
             self._version_head = seen[0]
 
-    async def write(self, oid: str, data: bytes) -> None:
+    async def write(self, oid: str, data: bytes, snapc=None) -> None:
         """Append-only full-object write (create or replace).
+
+        ``snapc`` = {"seq": int, "snaps": [ids]} (librados SnapContext):
+        when seq is newer than the object's SnapSet seq, the current head
+        is cloned shard-by-shard in the SAME transaction before the new
+        bytes land (PrimaryLogPG::make_writeable).
 
         A WriteConflict (a shard refused the version as stale) propagates
         to the caller: with the primary hosted in the OSD, one primary
@@ -1146,7 +1174,7 @@ class ECBackend:
         async with self._object_lock(oid):
             async with self.extent_cache.pin(oid, 0, 1 << 62):
                 try:
-                    await self._write_pinned(oid, data)
+                    await self._write_pinned(oid, data, snapc)
                 except WriteConflict as wc:
                     # adopt the winning version so a retry lands on top
                     self._learn_version(oid, wc.winner)
@@ -1158,12 +1186,16 @@ class ECBackend:
                     # bytes are stale
                     self.extent_cache.invalidate(oid)
 
-    async def _write_pinned(self, oid: str, data: bytes) -> None:
+    async def _write_pinned(self, oid: str, data: bytes,
+                            snapc=None) -> None:
         # a primary that has never touched this object must learn its
         # current version first: overwriting with a regressed version
         # would be refused by the shards' stale-write gate
-        if oid not in self._versions:
+        if oid not in self._versions or (
+            snapc and oid not in self._snapsets
+        ):
             await self._stat(oid)
+        snapset, clone_id = self._snap_prepare(oid, snapc)
         version = self._next_version(oid)
         logical = len(data)
         padded_len = self.sinfo.logical_to_next_stripe_offset(logical)
@@ -1206,14 +1238,20 @@ class ECBackend:
             if acting[s] is None:
                 continue  # CRUSH hole: no device for this position
             soid = shard_oid(oid, s)
+            txn = Transaction()
+            if clone_id is not None:
+                txn.clone(soid, shard_oid(snap_oid(oid, clone_id), s))
             txn = (
-                Transaction()
+                txn
                 .write(soid, 0, encoded[s].tobytes())
                 .truncate(soid, len(encoded[s]))
                 .setattr(soid, ecutil.HINFO_KEY, hinfo.to_dict())
                 .setattr(soid, SIZE_KEY, logical)
                 .setattr(soid, VERSION_KEY, version)
             )
+            txn.setattr(soid, WHITEOUT_KEY, None)
+            if snapset is not None:
+                txn.setattr(soid, SNAPSET_KEY, snapset)
             sub = ECSubWrite(
                 from_shard=s,
                 tid=tid,
@@ -1231,6 +1269,7 @@ class ECBackend:
         try:
             await self._await_commits(oid, tid, done, min_acks=self.k)
             span.event("all_commit")
+            self._snap_committed(oid, snapset, logical)
         finally:
             span.finish()
 
@@ -1497,17 +1536,27 @@ class ECBackend:
             if self._shard_up(acting, s)
         ]
         replies = await self._read_shards(oid, up, acting, extents=[(0, 0)])
-        best = None  # (version_tuple, size, hinfo)
+        best = None  # (version_tuple, size, hinfo, snapset, whiteout)
         for r in replies.values():
             attrs = r.attrs_read.get(oid) or {}
             if attrs.get(SIZE_KEY) is None:
                 continue
             ver = vt(attrs.get(VERSION_KEY))
             if best is None or ver > best[0]:
-                best = (ver, attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY))
+                best = (ver, attrs[SIZE_KEY], attrs.get(ecutil.HINFO_KEY),
+                        attrs.get(SNAPSET_KEY), attrs.get(WHITEOUT_KEY))
         if best is None:
+            self._snapsets[oid] = {"seq": 0, "clones": [],
+                                   "exists": False, "size": 0}
             return 0, None
         self._learn_version(oid, best[0])
+        ss = best[3] or {"seq": 0, "clones": []}
+        self._snapsets[oid] = {
+            "seq": ss["seq"], "clones": list(ss["clones"]),
+            "exists": not best[4], "size": best[1],
+        }
+        if best[4]:
+            return 0, None  # whiteout head: absent to plain stat/readers
         return best[1], best[2]
 
     async def stat(self, oid: str):
@@ -1551,7 +1600,8 @@ class ECBackend:
         self.perf.inc("read_range")
         return data[lo : lo + length]
 
-    async def write_range(self, oid: str, offset: int, data: bytes) -> None:
+    async def write_range(self, oid: str, offset: int, data: bytes,
+                          snapc=None) -> None:
         """Partial write with RMW (the ECTransaction get_write_plan path).
 
         Appends extend the cumulative hash info; overwrites clear the chunk
@@ -1567,7 +1617,9 @@ class ECBackend:
             hi_pin = self.sinfo.logical_to_next_stripe_offset(offset + len(data))
             async with self.extent_cache.pin(oid, lo_pin, hi_pin) as pin:
                 try:
-                    await self._write_range_pinned(oid, offset, data, pin)
+                    await self._write_range_pinned(
+                        oid, offset, data, pin, snapc
+                    )
                 except WriteConflict as wc:
                     # this primary's version view was cold (see write());
                     # learn the winner so the Objecter-level retry replays
@@ -1584,11 +1636,12 @@ class ECBackend:
                     raise
 
     async def _write_range_pinned(
-        self, oid: str, offset: int, data: bytes, pin
+        self, oid: str, offset: int, data: bytes, pin, snapc=None
     ) -> None:
         from ceph_tpu.osd.ectransaction import get_write_plan
 
         size, hinfo_d = await self._stat(oid)
+        snapset, clone_id = self._snap_prepare(oid, snapc)
         # the version counter this RMW is computed on top of: shards not
         # on this base missed history and must skip the extent write
         base_version = self._versions.get(oid, 0)
@@ -1650,13 +1703,19 @@ class ECBackend:
         self.log.append(entry)
         for s in range(self.km):
             soid = shard_oid(oid, s)
+            txn = Transaction()
+            if clone_id is not None:
+                txn.clone(soid, shard_oid(snap_oid(oid, clone_id), s))
             txn = (
-                Transaction()
+                txn
                 .write(soid, chunk_off, encoded[s].tobytes())
                 .setattr(soid, ecutil.HINFO_KEY, hinfo.to_dict())
                 .setattr(soid, SIZE_KEY, plan.new_size)
                 .setattr(soid, VERSION_KEY, version)
+                .setattr(soid, WHITEOUT_KEY, None)
             )
+            if snapset is not None:
+                txn.setattr(soid, SNAPSET_KEY, snapset)
             sub = ECSubWrite(
                 from_shard=s, tid=tid, oid=oid, transaction=txn,
                 at_version=version, log_entries=[entry],
@@ -1667,20 +1726,67 @@ class ECBackend:
             )
         self.perf.inc("write_range")
         await self._await_commits(oid, tid, done, min_acks=self.k)
+        self._snap_committed(oid, snapset, plan.new_size)
         # publish committed bytes for read-through (padding included: those
         # bytes are logically zero up to new_size and real data below it)
         pin.commit(start, buf.tobytes())
 
-    async def remove_object(self, oid: str) -> None:
-        """Delete every shard of an object (librados remove role)."""
+    async def remove_object(self, oid: str, snapc=None) -> None:
+        """Delete every shard of an object (librados remove role).
+
+        Under a snap context newer than the SnapSet seq the head is
+        cloned first and then WHITEOUT'd (truncated to zero with the
+        whiteout attr) instead of removed, so snap reads keep resolving
+        through the head's SnapSet -- the reference's snapdir object.
+        The whiteout disappears when snap_trim drops the last clone."""
+        async with self._object_lock(oid):
+            await self._remove_object_locked(oid, snapc)
+
+    async def _remove_object_locked(self, oid: str, snapc=None) -> None:
         acting = self.acting_set(oid)
         up = [s for s in range(self.km) if self._shard_up(acting, s)]
         if not up:
             raise IOError(f"cannot remove {oid}: no shards up")
         if len(up) < len([s for s in range(self.km) if acting[s] is not None]):
             self._dirty.add(oid)  # down holders keep a doomed copy
-        if oid not in self._versions:
+        if oid not in self._versions or (
+            snapc and oid not in self._snapsets
+        ):
             await self._stat(oid)
+        snapset, clone_id = self._snap_prepare(oid, snapc)
+        if clone_id is not None:
+            # snap-preserving delete: clone + whiteout in one transaction
+            if len(up) < self.k:
+                raise IOError(f"cannot remove {oid}: only {len(up)} up")
+            version = self._next_version(oid)
+            tid = self._new_tid()
+            done = asyncio.get_event_loop().create_future()
+            self._pending[tid] = {
+                "committed": set(),
+                "expected": {f"osd.{acting[s]}" for s in up},
+                "done": done,
+            }
+            for s in up:
+                soid = shard_oid(oid, s)
+                txn = (
+                    Transaction()
+                    .clone(soid, shard_oid(snap_oid(oid, clone_id), s))
+                    .truncate(soid, 0)
+                    .setattr(soid, SIZE_KEY, 0)
+                    .setattr(soid, VERSION_KEY, version)
+                    .setattr(soid, WHITEOUT_KEY, True)
+                    .setattr(soid, SNAPSET_KEY, snapset)
+                )
+                await self.messenger.send_message(
+                    self.name, f"osd.{acting[s]}",
+                    ECSubWrite(from_shard=s, tid=tid, oid=oid,
+                               transaction=txn, at_version=version),
+                )
+            await self._await_commits(oid, tid, done, min_acks=self.k)
+            self._snap_committed(oid, snapset, 0, exists=False)
+            self.extent_cache.invalidate(oid)
+            return
+        self._snapsets.pop(oid, None)
         version = self._next_version(oid)
         tid = self._new_tid()
         done = asyncio.get_event_loop().create_future()
@@ -1886,6 +1992,149 @@ class ECBackend:
 
         return await call_method(self, oid, cls, method, inp)
 
+    # -- snapshots (SnapMapper / make_writeable roles) ---------------------
+
+    def _snap_prepare(self, oid: str, snapc):
+        """(new snapset attr value, clone id) for a write under ``snapc``;
+        (None, None) when no snap context.  Must run after _stat primed
+        the SnapSet cache.  Reference: PrimaryLogPG::make_writeable."""
+        if not snapc:
+            return None, None
+        cur = self._snapsets.get(oid) or {
+            "seq": 0, "clones": [], "exists": False, "size": 0
+        }
+        snapset = {"seq": max(cur["seq"], snapc["seq"]),
+                   "clones": list(cur["clones"])}
+        clone_id = None
+        if cur.get("exists") and snapc["seq"] > cur["seq"]:
+            clone_id = snapc["seq"]
+            snapset["clones"].append(
+                {"id": clone_id, "size": cur.get("size", 0)}
+            )
+        return snapset, clone_id
+
+    def _snap_committed(self, oid: str, snapset, new_size: int,
+                        exists: bool = True) -> None:
+        """Update the SnapSet cache after a committed snap-context op."""
+        if snapset is None:
+            ent = self._snapsets.get(oid)
+            if ent is not None:
+                ent["exists"] = exists
+                ent["size"] = new_size
+            return
+        self._snapsets[oid] = {
+            "seq": snapset["seq"], "clones": list(snapset["clones"]),
+            "exists": exists, "size": new_size,
+        }
+
+    async def resolve_snap(self, oid: str, snap: int) -> str:
+        """Object name serving reads at snap id ``snap``: the oldest clone
+        whose id >= snap, else the head (librados snap read resolution,
+        SnapSet::get_clone_bytes / PrimaryLogPG::find_object_context)."""
+        if oid not in self._snapsets:
+            await self._stat(oid)
+        ss = self._snapsets.get(oid)
+        if not ss or not ss["clones"]:
+            return oid
+        cands = sorted(c["id"] for c in ss["clones"] if c["id"] >= snap)
+        return snap_oid(oid, cands[0]) if cands else oid
+
+    async def list_snaps(self, oid: str) -> dict:
+        """The object's SnapSet (rados listsnaps role)."""
+        await self._stat(oid)  # refresh
+        ss = self._snapsets.get(oid) or {"seq": 0, "clones": [],
+                                         "exists": False}
+        return {"seq": ss["seq"], "clones": list(ss["clones"]),
+                "head_exists": bool(ss.get("exists"))}
+
+    async def snap_rollback(self, oid: str, snap: int, snapc=None) -> None:
+        """Restore the head to its state at ``snap`` (librados
+        selfmanaged_snap_rollback; reference PrimaryLogPG::_rollback_to).
+        Implemented as read-at-snap + write-as-new-version, so the
+        rollback itself is snapshotted under ``snapc`` like any write."""
+        src = await self.resolve_snap(oid, snap)
+        if src == oid:
+            return  # head already is the snap state
+        data = await self.read(src)
+        await self.write(oid, data, snapc=snapc)
+
+    async def snap_trim(self, oid: str, live_snaps) -> int:
+        """Drop clones no longer needed by any live snap (SnapMapper +
+        snap trim role).  A clone with id C covers snaps in
+        (previous clone id, C]; when none of those are alive the clone is
+        removed and the head's SnapSet shrinks.  A whiteout head whose
+        last clone goes is removed outright.  Returns clones dropped."""
+        await self._stat(oid)
+        cur = self._snapsets.get(oid)
+        if not cur or not cur["clones"]:
+            return 0
+        live = sorted(live_snaps)
+        keep, drop = [], []
+        prev = 0
+        for c in sorted(cur["clones"], key=lambda c: c["id"]):
+            if any(prev < sn <= c["id"] for sn in live):
+                keep.append(c)
+            else:
+                drop.append(c)
+            prev = c["id"]
+        if not drop:
+            return 0
+        # the whole read-modify-write of the SnapSet runs under the head's
+        # object lock so a concurrent snap-context write cannot append a
+        # clone entry that the stale stamp below would erase
+        async with self._object_lock(oid):
+            cur = self._snapsets.get(oid) or cur  # re-read under the lock
+            keep = [c for c in cur["clones"]
+                    if not any(d["id"] == c["id"] for d in drop)]
+            for c in drop:
+                try:
+                    await self.remove_object(snap_oid(oid, c["id"]))
+                except IOError:
+                    pass  # already gone; peering will converge
+            self.perf.inc("snap_trim", len(drop))
+            if not keep and not cur.get("exists"):
+                # whiteout head, no clones left: the object is fully dead
+                await self._remove_object_locked(oid)
+                self._snapsets.pop(oid, None)
+                return len(drop)
+            new_ss = {"seq": cur["seq"], "clones": keep}
+            await self._set_snapset_locked(oid, new_ss)
+        return len(drop)
+
+    async def _set_snapset_locked(self, oid: str, snapset: dict) -> None:
+        """Attr-only fan-out updating the head's SnapSet (version-stamped
+        so the stale gates order it like any write).  Caller holds the
+        object lock."""
+        acting = self.acting_set(oid)
+        up = [s for s in range(self.km) if self._shard_up(acting, s)]
+        if len(up) < self.k:
+            raise IOError(f"cannot update snapset of {oid}")
+        version = self._next_version(oid)
+        tid = self._new_tid()
+        done = asyncio.get_event_loop().create_future()
+        self._pending[tid] = {
+            "committed": set(),
+            "expected": {f"osd.{acting[s]}" for s in up},
+            "done": done,
+        }
+        for s in up:
+            soid = shard_oid(oid, s)
+            txn = (
+                Transaction()
+                .setattr(soid, SNAPSET_KEY, snapset)
+                .setattr(soid, VERSION_KEY, version)
+            )
+            await self.messenger.send_message(
+                self.name, f"osd.{acting[s]}",
+                ECSubWrite(from_shard=s, tid=tid, oid=oid,
+                           transaction=txn, at_version=version),
+            )
+        await self._await_commits(oid, tid, done, min_acks=self.k)
+        ent = self._snapsets.get(oid)
+        if ent is not None:
+            ent["seq"] = snapset["seq"]
+            ent["clones"] = list(snapset["clones"])
+
     # -- scrub -------------------------------------------------------------
 
     async def deep_scrub(self, oid: str) -> dict:
@@ -1993,17 +2242,25 @@ class ECBackend:
         write landing mid-recovery changes the object version; that is
         detected at the next window's gather and the recovery restarts.
         ``rollback=True`` lets the final stamp overwrite a torn
-        higher-versioned copy (peering's divergent-entry rollback)."""
+        higher-versioned copy (peering's divergent-entry rollback).
+
+        The whole recovery holds the object's write lock, so client
+        writes to a HOT object queue briefly behind the push instead of
+        restarting it forever (the reference pins the object context for
+        the duration of the push, src/osd/ECBackend.cc:535-700).  The
+        version-moved restart loop remains as a safety net for writes
+        from a racing primary, which does not share this lock."""
         from ceph_tpu.utils.config import get_config
 
         window = max(1, int(get_config().get_val("osd_recovery_max_chunk")))
-        for attempt in range(3):
-            if await self._recover_shard_once(
-                oid, shard, target_osd, window, rollback
-            ):
-                self.perf.inc("recover")
-                return
-            self.perf.inc("recover_restart")
+        async with self._object_lock(oid):
+            for attempt in range(3):
+                if await self._recover_shard_once(
+                    oid, shard, target_osd, window, rollback
+                ):
+                    self.perf.inc("recover")
+                    return
+                self.perf.inc("recover_restart")
         raise IOError(
             f"recovery of {oid}@{shard} kept losing to concurrent writes"
         )
@@ -2494,19 +2751,31 @@ class ECBackend:
         to the acting set.  Returns the op's wire-encodable result."""
         kind = msg["kind"]
         oid = msg.get("oid", "")
+        snap = msg.get("snap")
+        if snap is not None and kind in ("read", "read_range", "stat"):
+            # snap reads resolve to the serving clone (find_object_context)
+            oid = await self.resolve_snap(oid, snap)
         if kind == "write":
-            await self.write(oid, msg["data"])
+            await self.write(oid, msg["data"], snapc=msg.get("snapc"))
         elif kind == "read":
             return await self.read(oid)
         elif kind == "write_range":
-            await self.write_range(oid, msg["offset"], msg["data"])
+            await self.write_range(oid, msg["offset"], msg["data"],
+                                   snapc=msg.get("snapc"))
         elif kind == "read_range":
             return await self.read_range(oid, msg["offset"], msg["length"])
         elif kind == "remove":
-            await self.remove_object(oid)
+            await self.remove_object(oid, snapc=msg.get("snapc"))
         elif kind == "stat":
             size, hinfo = await self._stat(oid)
             return (size, hinfo)
+        elif kind == "snap_rollback":
+            await self.snap_rollback(oid, msg["snapid"],
+                                     snapc=msg.get("snapc"))
+        elif kind == "snap_trim":
+            return await self.snap_trim(oid, msg["live_snaps"])
+        elif kind == "list_snaps":
+            return await self.list_snaps(oid)
         elif kind == "scrub":
             return await self.deep_scrub(oid)
         elif kind == "recover":

@@ -58,6 +58,15 @@ class MemStore:
                         del o.data[op.offset :]
                     else:
                         o.data.extend(b"\0" * (op.offset - len(o.data)))
+                elif op.op == "clone":
+                    src = staged[op.oid] if op.oid in staged \
+                        else self._objects.get(op.oid)
+                    if src is None:
+                        raise FileNotFoundError(op.oid)
+                    dst = MemObject()
+                    dst.data = bytearray(src.data)
+                    dst.xattrs = dict(src.xattrs)
+                    staged[op.attr_name] = dst
                 elif op.op == "remove":
                     staged[op.oid] = None
                 elif op.op == "omap_set":

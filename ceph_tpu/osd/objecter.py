@@ -39,7 +39,7 @@ _EXCEPTIONS = {
 #: the new authority.  The reference dedups via reqids persisted in the pg
 #: log; until an equivalent exists these surface an indeterminate-outcome
 #: error instead of lying (librados analogue: ETIMEDOUT, caller re-checks).
-_NON_IDEMPOTENT = frozenset({"omap_cas", "exec"})
+_NON_IDEMPOTENT = frozenset({"omap_cas", "exec", "snap_rollback"})
 
 
 class OpIndeterminate(IOError):
@@ -109,6 +109,7 @@ class Objecter:
     # -- placement (the _calc_target role) ---------------------------------
 
     def acting_set(self, oid: str) -> List[Optional[int]]:
+        oid = oid.split("~", 1)[0]  # clones place with their head
         if self.placement is not None:
             return self.placement.acting(oid)
         from ceph_tpu.osd.placement import fallback_acting
@@ -226,22 +227,36 @@ class Objecter:
 
     # -- I/O surface (librados IoCtx ops, one round trip each) -------------
 
-    async def write(self, oid: str, data: bytes) -> None:
-        await self._submit("write", oid, data=bytes(data))
+    async def write(self, oid: str, data: bytes, snapc=None) -> None:
+        await self._submit("write", oid, data=bytes(data), snapc=snapc)
 
-    async def read(self, oid: str) -> bytes:
-        return await self._submit("read", oid)
+    async def read(self, oid: str, snap=None) -> bytes:
+        return await self._submit("read", oid, snap=snap)
 
-    async def write_range(self, oid: str, offset: int, data: bytes) -> None:
+    async def write_range(self, oid: str, offset: int, data: bytes,
+                          snapc=None) -> None:
         await self._submit("write_range", oid, offset=offset,
-                           data=bytes(data))
+                           data=bytes(data), snapc=snapc)
 
-    async def read_range(self, oid: str, offset: int, length: int) -> bytes:
+    async def read_range(self, oid: str, offset: int, length: int,
+                         snap=None) -> bytes:
         return await self._submit("read_range", oid, offset=offset,
-                                  length=length)
+                                  length=length, snap=snap)
 
-    async def remove_object(self, oid: str) -> None:
-        await self._submit("remove", oid)
+    async def remove_object(self, oid: str, snapc=None) -> None:
+        await self._submit("remove", oid, snapc=snapc)
+
+    # -- snapshots (librados selfmanaged snap surface) ---------------------
+
+    async def snap_rollback(self, oid: str, snapid: int, snapc=None) -> None:
+        await self._submit("snap_rollback", oid, snapid=snapid, snapc=snapc)
+
+    async def snap_trim(self, oid: str, live_snaps) -> int:
+        return await self._submit("snap_trim", oid,
+                                  live_snaps=list(live_snaps))
+
+    async def list_snaps(self, oid: str) -> dict:
+        return await self._submit("list_snaps", oid)
 
     async def stat(self, oid: str):
         """(logical size, hinfo dict | None) from the primary."""

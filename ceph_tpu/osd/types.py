@@ -47,6 +47,14 @@ class Transaction:
         self.ops.append(TxnOp("truncate", oid=oid, offset=offset))
         return self
 
+    def clone(self, oid: str, dst_oid: str) -> "Transaction":
+        """Copy ``oid`` (data + xattrs) to ``dst_oid`` -- the COW-clone
+        primitive snapshots ride on (reference: ObjectStore clone,
+        PrimaryLogPG::make_writeable cloning the head before a write
+        under a newer SnapContext)."""
+        self.ops.append(TxnOp("clone", oid=oid, attr_name=dst_oid))
+        return self
+
     # -- omap (reference: ObjectStore omap_setkeys/rmkeys/clear; the
     # per-object sorted key->value map cls/mds/rbd metadata lives in) ----
 
