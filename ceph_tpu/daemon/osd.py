@@ -58,6 +58,38 @@ async def serve(args) -> None:
         shard.host_pool(conf.get("pool", "ecpool"), ec, n_osds, placement)
         # daemons run peering-driven auto recovery by default (OSD::tick)
         shard.start_tick()
+    # admin socket (src/common/admin_socket.cc): perf dump / ops /
+    # config show / status over a unix socket next to the data dir
+    asok = None
+    if args.admin_socket or args.data_path:
+        from ceph_tpu.utils.admin_socket import AdminSocket
+        from ceph_tpu.utils.config import get_config
+
+        asok_path = args.admin_socket or f"{args.data_path}/{name}.asok"
+        asok = AdminSocket(asok_path)
+        asok.register("perf dump", lambda cmd: shard.perf.snapshot())
+        asok.register(
+            "ops", lambda cmd: shard.optracker.dump_ops_in_flight()
+        )
+        asok.register(
+            "dump_historic_ops",
+            lambda cmd: shard.optracker.dump_historic_ops(),
+        )
+        asok.register(
+            "config show", lambda cmd: get_config().show_config()
+        )
+        asok.register(
+            "config set",
+            lambda cmd: get_config().apply_changes(
+                {cmd["key"]: cmd["value"]}
+            ) or {"success": True},
+        )
+        asok.register("status", lambda cmd: {
+            "name": name,
+            "objects": len(shard.store.list_objects()),
+            "pools": sorted(shard.pools),
+        })
+        await asok.start()
     print(f"{name} up", flush=True)
 
     stop = asyncio.Event()
@@ -65,6 +97,8 @@ async def serve(args) -> None:
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if asok is not None:
+        await asok.stop()
     await messenger.shutdown()
 
 
@@ -77,6 +111,9 @@ def main(argv=None) -> int:
     ap.add_argument("--op-queue", default="wpq")
     ap.add_argument("--keyring", default="",
                     help="keyring file enabling cephx-style auth")
+    ap.add_argument("--admin-socket", default="",
+                    help="unix socket path for daemon introspection "
+                         "(default <data-path>/<name>.asok)")
     ap.add_argument("--cluster-conf", default="",
                     help="cluster.json with the pool profile: this OSD "
                          "hosts a primary engine for the pool")

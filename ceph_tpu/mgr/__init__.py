@@ -15,5 +15,7 @@ python modules (prometheus, status, ...).  Same roles here:
 
 from ceph_tpu.mgr.mgr import ClusterState, MgrDaemon, health_checks, \
     prometheus_text
+from ceph_tpu.mgr.module_host import MgrModule, PyModuleRegistry
 
-__all__ = ["ClusterState", "MgrDaemon", "health_checks", "prometheus_text"]
+__all__ = ["ClusterState", "MgrDaemon", "health_checks", "prometheus_text",
+           "MgrModule", "PyModuleRegistry"]

@@ -162,8 +162,14 @@ def prometheus_text(state: dict) -> str:
 class MgrDaemon:
     """HTTP endpoint: /metrics (prometheus), /health, /status (JSON)."""
 
-    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0):
+    def __init__(self, cluster, host: str = "127.0.0.1", port: int = 0,
+                 registry=None):
         self.state = ClusterState(cluster)
+        if registry is None:
+            from ceph_tpu.mgr.module_host import PyModuleRegistry
+
+            registry = PyModuleRegistry(cluster)
+        self.registry = registry
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -173,9 +179,37 @@ class MgrDaemon:
             self._serve, self.host, self.port
         )
         self.port = self._server.sockets[0].getsockname()[1]
+        # host the modules: serve() loops + map-change notifications
+        # (ActivePyModules lifecycle; the reference notifies modules on
+        # every map/health epoch -- polled here, the in-process cluster
+        # has no mgr subscription channel)
+        self.registry.start()
+        self._notify_task = asyncio.get_event_loop().create_task(
+            self._notify_loop()
+        )
         return self.port
 
+    async def _notify_loop(self, interval: float = 1.0) -> None:
+        last_up = None
+        while True:
+            up = tuple(
+                not self.state.cluster.messenger.is_down(o.name)
+                for o in self.state.cluster.osds
+            )
+            if up != last_up:
+                last_up = up
+                self.registry.notify_all("osd_map")
+            await asyncio.sleep(interval)
+
     async def stop(self) -> None:
+        task = getattr(self, "_notify_task", None)
+        if task is not None:
+            task.cancel()
+            try:
+                await task
+            except asyncio.CancelledError:
+                pass
+        await self.registry.stop()
         if self._server is not None:
             self._server.close()
             await self._server.wait_closed()
@@ -190,13 +224,16 @@ class MgrDaemon:
                     break
             path = request.split()[1].decode() if request.split() else "/"
             if path == "/metrics":
-                body = prometheus_text(self.state.dump())
+                # served BY the prometheus module through the host surface
+                prom = self.registry.modules.get("prometheus")
+                body = (prom.metrics() if prom is not None
+                        else prometheus_text(self.state.dump()))
                 ctype = "text/plain; version=0.0.4"
                 code = "200 OK"
             elif path == "/health":
                 import json
 
-                body = json.dumps(health_checks(self.state.dump()))
+                body = json.dumps(self.registry.gather_health())
                 ctype = "application/json"
                 code = "200 OK"
             elif path == "/status":

@@ -1,0 +1,1 @@
+"""Builtin mgr modules (the src/pybind/mgr tree's role)."""

@@ -112,6 +112,41 @@ void mul_region(uint8_t c, const uint8_t* in, uint8_t* out, size_t n,
 
 extern "C" {
 
+// Runtime CPU feature probe (reference src/arch/probe.cc ceph_arch_probe
+// + src/arch/intel.c: the reference fills ceph_arch_intel_* flags once
+// and codecs pick kernels off them).  Bitmask: 1=sse4.2, 2=avx,
+// 4=avx2, 8=avx512f.
+int ec_arch_probe(void) {
+  int f = 0;
+#if defined(__x86_64__) || defined(__i386__)
+  __builtin_cpu_init();
+  if (__builtin_cpu_supports("sse4.2")) f |= 1;
+  if (__builtin_cpu_supports("avx")) f |= 2;
+  if (__builtin_cpu_supports("avx2")) f |= 4;
+  if (__builtin_cpu_supports("avx512f")) f |= 8;
+#endif
+  return f;
+}
+
+// What THIS build was compiled to require (so a library copied onto an
+// older machine is rejected at load instead of crashing mid-kernel).
+int ec_arch_built(void) {
+  int f = 0;
+#if defined(__SSE4_2__)
+  f |= 1;
+#endif
+#if defined(__AVX__)
+  f |= 2;
+#endif
+#if defined(__AVX2__)
+  f |= 4;
+#endif
+#if defined(__AVX512F__)
+  f |= 8;
+#endif
+  return f;
+}
+
 // GF(2^8) region multiply-accumulate: out (^)= c * in over n bytes.
 void ec_gf8_mul_region(uint8_t c, const uint8_t* in, uint8_t* out, size_t n,
                        int accum) {

@@ -27,6 +27,22 @@ def _load() -> ctypes.CDLL:
             capture_output=True,
         )
     lib = ctypes.CDLL(_SO)
+    if not hasattr(lib, "ec_arch_probe"):
+        # stale build from before the arch probe existed: rebuild
+        subprocess.run(["make", "-C", _DIR, "-B", "libec_kernels.so"],
+                       check=True, capture_output=True)
+        lib = ctypes.CDLL(_SO)
+    lib.ec_arch_probe.restype = ctypes.c_int
+    lib.ec_arch_built.restype = ctypes.c_int
+    # runtime feature gate (reference ceph_arch_probe): refuse a library
+    # whose compile-time ISA the running CPU lacks -- e.g. an AVX2 build
+    # copied to a pre-Haswell machine -- instead of SIGILL'ing later
+    built, have = lib.ec_arch_built(), lib.ec_arch_probe()
+    if built & ~have:
+        raise OSError(
+            f"native EC library needs CPU features 0x{built:x}, "
+            f"CPU has 0x{have:x} (rebuild with 'make -C {_DIR}')"
+        )
     lib.ec_gf8_mul_region.argtypes = [
         ctypes.c_uint8,
         ctypes.c_void_p,
@@ -66,6 +82,16 @@ def _load() -> ctypes.CDLL:
 
 
 _lib = _load()
+
+
+def cpu_features() -> dict:
+    """Decoded runtime/build ISA flags (the src/arch introspection)."""
+    have, built = _lib.ec_arch_probe(), _lib.ec_arch_built()
+    names = {1: "sse4.2", 2: "avx", 4: "avx2", 8: "avx512f"}
+    return {
+        "cpu": [n for b, n in names.items() if have & b],
+        "build": [n for b, n in names.items() if built & b],
+    }
 
 
 def _ptr_array(arrays) -> "ctypes.Array":

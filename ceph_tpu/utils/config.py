@@ -57,6 +57,13 @@ OPTIONS: Dict[str, Option] = {
              "max concurrent object recoveries per OSD"),
         _opt("osd_tick_interval", float, 5.0, LEVEL_ADVANCED,
              "seconds between OSD background ticks (peering/scrub)"),
+        _opt("lockdep", bool, False, LEVEL_DEV,
+             "track lock acquisition order and raise on cycles "
+             "(reference src/common/lockdep.h; asyncio-lock analogue)"),
+        _opt("mgr_modules", str, "status prometheus", LEVEL_BASIC,
+             "mgr modules loaded at start: bare names resolve under "
+             "ceph_tpu.mgr.mgr_modules, dotted paths import third-party "
+             "modules (reference: mgr_initial_modules)"),
         _opt("osd_client_op_commit_timeout", float, 30.0, LEVEL_ADVANCED,
              "seconds a primary waits for sub-write commit acks before "
              "failing the op (fault-injection tests shrink this to "

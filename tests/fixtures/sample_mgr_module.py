@@ -1,0 +1,34 @@
+"""Third-party mgr module fixture: loadable by dotted name from config
+(the PyModuleRegistry third-party loading test)."""
+
+from ceph_tpu.mgr.module_host import MgrModule
+
+
+class Module(MgrModule):
+    NAME = "sample"
+
+    def __init__(self, host):
+        super().__init__(host)
+        self.notifies = []
+
+    def notify(self, what, ident):
+        self.notifies.append((what, ident))
+        if what == "osd_map":
+            n_down = sum(
+                1 for s in self.get("osd_stats").values() if not s["up"]
+            )
+            if n_down:
+                self.set_health_checks({
+                    "SAMPLE_SAW_DOWN": {
+                        "severity": "HEALTH_WARN",
+                        "summary": f"sample module saw {n_down} down",
+                    }
+                })
+            else:
+                self.set_health_checks({})
+
+    def handle_command(self, cmd):
+        verb = cmd.get("prefix", "").split(" ", 1)[-1]
+        if verb == "ping":
+            return 0, "pong\n", ""
+        return -22, "", "unknown"

@@ -5,9 +5,16 @@ prometheus)."""
 import asyncio
 import json
 
+import pytest
+
 from ceph_tpu.mgr import ClusterState, MgrDaemon, health_checks, \
     prometheus_text
 from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.utils.perf import PerfCounters
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
 
 
 def _mk():
@@ -97,3 +104,96 @@ def test_mgr_http_endpoints():
         await c.shutdown()
 
     asyncio.run(run())
+
+
+# -- module host (PyModuleRegistry / ActivePyModules role) ------------------
+
+
+def test_module_host_builtin_modules():
+    from ceph_tpu.mgr import PyModuleRegistry
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"k": "2", "m": "1", "plugin": "jerasure"})
+        await c.write("obj", b"x" * 4000)
+        reg = PyModuleRegistry(c)  # names from mgr_modules config
+        assert set(reg.modules) == {"status", "prometheus"}
+        rc, out, _ = reg.handle_command({"prefix": "status status"})
+        assert rc == 0 and "health:" in out and "osd:" in out
+        rc, out, _ = reg.handle_command({"prefix": "prometheus metrics"})
+        assert rc == 0 and "ceph_osd_up" in out
+        rc, _, err = reg.handle_command({"prefix": "nosuch verb"})
+        assert rc != 0 and "no mgr module" in err
+        await c.shutdown()
+
+    run(main())
+
+
+def test_module_host_third_party_by_name():
+    """A third-party module loads by dotted path from the mgr_modules
+    config (VERDICT r3 item 9 done-criterion), receives notify events,
+    and its raised health checks merge into cluster health."""
+    from ceph_tpu.mgr import PyModuleRegistry
+    from ceph_tpu.utils.config import get_config
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"k": "2", "m": "1", "plugin": "jerasure"})
+        get_config().set_val(
+            "mgr_modules",
+            "status prometheus tests.fixtures.sample_mgr_module",
+        )
+        try:
+            reg = PyModuleRegistry(c)
+        finally:
+            get_config().set_val("mgr_modules", "status prometheus")
+        assert "sample" in reg.modules
+        rc, out, _ = reg.handle_command({"prefix": "sample ping"})
+        assert (rc, out) == (0, "pong\n")
+        c.kill_osd(0)
+        reg.notify_all("osd_map")
+        assert reg.modules["sample"].notifies
+        health = reg.gather_health()
+        assert "SAMPLE_SAW_DOWN" in health["checks"]
+        c.revive_osd(0)
+        reg.notify_all("osd_map")
+        assert "SAMPLE_SAW_DOWN" not in reg.gather_health()["checks"]
+        await c.shutdown()
+
+    run(main())
+
+
+def test_module_host_rejects_broken_module():
+    from ceph_tpu.mgr import PyModuleRegistry
+
+    async def main():
+        c = ECCluster(4, {"k": "2", "m": "1", "plugin": "jerasure"})
+        with pytest.raises(ImportError):
+            PyModuleRegistry(c, modules=["no.such.module"])
+        with pytest.raises(TypeError):
+            # a real importable module without a Module(MgrModule) class
+            PyModuleRegistry(c, modules=["ceph_tpu.mgr.mgr"])
+        await c.shutdown()
+
+    run(main())
+
+
+def test_mgr_daemon_metrics_via_module():
+    """/metrics is served BY the prometheus module through the host."""
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"k": "2", "m": "1", "plugin": "jerasure"})
+        await c.write("o", b"y" * 2000)
+        mgr = MgrDaemon(c)
+        port = await mgr.start()
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        writer.write(b"GET /metrics HTTP/1.1\r\n\r\n")
+        await writer.drain()
+        data = await reader.read()
+        writer.close()
+        assert b"ceph_osd_up" in data
+        await mgr.stop()
+        await c.shutdown()
+
+    run(main())

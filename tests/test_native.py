@@ -73,3 +73,16 @@ def test_plugin_native_backend_bit_exact(technique):
     out = nat.decode({1, 4}, have)
     for e in (1, 4):
         assert np.array_equal(out[e], e1[e])
+
+
+def test_arch_probe():
+    """Runtime CPU feature probe (reference src/arch/probe.cc): the
+    build's required ISA must be a subset of what the CPU reports, and
+    the decoded flags are exposed for introspection."""
+    from ceph_tpu.native import gf_native
+
+    feats = gf_native.cpu_features()
+    assert set(feats["build"]) <= set(feats["cpu"])
+    have = gf_native._lib.ec_arch_probe()
+    built = gf_native._lib.ec_arch_built()
+    assert built & ~have == 0

@@ -216,3 +216,35 @@ def test_process_cluster_persistent_store_survives_restart(tmp_path):
         asyncio.run(phase2())
     finally:
         vstart.stop_cluster(run_dir)
+
+
+def test_admin_socket_perf_config_ops(cluster):
+    """Daemon introspection over the admin socket (the `ceph daemon
+    <asok> ...` surface; reference src/common/admin_socket.cc)."""
+    import asyncio
+    import time as _t
+
+    from ceph_tpu.utils.admin_socket import admin_command
+
+    path = os.path.join(cluster, "data", "osd.0.asok")
+    deadline = _t.time() + 10
+    while not os.path.exists(path):
+        if _t.time() > deadline:
+            raise AssertionError("admin socket never appeared")
+        _t.sleep(0.05)
+
+    async def run():
+        helps = await admin_command(path, "help")
+        assert "perf dump" in helps and "config show" in helps
+        perf = await admin_command(path, "perf dump")
+        assert isinstance(perf, dict)
+        cfg = await admin_command(path, "config show")
+        assert "osd_tick_interval" in cfg
+        st = await admin_command(path, "status")
+        assert st["name"] == "osd.0"
+        ops = await admin_command(path, "ops")
+        assert "num_ops" in ops
+        bad = await admin_command(path, "no such thing")
+        assert "error" in bad
+
+    asyncio.new_event_loop().run_until_complete(run())

@@ -1,0 +1,34 @@
+"""ceph daemon <asok> <command...>: admin-socket client CLI.
+
+Reference: the `ceph daemon` path of src/ceph.in, talking to
+src/common/admin_socket.cc.  Examples:
+
+    python tools/ceph_daemon.py /path/osd.0.asok perf dump
+    python tools/ceph_daemon.py /path/osd.0.asok config show
+    python tools/ceph_daemon.py /path/osd.0.asok help
+"""
+
+import asyncio
+import json
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.utils.admin_socket import admin_command  # noqa: E402
+
+
+def main(argv=None) -> int:
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) < 2:
+        print(__doc__, file=sys.stderr)
+        return 1
+    path, prefix = argv[0], " ".join(argv[1:])
+    out = asyncio.new_event_loop().run_until_complete(
+        admin_command(path, prefix)
+    )
+    print(json.dumps(out, indent=2, default=str))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
