@@ -1,0 +1,28 @@
+"""CephFS: metadata server + POSIX-style client over RADOS.
+
+Reference: src/mds (MDCache / MDLog / LogEvent journaling, 76.9k LoC) +
+src/client (libcephfs, 24.1k LoC), reduced to the architecture:
+
+* **Namespace in RADOS** -- each directory is one RADOS object whose
+  omap maps entry name -> encoded dentry with the inode EMBEDDED
+  (CephFS's primary-dentry inode embedding); the inode-number table is
+  an omap counter allocated through the CAS primitive
+  (src/mds/InoTable.h).
+* **Journaled mutations** (MDLog/LogEvent): every metadata mutation is
+  appended to the MDS journal object BEFORE it is applied to the
+  directory objects; a restarted or standby MDS replays the journal
+  tail (idempotent events) and trims it -- the up:replay ->
+  up:active takeover flow (src/mds/MDLog.cc).
+* **File data striped over objects** via the shared Striper
+  (src/osdc/Striper.cc, file_layout_t): data object "<ino>.<objno>",
+  I/O through the same EC/replicated pool machinery as everything else.
+
+``MDS`` is the rank-0 daemon; ``CephFS`` is the libcephfs-role client
+(metadata calls to the MDS, data I/O straight to RADOS -- the
+reference's split between MDS requests and OSD file I/O).
+"""
+
+from ceph_tpu.mds.mds import MDS
+from ceph_tpu.mds.cephfs import CephFS
+
+__all__ = ["MDS", "CephFS"]

@@ -1,0 +1,142 @@
+"""CephFS client (the libcephfs / src/client role).
+
+Metadata operations go to the MDS; file DATA goes straight to RADOS,
+striped over ``<ino>.<objno>`` objects by the shared Striper with the
+file's ``file_layout_t`` -- exactly the reference's split (the client
+never proxies data through the MDS).  File sizes flush back to the MDS
+as a journaled setattr (the size-cap writeback role).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+from ceph_tpu.mds.mds import MDS, FSError, data_oid
+from ceph_tpu.osdc.striper import FileLayout, Striper
+
+
+class CephFS:
+    def __init__(self, backend, mds: MDS = None):
+        self.backend = backend
+        self.mds = mds if mds is not None else MDS(backend)
+
+    @classmethod
+    async def mount(cls, backend) -> "CephFS":
+        fs = cls(backend)
+        await fs.mds.start()
+        return fs
+
+    # -- namespace ---------------------------------------------------------
+
+    async def mkdir(self, path: str) -> None:
+        await self.mds.mkdir(path)
+
+    async def mkdirs(self, path: str) -> None:
+        parts = [p for p in path.split("/") if p]
+        cur = ""
+        for p in parts:
+            cur += "/" + p
+            try:
+                await self.mds.mkdir(cur)
+            except FSError as e:
+                if e.errno != 17:
+                    raise
+    async def readdir(self, path: str) -> List[str]:
+        return sorted(await self.mds.readdir(path))
+
+    async def stat(self, path: str) -> dict:
+        return await self.mds.stat(path)
+
+    async def rename(self, src: str, dst: str) -> None:
+        await self.mds.rename(src, dst)
+
+    async def rmdir(self, path: str) -> None:
+        await self.mds.rmdir(path)
+
+    async def unlink(self, path: str) -> None:
+        """Remove the file and purge its data objects (the purge-queue
+        role, client-side)."""
+        dentry = await self.mds.unlink(path)
+        layout = FileLayout(*self._layout_tuple(dentry))
+        striper = Striper(layout)
+        for objno in range(striper.object_count(dentry["size"])):
+            try:
+                await self.backend.remove_object(
+                    data_oid(dentry["ino"], objno)
+                )
+            except IOError:
+                pass  # sparse file: object never written
+
+    # -- file I/O (straight to RADOS, MDS only for size) -------------------
+
+    @staticmethod
+    def _layout_tuple(dentry) -> tuple:
+        su, sc, osz = dentry["layout"]
+        return osz, su, sc  # FileLayout(object_size, stripe_unit, count)
+
+    async def write_file(self, path: str, data: bytes,
+                         offset: int = 0) -> None:
+        dentry = await self.mds.create(path)
+        striper = Striper(FileLayout(*self._layout_tuple(dentry)))
+        # extents come out in logical order (Striper::file_to_extents)
+        pos = 0
+        for objno, obj_off, length in striper.map_extent(offset, len(data)):
+            piece = data[pos:pos + length]
+            pos += length
+            await self.backend.write_range(
+                data_oid(dentry["ino"], objno), obj_off, piece
+            )
+        new_size = max(dentry["size"], offset + len(data))
+        if new_size != dentry["size"]:
+            await self.mds.set_size(path, new_size)
+
+    async def read_file(self, path: str, offset: int = 0,
+                        length: int = -1) -> bytes:
+        dentry = await self.mds.stat(path)
+        if dentry["type"] != "f":
+            raise FSError(21, f"is a directory: {path!r}")
+        size = dentry["size"]
+        if length < 0:
+            length = max(0, size - offset)
+        end = min(offset + length, size)
+        if end <= offset:
+            return b""
+        striper = Striper(FileLayout(*self._layout_tuple(dentry)))
+        out = bytearray(end - offset)
+        pos = 0
+        for objno, obj_off, ln in striper.map_extent(offset, end - offset):
+            try:
+                piece = await self.backend.read_range(
+                    data_oid(dentry["ino"], objno), obj_off, ln
+                )
+            except IOError:
+                piece = b""  # sparse hole: zeros
+            out[pos:pos + len(piece)] = piece
+            pos += ln
+        return bytes(out)
+
+    async def truncate(self, path: str, size: int) -> None:
+        dentry = await self.mds.stat(path)
+        old = dentry["size"]
+        await self.mds.set_size(path, size)
+        if size < old:
+            striper = Striper(FileLayout(*self._layout_tuple(dentry)))
+            first_dead = striper.object_count(size)
+            for objno in range(first_dead, striper.object_count(old)):
+                try:
+                    await self.backend.remove_object(
+                        data_oid(dentry["ino"], objno)
+                    )
+                except IOError:
+                    pass
+            # POSIX: bytes exposed by a later re-grow must read as zeros,
+            # so the surviving boundary object's stale tail is zeroed
+            for objno, obj_off, ln in striper.map_extent(size, old - size):
+                if objno >= first_dead:
+                    continue  # removed above
+                try:
+                    await self.backend.write_range(
+                        data_oid(dentry["ino"], objno), obj_off, bytes(ln)
+                    )
+                except IOError:
+                    pass  # sparse: nothing stored there anyway

@@ -1,0 +1,303 @@
+"""MDS rank 0: the metadata server (reference src/mds).
+
+State layout in the metadata pool (all through an Objecter, so the
+namespace inherits EC durability, recovery and scrub):
+
+* ``mds0_inotable``       omap {"next": int}        InoTable role
+* ``mds0_journal``        omap {seq16: event}       MDLog/LogEvent role
+*                         omap {"_committed": seq}  expire pointer
+* ``<ino-hex>.dir``       omap {name: dentry}       CDir role
+
+A dentry embeds its inode (CephFS primary-dentry embedding):
+``{"ino", "type": "f"|"d", "size", "mtime", "layout": [su, sc, osz]}``.
+
+Every mutation is journaled before application and applied with
+idempotent operations, so replay after a crash (or by a standby taking
+over) converges -- the up:replay state.  A single MDS serializes
+mutations behind one asyncio lock (the reference serializes through the
+MDCache locker at rank granularity).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+ROOT_INO = 1
+INOTABLE = "mds0_inotable"
+JOURNAL = "mds0_journal"
+COMMITTED_KEY = "_committed"
+DEFAULT_LAYOUT = (1 << 20, 1, 1 << 20)  # (stripe_unit, count, object_size)
+
+
+def dir_oid(ino: int) -> str:
+    return f"{ino:x}.dir"
+
+
+def data_oid(ino: int, objno: int) -> str:
+    return f"{ino:x}.{objno:08x}"
+
+
+def _enc(v) -> bytes:
+    return Encoder().value(v).bytes()
+
+
+def _dec(b: bytes):
+    return Decoder(b).value()
+
+
+class FSError(OSError):
+    pass
+
+
+class MDS:
+    """Rank-0 metadata server over a RADOS backend (an Objecter)."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self._mutate_lock = asyncio.Lock()
+        self._journal_seq = 0
+        self.replayed = 0  # events replayed at the last start()
+
+    # -- boot / journal replay (up:replay -> up:active) --------------------
+
+    async def start(self) -> None:
+        """Create the root on a fresh filesystem; replay the journal
+        tail left by a crashed predecessor; trim it."""
+        omap = await self.backend.omap_get(JOURNAL)
+        committed = int(
+            _dec(omap[COMMITTED_KEY]) if COMMITTED_KEY in omap else 0
+        )
+        events = sorted(
+            (int(k), _dec(v)) for k, v in omap.items()
+            if k != COMMITTED_KEY
+        )
+        self.replayed = 0
+        # new seqs must stay above the committed pointer even when the
+        # journal is empty, else a fresh MDS reuses low seqs and its own
+        # crash-recovery filter would skip them (review finding)
+        self._journal_seq = max(self._journal_seq, committed)
+        for seq, ev in events:
+            self._journal_seq = max(self._journal_seq, seq)
+            if seq > committed:
+                await self._apply(ev)
+                self.replayed += 1
+        if events:
+            await self._trim(max(s for s, _ in events))
+        root = await self.backend.omap_get(dir_oid(ROOT_INO))
+        if "." not in root:
+            await self.backend.omap_set(dir_oid(ROOT_INO), {
+                ".": _enc(self._mkdentry(ROOT_INO, "d")),
+            })
+
+    # -- inode allocation (InoTable) ---------------------------------------
+
+    async def _alloc_ino(self) -> int:
+        while True:
+            cur = await self.backend.omap_get(INOTABLE, ["next"])
+            have = int(_dec(cur["next"])) if "next" in cur else ROOT_INO + 1
+            ok, _ = await self.backend.omap_cas(
+                INOTABLE, "next",
+                cur.get("next"), _enc(have + 1),
+            )
+            if ok:
+                return have
+
+    # -- journal -----------------------------------------------------------
+
+    async def _journal_and_apply(self, ev: dict) -> None:
+        """MDLog contract: the event is durable in the journal BEFORE the
+        directory objects change; apply is idempotent for replay."""
+        self._journal_seq += 1
+        seq = self._journal_seq
+        await self.backend.omap_set(JOURNAL, {f"{seq:016d}": _enc(ev)})
+        await self._apply(ev)
+        await self._trim(seq, keys=[f"{seq:016d}"])
+
+    async def _trim(self, upto: int, keys=None) -> None:
+        """Advance the committed pointer and drop applied events (MDLog
+        trim/expire).  The hot path passes the exact keys it just
+        journaled; replay passes None and pays one full scan."""
+        if keys is None:
+            omap = await self.backend.omap_get(JOURNAL)
+            keys = [k for k in omap
+                    if k != COMMITTED_KEY and int(k) <= upto]
+        await self.backend.omap_set(JOURNAL, {COMMITTED_KEY: _enc(upto)})
+        if keys:
+            await self.backend.omap_rm(JOURNAL, keys)
+
+    async def _apply(self, ev: dict) -> None:
+        op = ev["op"]
+        if op == "link":  # create dentry (mkdir/create/rename-target)
+            await self.backend.omap_set(
+                dir_oid(ev["dir"]), {ev["name"]: _enc(ev["dentry"])}
+            )
+            if ev["dentry"]["type"] == "d":
+                await self.backend.omap_set(dir_oid(ev["dentry"]["ino"]), {
+                    ".": _enc(self._mkdentry(ev["dentry"]["ino"], "d")),
+                })
+        elif op == "unlink":
+            await self.backend.omap_rm(dir_oid(ev["dir"]), [ev["name"]])
+        elif op == "setattr":
+            cur = await self.backend.omap_get(dir_oid(ev["dir"]),
+                                              [ev["name"]])
+            if ev["name"] in cur:
+                d = _dec(cur[ev["name"]])
+                d.update(ev["attrs"])
+                await self.backend.omap_set(
+                    dir_oid(ev["dir"]), {ev["name"]: _enc(d)}
+                )
+        else:
+            raise ValueError(f"unknown journal op {op!r}")
+
+    # -- path resolution (MDCache::path_traverse) --------------------------
+
+    @staticmethod
+    def _split(path: str) -> List[str]:
+        return [p for p in path.split("/") if p and p != "."]
+
+    def _mkdentry(self, ino: int, typ: str, size: int = 0,
+                  layout=DEFAULT_LAYOUT) -> dict:
+        return {"ino": ino, "type": typ, "size": size,
+                "mtime": int(time.time()), "layout": list(layout)}
+
+    async def resolve(self, path: str) -> Tuple[int, Optional[dict]]:
+        """-> (parent dir ino, dentry|None for the final component);
+        the root resolves to (ROOT_INO, its self dentry)."""
+        parts = self._split(path)
+        if not parts:
+            root = await self.backend.omap_get(dir_oid(ROOT_INO), ["."])
+            return ROOT_INO, _dec(root["."])
+        cur = ROOT_INO
+        for i, name in enumerate(parts):
+            ent = await self.backend.omap_get(dir_oid(cur), [name])
+            if name not in ent:
+                if i == len(parts) - 1:
+                    return cur, None
+                raise FSError(2, f"no such directory: {name!r} in {path!r}")
+            dentry = _dec(ent[name])
+            if i == len(parts) - 1:
+                return cur, dentry
+            if dentry["type"] != "d":
+                raise FSError(20, f"not a directory: {name!r}")
+            cur = dentry["ino"]
+        raise AssertionError("unreachable")
+
+    async def _resolve_dir(self, path: str) -> int:
+        _, dentry = await self.resolve(path)
+        if dentry is None:
+            raise FSError(2, f"no such file or directory: {path!r}")
+        if dentry["type"] != "d":
+            raise FSError(20, f"not a directory: {path!r}")
+        return dentry["ino"]
+
+    # -- metadata ops (Server::handle_client_request dispatch) -------------
+
+    async def mkdir(self, path: str) -> int:
+        async with self._mutate_lock:
+            parent, existing = await self.resolve(path)
+            if existing is not None:
+                raise FSError(17, f"exists: {path!r}")
+            name = self._split(path)[-1]
+            ino = await self._alloc_ino()
+            dentry = self._mkdentry(ino, "d")
+            await self._journal_and_apply(
+                {"op": "link", "dir": parent, "name": name,
+                 "dentry": dentry}
+            )
+            return ino
+
+    async def create(self, path: str, layout=DEFAULT_LAYOUT) -> dict:
+        async with self._mutate_lock:
+            parent, existing = await self.resolve(path)
+            if existing is not None:
+                if existing["type"] == "d":
+                    raise FSError(21, f"is a directory: {path!r}")
+                return existing  # open-existing semantics
+            name = self._split(path)[-1]
+            if not name:
+                raise FSError(22, "empty file name")
+            ino = await self._alloc_ino()
+            dentry = self._mkdentry(ino, "f", layout=layout)
+            await self._journal_and_apply(
+                {"op": "link", "dir": parent, "name": name,
+                 "dentry": dentry}
+            )
+            return dentry
+
+    async def readdir(self, path: str) -> Dict[str, dict]:
+        ino = await self._resolve_dir(path)
+        omap = await self.backend.omap_get(dir_oid(ino))
+        return {
+            name: _dec(raw) for name, raw in omap.items() if name != "."
+        }
+
+    async def stat(self, path: str) -> dict:
+        _, dentry = await self.resolve(path)
+        if dentry is None:
+            raise FSError(2, f"no such file or directory: {path!r}")
+        return dentry
+
+    async def set_size(self, path: str, size: int) -> None:
+        async with self._mutate_lock:
+            parent, dentry = await self.resolve(path)
+            if dentry is None:
+                raise FSError(2, f"no such file: {path!r}")
+            name = self._split(path)[-1]
+            await self._journal_and_apply({
+                "op": "setattr", "dir": parent, "name": name,
+                "attrs": {"size": size, "mtime": int(time.time())},
+            })
+
+    async def unlink(self, path: str) -> dict:
+        """Remove a FILE dentry; returns it (caller purges data objects
+        -- the reference strays/purge queue role lives client-side
+        here)."""
+        async with self._mutate_lock:
+            parent, dentry = await self.resolve(path)
+            if dentry is None:
+                raise FSError(2, f"no such file: {path!r}")
+            if dentry["type"] == "d":
+                raise FSError(21, f"is a directory: {path!r}")
+            name = self._split(path)[-1]
+            await self._journal_and_apply(
+                {"op": "unlink", "dir": parent, "name": name}
+            )
+            return dentry
+
+    async def rmdir(self, path: str) -> None:
+        async with self._mutate_lock:
+            parent, dentry = await self.resolve(path)
+            if dentry is None or dentry["type"] != "d":
+                raise FSError(2, f"no such directory: {path!r}")
+            entries = await self.backend.omap_get(dir_oid(dentry["ino"]))
+            if set(entries) - {"."}:
+                raise FSError(39, f"directory not empty: {path!r}")
+            name = self._split(path)[-1]
+            await self._journal_and_apply(
+                {"op": "unlink", "dir": parent, "name": name}
+            )
+
+    async def rename(self, src: str, dst: str) -> None:
+        """Journaled as link(dst)+unlink(src): replay-idempotent and in
+        that order, so a crash between them leaves a hard-link-like
+        state, never a lost file (the reference journals both halves in
+        one EUpdate)."""
+        async with self._mutate_lock:
+            sparent, sdentry = await self.resolve(src)
+            if sdentry is None:
+                raise FSError(2, f"no such file or directory: {src!r}")
+            dparent, ddentry = await self.resolve(dst)
+            if ddentry is not None:
+                raise FSError(17, f"exists: {dst!r}")
+            await self._journal_and_apply({
+                "op": "link", "dir": dparent,
+                "name": self._split(dst)[-1], "dentry": sdentry,
+            })
+            await self._journal_and_apply({
+                "op": "unlink", "dir": sparent,
+                "name": self._split(src)[-1],
+            })

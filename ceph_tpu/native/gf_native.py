@@ -20,18 +20,20 @@ _SO = os.path.join(_DIR, "libec_kernels.so")
 
 
 def _load() -> ctypes.CDLL:
-    if not os.path.exists(_SO):
-        subprocess.run(
-            ["make", "-C", _DIR],
-            check=True,
-            capture_output=True,
-        )
+    # make's dependency tracking rebuilds a stale .so BEFORE we dlopen it
+    # (ctypes cannot reload a library at the same path within a process,
+    # so rebuilding after a failed symbol lookup would be too late)
+    subprocess.run(
+        ["make", "-C", _DIR, "libec_kernels.so"],
+        check=True,
+        capture_output=True,
+    )
     lib = ctypes.CDLL(_SO)
     if not hasattr(lib, "ec_arch_probe"):
-        # stale build from before the arch probe existed: rebuild
-        subprocess.run(["make", "-C", _DIR, "-B", "libec_kernels.so"],
-                       check=True, capture_output=True)
-        lib = ctypes.CDLL(_SO)
+        raise OSError(
+            "stale libec_kernels.so predates the arch probe and make "
+            f"considers it current; run: make -B -C {_DIR}"
+        )
     lib.ec_arch_probe.restype = ctypes.c_int
     lib.ec_arch_built.restype = ctypes.c_int
     # runtime feature gate (reference ceph_arch_probe): refuse a library

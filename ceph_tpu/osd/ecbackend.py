@@ -1215,10 +1215,15 @@ class ECBackend:
 
         span = trace.new_trace("ec write")
         span.event("start_rmw")
-        encoded = ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+        if padded_len:
+            encoded = ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+        else:
+            # zero-byte object (S3 markers, touch): no stripes to encode
+            encoded = [np.zeros(0, dtype=np.uint8) for _ in range(self.km)]
         span.event("encoded")
         hinfo = ecutil.HashInfo(self.km)
-        hinfo.append(0, encoded)
+        if padded_len:
+            hinfo.append(0, encoded)
 
         acting = self.acting_set(oid)
         up = [

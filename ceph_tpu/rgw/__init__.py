@@ -1,0 +1,21 @@
+"""RGW: S3-compatible object gateway over RADOS (reference: src/rgw).
+
+The reference radosgw terminates S3/Swift HTTP, authenticates requests
+(AWS signatures against users kept in RADOS), and maps the bucket/object
+model onto RADOS objects: bucket indexes are omap objects, object data
+lands in data-pool objects, user/bucket metadata lives in meta objects
+(rgw_main.cc, rgw_rados.cc, rgw_bucket.cc).  Same decomposition here:
+
+* ``RGWGateway``   -- asyncio HTTP frontend (the civetweb/beast role)
+  with AWS-v2-style HMAC request signing;
+* users            -- omap on ``rgw.users`` (access -> secret, display);
+* buckets          -- omap on ``rgw.buckets`` (the bucket.instance
+  metadata role) + one ``rgw.bucket.<name>`` index object per bucket
+  whose omap is the bucket index (key -> size/etag/mtime);
+* object data      -- one RADOS object ``rgw.obj.<bucket>/<key>`` on
+  the (EC) data pool.
+"""
+
+from ceph_tpu.rgw.gateway import RGWGateway, sign_v2
+
+__all__ = ["RGWGateway", "sign_v2"]

@@ -1,0 +1,310 @@
+"""S3 gateway frontend + RADOS mapping (reference src/rgw/rgw_main.cc,
+rgw_rest_s3.cc, rgw_rados.cc).
+
+Supported S3 surface: service list (GET /), bucket create/delete/list
+(PUT/DELETE/GET /<bucket>), object put/get/head/delete
+(PUT/GET/HEAD/DELETE /<bucket>/<key>), prefix-filtered listing, ETags
+(md5, as S3 defines for single-part uploads), AWS-v2 HMAC auth
+(Authorization: AWS <access>:<sig> over the canonical string), and the
+matching S3 XML error envelopes (NoSuchBucket, NoSuchKey,
+SignatureDoesNotMatch, BucketAlreadyExists, BucketNotEmpty,
+AccessDenied).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import base64
+import hashlib
+import hmac
+import time
+from typing import Dict, Optional, Tuple
+from xml.sax.saxutils import escape
+
+USERS_OID = "rgw.users"
+BUCKETS_OID = "rgw.buckets"
+
+
+def bucket_index_oid(bucket: str) -> str:
+    return f"rgw.bucket.{bucket}"
+
+
+def obj_oid(bucket: str, key: str) -> str:
+    return f"rgw.obj.{bucket}/{key}"
+
+
+def sign_v2(secret: str, method: str, resource: str, date: str,
+            content_type: str = "", content_md5: str = "") -> str:
+    """AWS signature v2 (the rgw_auth_s3.cc canonical string)."""
+    to_sign = "\n".join([method, content_md5, content_type, date, resource])
+    mac = hmac.new(secret.encode(), to_sign.encode(), hashlib.sha1)
+    return base64.b64encode(mac.digest()).decode()
+
+
+def _xml_error(code: str, message: str) -> str:
+    return (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        f"<Error><Code>{code}</Code>"
+        f"<Message>{escape(message)}</Message></Error>"
+    )
+
+
+_ERROR_STATUS = {
+    "NoSuchBucket": "404 Not Found",
+    "NoSuchKey": "404 Not Found",
+    "BucketAlreadyExists": "409 Conflict",
+    "BucketNotEmpty": "409 Conflict",
+    "SignatureDoesNotMatch": "403 Forbidden",
+    "AccessDenied": "403 Forbidden",
+    "InvalidRequest": "400 Bad Request",
+}
+
+
+class S3Error(Exception):
+    def __init__(self, code: str, message: str = ""):
+        super().__init__(message or code)
+        self.code = code
+
+
+class RGWGateway:
+    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0):
+        self.backend = backend  # an Objecter (data + metadata pool)
+        self.host = host
+        self.port = port
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    # -- user admin (radosgw-admin user create role) -----------------------
+
+    async def create_user(self, access: str, secret: str,
+                          display: str = "") -> None:
+        await self.backend.omap_set(USERS_OID, {
+            access: f"{secret}\x00{display}".encode(),
+        })
+
+    async def _secret_for(self, access: str) -> Optional[str]:
+        got = await self.backend.omap_get(USERS_OID, [access])
+        if access not in got:
+            return None
+        return got[access].decode().split("\x00", 1)[0]
+
+    # -- HTTP server -------------------------------------------------------
+
+    async def start(self) -> int:
+        self._server = await asyncio.start_server(
+            self._serve, self.host, self.port
+        )
+        self.port = self._server.sockets[0].getsockname()[1]
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            await self._server.wait_closed()
+
+    async def _serve(self, reader: asyncio.StreamReader,
+                     writer: asyncio.StreamWriter) -> None:
+        try:
+            req = await reader.readline()
+            parts = req.split()
+            if len(parts) < 2:
+                return
+            method, target = parts[0].decode(), parts[1].decode()
+            headers: Dict[str, str] = {}
+            while True:
+                line = await reader.readline()
+                if line in (b"\r\n", b"\n", b""):
+                    break
+                k, _, v = line.decode().partition(":")
+                headers[k.strip().lower()] = v.strip()
+            body = b""
+            n = int(headers.get("content-length", "0") or "0")
+            if n:
+                body = await reader.readexactly(n)
+            try:
+                status, ctype, out, extra = await self._handle(
+                    method, target, headers, body
+                )
+            except S3Error as e:
+                status = _ERROR_STATUS.get(e.code, "400 Bad Request")
+                ctype = "application/xml"
+                out = _xml_error(e.code, str(e)).encode()
+                extra = {}
+            except Exception as e:  # noqa: BLE001 -- internal error
+                status, ctype = "500 Internal Server Error", "application/xml"
+                out = _xml_error("InternalError", str(e)).encode()
+                extra = {}
+            hdr = (
+                f"HTTP/1.1 {status}\r\nContent-Type: {ctype}\r\n"
+                f"Content-Length: {len(out)}\r\nConnection: close\r\n"
+            )
+            for k, v in extra.items():
+                hdr += f"{k}: {v}\r\n"
+            writer.write(hdr.encode() + b"\r\n" + out)
+            await writer.drain()
+        finally:
+            writer.close()
+
+    # -- request routing (RGWHandler_REST_S3 dispatch) ---------------------
+
+    async def _auth(self, method: str, resource: str,
+                    headers: Dict[str, str]) -> str:
+        auth = headers.get("authorization", "")
+        if not auth.startswith("AWS "):
+            raise S3Error("AccessDenied", "missing AWS authorization")
+        try:
+            access, sig = auth[4:].split(":", 1)
+        except ValueError:
+            raise S3Error("InvalidRequest", "malformed authorization")
+        secret = await self._secret_for(access)
+        if secret is None:
+            raise S3Error("AccessDenied", f"no such access key {access!r}")
+        want = sign_v2(
+            secret, method, resource, headers.get("date", ""),
+            headers.get("content-type", ""), headers.get("content-md5", ""),
+        )
+        if not hmac.compare_digest(want, sig):
+            raise S3Error("SignatureDoesNotMatch", "bad signature")
+        return access
+
+    @staticmethod
+    def _split_target(target: str) -> Tuple[str, str, Dict[str, str]]:
+        path, _, query = target.partition("?")
+        params = {}
+        for kv in query.split("&"):
+            if kv:
+                k, _, v = kv.partition("=")
+                params[k] = v
+        path = path.lstrip("/")
+        bucket, _, key = path.partition("/")
+        return bucket, key, params
+
+    async def _handle(self, method, target, headers, body):
+        bucket, key, params = self._split_target(target)
+        resource = "/" + bucket + ("/" + key if key else "")
+        owner = await self._auth(method, resource, headers)
+        if not bucket:
+            if method == "GET":
+                return await self._list_buckets(owner)
+            raise S3Error("InvalidRequest", f"{method} on service root")
+        if not key:
+            if method == "PUT":
+                return await self._create_bucket(bucket, owner)
+            if method == "DELETE":
+                return await self._delete_bucket(bucket)
+            if method == "GET":
+                return await self._list_objects(
+                    bucket, params.get("prefix", "")
+                )
+            raise S3Error("InvalidRequest", f"{method} on bucket")
+        if method == "PUT":
+            return await self._put_object(bucket, key, body)
+        if method == "GET":
+            return await self._get_object(bucket, key)
+        if method == "HEAD":
+            return await self._head_object(bucket, key)
+        if method == "DELETE":
+            return await self._delete_object(bucket, key)
+        raise S3Error("InvalidRequest", f"{method} on object")
+
+    # -- bucket ops (rgw_bucket.cc) ----------------------------------------
+
+    async def _bucket_exists(self, bucket: str) -> bool:
+        got = await self.backend.omap_get(BUCKETS_OID, [bucket])
+        return bucket in got
+
+    async def _list_buckets(self, owner: str):
+        buckets = await self.backend.omap_get(BUCKETS_OID)
+        items = "".join(
+            f"<Bucket><Name>{escape(n)}</Name></Bucket>"
+            for n in sorted(buckets)
+        )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<ListAllMyBucketsResult>"
+            f"<Owner><ID>{escape(owner)}</ID></Owner>"
+            f"<Buckets>{items}</Buckets></ListAllMyBucketsResult>"
+        )
+        return "200 OK", "application/xml", xml.encode(), {}
+
+    async def _create_bucket(self, bucket: str, owner: str):
+        if await self._bucket_exists(bucket):
+            raise S3Error("BucketAlreadyExists", bucket)
+        await self.backend.omap_set(BUCKETS_OID, {
+            bucket: f"{owner}\x00{int(time.time())}".encode(),
+        })
+        return "200 OK", "application/xml", b"", {}
+
+    async def _delete_bucket(self, bucket: str):
+        if not await self._bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+        index = await self.backend.omap_get(bucket_index_oid(bucket))
+        if index:
+            raise S3Error("BucketNotEmpty", bucket)
+        await self.backend.omap_rm(BUCKETS_OID, [bucket])
+        return "204 No Content", "application/xml", b"", {}
+
+    async def _list_objects(self, bucket: str, prefix: str):
+        if not await self._bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+        index = await self.backend.omap_get(bucket_index_oid(bucket))
+        items = []
+        for k in sorted(index):
+            if not k.startswith(prefix):
+                continue
+            size, etag, mtime = index[k].decode().split("\x00")
+            items.append(
+                f"<Contents><Key>{escape(k)}</Key><Size>{size}</Size>"
+                f'<ETag>"{etag}"</ETag></Contents>'
+            )
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            f"<ListBucketResult><Name>{escape(bucket)}</Name>"
+            f"<Prefix>{escape(prefix)}</Prefix>"
+            f"{''.join(items)}</ListBucketResult>"
+        )
+        return "200 OK", "application/xml", xml.encode(), {}
+
+    # -- object ops (rgw_rados.cc put/get paths) ---------------------------
+
+    async def _put_object(self, bucket: str, key: str, body: bytes):
+        if not await self._bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+        etag = hashlib.md5(body).hexdigest()
+        # data first, then the index entry (the reference's bucket-index
+        # prepare/complete keeps the index authoritative)
+        await self.backend.write(obj_oid(bucket, key), body)
+        await self.backend.omap_set(bucket_index_oid(bucket), {
+            key: f"{len(body)}\x00{etag}\x00{int(time.time())}".encode(),
+        })
+        return "200 OK", "application/xml", b"", {"ETag": f'"{etag}"'}
+
+    async def _index_entry(self, bucket: str, key: str):
+        if not await self._bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+        got = await self.backend.omap_get(bucket_index_oid(bucket), [key])
+        if key not in got:
+            raise S3Error("NoSuchKey", key)
+        size, etag, mtime = got[key].decode().split("\x00")
+        return int(size), etag
+
+    async def _get_object(self, bucket: str, key: str):
+        size, etag = await self._index_entry(bucket, key)
+        data = await self.backend.read(obj_oid(bucket, key))
+        return "200 OK", "application/octet-stream", data, {
+            "ETag": f'"{etag}"',
+        }
+
+    async def _head_object(self, bucket: str, key: str):
+        size, etag = await self._index_entry(bucket, key)
+        return "200 OK", "application/octet-stream", b"", {
+            "ETag": f'"{etag}"', "X-Object-Size": str(size),
+        }
+
+    async def _delete_object(self, bucket: str, key: str):
+        await self._index_entry(bucket, key)  # NoSuchKey check
+        await self.backend.omap_rm(bucket_index_oid(bucket), [key])
+        try:
+            await self.backend.remove_object(obj_oid(bucket, key))
+        except IOError:
+            pass  # zero-byte object: nothing was written
+        return "204 No Content", "application/xml", b"", {}

@@ -5,6 +5,8 @@ src/common/admin_socket.cc.  Examples:
 
     python tools/ceph_daemon.py /path/osd.0.asok perf dump
     python tools/ceph_daemon.py /path/osd.0.asok config show
+    python tools/ceph_daemon.py /path/osd.0.asok config set \
+        key=osd_tick_interval value=1
     python tools/ceph_daemon.py /path/osd.0.asok help
 """
 
@@ -22,9 +24,12 @@ def main(argv=None) -> int:
     if len(argv) < 2:
         print(__doc__, file=sys.stderr)
         return 1
-    path, prefix = argv[0], " ".join(argv[1:])
+    path = argv[0]
+    words = [a for a in argv[1:] if "=" not in a]
+    fields = dict(a.split("=", 1) for a in argv[1:] if "=" in a)
+    prefix = " ".join(words)
     out = asyncio.new_event_loop().run_until_complete(
-        admin_command(path, prefix)
+        admin_command(path, prefix, **fields)
     )
     print(json.dumps(out, indent=2, default=str))
     return 0
