@@ -40,7 +40,7 @@ async def create(ctx, inp: bytes):
 
 @register("rbd", "get_metadata")
 async def get_metadata(ctx, inp: bytes):
-    omap = await ctx.omap_get(["size", "order", "snaps"])
+    omap = await ctx.omap_get(["size", "order", "snaps", "parent"])
     if "size" not in omap:
         return -2, b""
     snaps = _dec(omap.get("snaps")) or {"seq": 0, "by_name": {}}
@@ -49,6 +49,7 @@ async def get_metadata(ctx, inp: bytes):
         "order": _dec(omap["order"]),
         "snap_seq": snaps["seq"],
         "snaps": snaps["by_name"],
+        "parent": _dec(omap.get("parent")),
     })
 
 
@@ -120,3 +121,117 @@ async def metadata_get(ctx, inp: bytes):
     if v is None:
         return -2, b""
     return 0, v
+
+
+# -- snapshot protection + layering parent/child registry -------------------
+# (reference cls_rbd: snapshot_protect/unprotect, set_parent/remove_parent,
+# add_child/remove_child/get_children -- the metadata half of librbd
+# clone layering; the COW read/copy-up data path lives in ceph_tpu.rbd)
+
+
+@register("rbd", "snap_protect")
+async def snap_protect(ctx, inp: bytes):
+    req = _dec(inp)
+    for _ in range(16):
+        cur_raw = (await ctx.omap_get(["snaps"])).get("snaps")
+        snaps = _dec(cur_raw) or {"seq": 0, "by_name": {}}
+        ent = snaps["by_name"].get(req["name"])
+        if ent is None:
+            return -2, b""
+        by_name = dict(snaps["by_name"])
+        by_name[req["name"]] = dict(ent, protected=True)
+        ok, _ = await ctx.omap_cas(
+            "snaps", cur_raw, _enc({"seq": snaps["seq"], "by_name": by_name})
+        )
+        if ok:
+            return 0, b""
+    return -11, b""
+
+
+@register("rbd", "snap_unprotect")
+async def snap_unprotect(ctx, inp: bytes):
+    req = _dec(inp)
+    for _ in range(16):
+        cur_raw = (await ctx.omap_get(["snaps"])).get("snaps")
+        snaps = _dec(cur_raw) or {"seq": 0, "by_name": {}}
+        ent = snaps["by_name"].get(req["name"])
+        if ent is None:
+            return -2, b""
+        kids = _dec((await ctx.omap_get(
+            [f"children.{ent['id']}"])).get(f"children.{ent['id']}")) or []
+        if kids:
+            return -16, b""  # -EBUSY: clones still reference the snap
+        by_name = dict(snaps["by_name"])
+        by_name[req["name"]] = {k: v for k, v in ent.items()
+                                if k != "protected"}
+        # CAS: a concurrent clone re-registering a child bumps nothing in
+        # "snaps", but a concurrent snap_add must not be clobbered, and
+        # the add_child CAS below makes the child-list check repeatable
+        ok, _ = await ctx.omap_cas("snaps", cur_raw, _enc(
+            {"seq": snaps["seq"], "by_name": by_name}))
+        if ok:
+            return 0, b""
+    return -11, b""
+
+
+@register("rbd", "add_child")
+async def add_child(ctx, inp: bytes):
+    req = _dec(inp)
+    key = f"children.{req['snap_id']}"
+    for _ in range(16):
+        cur = (await ctx.omap_get([key])).get(key)
+        kids = _dec(cur) or []
+        if req["child"] not in kids:
+            kids.append(req["child"])
+        ok, _ = await ctx.omap_cas(key, cur, _enc(sorted(kids)))
+        if ok:
+            return 0, b""
+    return -11, b""
+
+
+@register("rbd", "remove_child")
+async def remove_child(ctx, inp: bytes):
+    req = _dec(inp)
+    key = f"children.{req['snap_id']}"
+    for _ in range(16):
+        cur = (await ctx.omap_get([key])).get(key)
+        kids = _dec(cur) or []
+        if req["child"] in kids:
+            kids.remove(req["child"])
+        ok, _ = await ctx.omap_cas(key, cur, _enc(kids))
+        if ok:
+            return 0, b""
+    return -11, b""
+
+
+@register("rbd", "get_children")
+async def get_children(ctx, inp: bytes):
+    req = _dec(inp)
+    key = f"children.{req['snap_id']}"
+    kids = _dec((await ctx.omap_get([key])).get(key)) or []
+    return 0, _enc(kids)
+
+
+@register("rbd", "set_parent")
+async def set_parent(ctx, inp: bytes):
+    req = _dec(inp)
+    await ctx.omap_set({"parent": _enc({
+        "image": req["image"], "snap_id": int(req["snap_id"]),
+        "snap_name": req.get("snap_name", ""),
+        "overlap": int(req["overlap"]),
+    })})
+    return 0, b""
+
+
+@register("rbd", "get_parent")
+async def get_parent(ctx, inp: bytes):
+    p = _dec((await ctx.omap_get(["parent"])).get("parent"))
+    if p is None:
+        return -2, b""
+    return 0, _enc(p)
+
+
+@register("rbd", "remove_parent")
+async def remove_parent(ctx, inp: bytes):
+    await ctx.omap_rm(["parent"])
+    return 0, b""

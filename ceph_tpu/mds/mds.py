@@ -287,6 +287,12 @@ class MDS:
         state, never a lost file (the reference journals both halves in
         one EUpdate)."""
         async with self._mutate_lock:
+            sparts = self._split(src)
+            dparts = self._split(dst)
+            if dparts[:len(sparts)] == sparts:
+                # moving a directory under itself would orphan the whole
+                # subtree behind an unreachable cycle (POSIX EINVAL)
+                raise FSError(22, f"cannot move {src!r} into itself")
             sparent, sdentry = await self.resolve(src)
             if sdentry is None:
                 raise FSError(2, f"no such file or directory: {src!r}")

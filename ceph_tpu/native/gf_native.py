@@ -19,21 +19,37 @@ _DIR = os.path.dirname(os.path.abspath(__file__))
 _SO = os.path.join(_DIR, "libec_kernels.so")
 
 
-def _load() -> ctypes.CDLL:
-    # make's dependency tracking rebuilds a stale .so BEFORE we dlopen it
-    # (ctypes cannot reload a library at the same path within a process,
-    # so rebuilding after a failed symbol lookup would be too late)
+def _rebuild_and_load() -> ctypes.CDLL:
+    """Rebuild, then dlopen through a UNIQUE path: dlopen caches by
+    path within a process, so reloading the same filename after a
+    rebuild would silently return the stale handle."""
+    import shutil
+    import tempfile
+
     subprocess.run(
-        ["make", "-C", _DIR, "libec_kernels.so"],
-        check=True,
-        capture_output=True,
+        ["make", "-B", "-C", _DIR, "libec_kernels.so"],
+        check=True, capture_output=True,
     )
+    tmp = tempfile.NamedTemporaryFile(
+        suffix=".so", prefix="libec_kernels-", delete=False
+    )
+    tmp.close()
+    shutil.copyfile(_SO, tmp.name)
+    return ctypes.CDLL(tmp.name)
+
+
+def _load() -> ctypes.CDLL:
+    # a present, current prebuilt library loads directly -- no toolchain
+    # needed on deploy hosts; missing or stale (pre-arch-probe) builds
+    # rebuild via make (dependency-tracked)
+    if not os.path.exists(_SO):
+        subprocess.run(
+            ["make", "-C", _DIR, "libec_kernels.so"],
+            check=True, capture_output=True,
+        )
     lib = ctypes.CDLL(_SO)
     if not hasattr(lib, "ec_arch_probe"):
-        raise OSError(
-            "stale libec_kernels.so predates the arch probe and make "
-            f"considers it current; run: make -B -C {_DIR}"
-        )
+        lib = _rebuild_and_load()
     lib.ec_arch_probe.restype = ctypes.c_int
     lib.ec_arch_built.restype = ctypes.c_int
     # runtime feature gate (reference ceph_arch_probe): refuse a library

@@ -1,4 +1,14 @@
-"""RBD image management + I/O (librbd core surface)."""
+"""RBD image management + I/O (librbd core surface).
+
+Round-4 upgrade: snapshots are REAL data snapshots (every data-object
+write carries the image's self-managed SnapContext, so the RADOS layer
+COW-clones pre-snap blocks -- the librbd snapshot model), and clones are
+REAL COW clones: a child image references ``parent@snap``; reads of
+never-written child blocks fall through to the parent at that snap and
+partial child writes copy the parent block up first (librbd layering +
+copy-up, src/librbd/io/CopyupRequest.cc).  ``flatten`` severs the
+dependency by copying every still-inherited block.
+"""
 
 from __future__ import annotations
 
@@ -27,7 +37,7 @@ def _data_oid(name: str, object_no: int) -> str:
 
 
 class RBD:
-    """Image management (librbd::RBD): create/list/remove/resize."""
+    """Image management (librbd::RBD): create/list/remove/clone."""
 
     def __init__(self, backend):
         self.backend = backend  # the pool's primary EC engine
@@ -43,6 +53,31 @@ class RBD:
             raise IOError(f"rbd create {name}: rc={ret}")
         await self.backend.omap_set(_DIR_OID, {f"name_{name}": b"1"})
 
+    async def clone(self, parent: str, snap: str, child: str) -> None:
+        """COW clone of parent@snap (librbd::RBD::clone).  The snap must
+        be protected first (the reference's guard against trimming a
+        snap that children still read through)."""
+        pimg = await Image.open(self.backend, parent)
+        ent = pimg.snaps.get(snap)
+        if ent is None:
+            raise FileNotFoundError(f"{parent}@{snap}")
+        if not ent.get("protected"):
+            raise PermissionError(
+                f"snap {parent}@{snap} is not protected"
+            )
+        await self.create(child, ent["size"], order=pimg.order)
+        ret, _ = await self.backend.exec(
+            _header_oid(child), "rbd", "set_parent",
+            _enc({"image": parent, "snap_id": ent["id"],
+                  "snap_name": snap, "overlap": ent["size"]}),
+        )
+        if ret != 0:
+            raise IOError(f"set_parent rc={ret}")
+        await self.backend.exec(
+            _header_oid(parent), "rbd", "add_child",
+            _enc({"snap_id": ent["id"], "child": child}),
+        )
+
     async def list(self) -> List[str]:
         try:
             omap = await self.backend.omap_get(_DIR_OID)
@@ -54,6 +89,22 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await Image.open(self.backend, name)
+        if img.snaps:
+            # the reference refuses too: deleting the head would orphan
+            # the snap clone objects with no way to ever trim them
+            raise IOError(f"image {name} has snapshots; remove them first")
+        for ent in img.snaps.values():
+            _, out = await self.backend.exec(
+                _header_oid(name), "rbd", "get_children",
+                _enc({"snap_id": ent["id"]}),
+            )
+            if _dec(out):
+                raise IOError(f"image {name} has clone children")
+        if img.parent is not None:
+            await self.backend.exec(
+                _header_oid(img.parent["image"]), "rbd", "remove_child",
+                _enc({"snap_id": img.parent["snap_id"], "child": name}),
+            )
         n_objects = img.striper.object_count(img.size)
         for object_no in range(n_objects):
             try:
@@ -65,46 +116,141 @@ class RBD:
 
 
 class Image:
-    """An open image (librbd::Image): read/write/resize/snap/lock."""
+    """An open image (librbd::Image): read/write/resize/snap/clone/lock."""
 
     def __init__(self, backend, name: str, size: int, order: int,
-                 snaps: Dict[str, dict]):
+                 snaps: Dict[str, dict], snap_seq: int = 0,
+                 parent: Optional[dict] = None,
+                 read_snap: Optional[str] = None):
         self.backend = backend
         self.name = name
         self.size = size
         self.order = order
         self.snaps = snaps
+        self.snap_seq = snap_seq
+        self.parent = parent
+        self.read_snap_id: Optional[int] = None
+        if read_snap is not None:
+            ent = snaps.get(read_snap)
+            if ent is None:
+                raise FileNotFoundError(f"{name}@{read_snap}")
+            self.read_snap_id = ent["id"]
+            self.size = ent["size"]
         self.striper = Striper(FileLayout(
             object_size=1 << order, stripe_unit=1 << order, stripe_count=1,
         ))
 
     @classmethod
-    async def open(cls, backend, name: str) -> "Image":
+    async def open(cls, backend, name: str,
+                   snap: Optional[str] = None) -> "Image":
         ret, out = await backend.exec(_header_oid(name), "rbd",
                                       "get_metadata")
         if ret == -2:
             raise FileNotFoundError(name)
         md = _dec(out)
-        return cls(backend, name, md["size"], md["order"], md["snaps"])
+        return cls(backend, name, md["size"], md["order"], md["snaps"],
+                   snap_seq=md.get("snap_seq", 0),
+                   parent=md.get("parent"), read_snap=snap)
 
     async def refresh(self) -> None:
         md = _dec((await self.backend.exec(
             _header_oid(self.name), "rbd", "get_metadata"))[1])
-        self.size, self.order = md["size"], md["order"]
+        if self.read_snap_id is None:
+            self.size = md["size"]
+        self.order = md["order"]
         self.snaps = md["snaps"]
+        self.snap_seq = md.get("snap_seq", 0)
+        self.parent = md.get("parent")
+
+    # -- snap context (the librados self-managed SnapContext) --------------
+
+    def _snapc(self) -> Optional[dict]:
+        ids = sorted((e["id"] for e in self.snaps.values()), reverse=True)
+        if not ids:
+            return None
+        return {"seq": self.snap_seq, "snaps": ids}
+
+    # -- layering helpers (librbd io layer) --------------------------------
+
+    async def _object_absent(self, oid: str) -> bool:
+        size, hinfo = await self.backend.stat(oid)
+        return size == 0 and hinfo is None
+
+    async def _object_absent_at(self, oid: str,
+                                snap: Optional[int]) -> bool:
+        """Did the object exist at ``snap``?  A clone with id >= snap
+        serves that state; a head whose SnapSet seq predates the snap is
+        unchanged since then; a head first written AT/AFTER the snap
+        (seq >= snap, no covering clone) did not exist yet -- reading a
+        child snapshot must then fall through to the parent even though
+        a later copy-up created the head (librbd head-vs-snap split)."""
+        if snap is None:
+            return await self._object_absent(oid)
+        try:
+            ss = await self.backend.list_snaps(oid)
+        except IOError:
+            return True
+        if any(c["id"] >= snap for c in ss["clones"]):
+            return False
+        return not ss["head_exists"] or ss["seq"] >= snap
+
+    async def _parent_image(self) -> "Image":
+        p = self.parent
+        img = await Image.open(self.backend, p["image"])
+        # read strictly at the cloned snap id, clipped to the overlap
+        img.read_snap_id = p["snap_id"]
+        img.size = p["overlap"]
+        return img
+
+    async def _read_parent(self, offset: int, length: int) -> bytes:
+        """Read [offset, offset+length) from parent@snap, zero-padded
+        past the overlap (librbd reads clip to the parent overlap)."""
+        p = self.parent
+        end = min(offset + length, p["overlap"])
+        if end <= offset:
+            return bytes(length)
+        parent = await self._parent_image()
+        data = await parent.read(offset, end - offset)
+        return data.ljust(length, b"\0")
+
+    async def _copy_up(self, object_no: int) -> None:
+        """Materialize a child object from the parent before a partial
+        write (librbd CopyupRequest): the whole parent block lands in
+        the child object so the rest of the block is never lost."""
+        osz = 1 << self.order
+        base = object_no * osz
+        span = min(osz, max(0, self.parent["overlap"] - base))
+        if span <= 0:
+            return
+        block = await self._read_parent(base, span)
+        await self.backend.write_range(
+            _data_oid(self.name, object_no), 0, block,
+            snapc=self._snapc(),
+        )
 
     # -- I/O ---------------------------------------------------------------
 
     async def write(self, offset: int, data: bytes) -> None:
+        if self.read_snap_id is not None:
+            raise IOError("image opened read-only at a snapshot")
         if offset + len(data) > self.size:
             raise IOError("write past end of image")
         pos = 0
+        osz = 1 << self.order
         for object_no, obj_off, length in self.striper.map_extent(
             offset, len(data)
         ):
             oid = _data_oid(self.name, object_no)
+            if (
+                self.parent is not None
+                and length < osz
+                and object_no * osz < self.parent["overlap"]
+                and await self._object_absent(oid)
+            ):
+                await self._copy_up(object_no)
             await self.backend.write_range(
-                oid, obj_off, data[pos : pos + length]
+                oid, obj_off, data[pos : pos + length],
+                snapc=self._snapc(),
             )
             pos += length
 
@@ -116,13 +262,41 @@ class Image:
             offset, length
         ):
             oid = _data_oid(self.name, object_no)
+            piece = b""
+            absent = False
             try:
-                piece = await self.backend.read_range(oid, obj_off, take)
+                piece = await self.backend.read_range(
+                    oid, obj_off, take, snap=self.read_snap_id,
+                )
             except (FileNotFoundError, IOError):
-                piece = b""  # never-written object reads as zeros
+                absent = True
+            if (absent or not piece) and self.parent is not None:
+                # block absent at the version being read: fall through
+                if await self._object_absent_at(oid, self.read_snap_id):
+                    piece = await self._read_parent(
+                        object_no * (1 << self.order) + obj_off, take
+                    )
             out[pos : pos + len(piece)] = piece
             pos += take
         return bytes(out)
+
+    async def flatten(self) -> None:
+        """Copy every still-inherited block from the parent and sever
+        the dependency (librbd::Image::flatten)."""
+        if self.parent is None:
+            return
+        osz = 1 << self.order
+        overlap = self.parent["overlap"]
+        for object_no in range((overlap + osz - 1) // osz):
+            if await self._object_absent(_data_oid(self.name, object_no)):
+                await self._copy_up(object_no)
+        await self.backend.exec(
+            _header_oid(self.parent["image"]), "rbd", "remove_child",
+            _enc({"snap_id": self.parent["snap_id"], "child": self.name}),
+        )
+        await self.backend.exec(
+            _header_oid(self.name), "rbd", "remove_parent", b"")
+        self.parent = None
 
     async def resize(self, new_size: int) -> None:
         old_size = self.size
@@ -133,6 +307,21 @@ class Image:
         if ret != 0:
             raise IOError(f"resize rc={ret}")
         self.size = new_size
+        if (
+            new_size < old_size
+            and self.parent is not None
+            and self.parent["overlap"] > new_size
+        ):
+            # librbd shrinks the parent overlap on resize: a later regrow
+            # must read zeros, never resurface parent bytes
+            self.parent = dict(self.parent, overlap=new_size)
+            await self.backend.exec(
+                _header_oid(self.name), "rbd", "set_parent",
+                _enc({"image": self.parent["image"],
+                      "snap_id": self.parent["snap_id"],
+                      "snap_name": self.parent.get("snap_name", ""),
+                      "overlap": new_size}),
+            )
         if new_size < old_size:
             # trim (librbd shrink semantics): whole objects past the new
             # end are deleted and the boundary object's tail is zeroed --
@@ -143,7 +332,8 @@ class Image:
                                    self.striper.object_count(old_size)):
                 try:
                     await self.backend.remove_object(
-                        _data_oid(self.name, object_no)
+                        _data_oid(self.name, object_no),
+                        snapc=self._snapc(),
                     )
                 except (FileNotFoundError, IOError):
                     pass
@@ -153,7 +343,8 @@ class Image:
                 obj_size, _ = await self.backend.stat(oid)
                 if obj_size > boundary:
                     await self.backend.write_range(
-                        oid, boundary, b"\0" * (obj_size - boundary)
+                        oid, boundary, b"\0" * (obj_size - boundary),
+                        snapc=self._snapc(),
                     )
         # header watchers (other clients with the image open) refresh
         await self.backend.notify(
@@ -161,7 +352,7 @@ class Image:
             timeout=1.0,
         )
 
-    # -- snapshots (metadata-level; see package docstring) ----------------
+    # -- snapshots (REAL data snapshots via the RADOS snap layer) ----------
 
     async def snap_create(self, snap: str) -> int:
         ret, out = await self.backend.exec(
@@ -172,11 +363,66 @@ class Image:
         return _dec(out)
 
     async def snap_remove(self, snap: str) -> None:
+        ent = self.snaps.get(snap)
+        if ent is not None and ent.get("protected"):
+            raise PermissionError(f"snap {snap} is protected")
         ret, _ = await self.backend.exec(
             _header_oid(self.name), "rbd", "snap_remove",
             _enc({"name": snap}))
         if ret != 0:
             raise IOError(f"snap_remove rc={ret}")
+        await self.refresh()
+        # trim RADOS-level clones the dropped snap alone kept alive
+        live = [e["id"] for e in self.snaps.values()]
+        max_objs = self.striper.object_count(
+            max([self.size] + [e["size"] for e in self.snaps.values()]
+                + ([ent["size"]] if ent else []))
+        )
+        for object_no in range(max_objs):
+            try:
+                await self.backend.snap_trim(
+                    _data_oid(self.name, object_no), live
+                )
+            except IOError:
+                pass
+
+    async def snap_rollback(self, snap: str) -> None:
+        """Restore the image data+size to the snapshot
+        (librbd::Image::snap_rollback)."""
+        ent = self.snaps.get(snap)
+        if ent is None:
+            raise FileNotFoundError(f"{self.name}@{snap}")
+        max_objs = self.striper.object_count(max(self.size, ent["size"]))
+        for object_no in range(max_objs):
+            try:
+                await self.backend.snap_rollback(
+                    _data_oid(self.name, object_no), ent["id"],
+                    snapc=self._snapc(),
+                )
+            except IOError:
+                pass  # object absent in both states
+        await self.backend.exec(
+            _header_oid(self.name), "rbd", "set_size",
+            _enc({"size": ent["size"]}),
+        )
+        self.size = ent["size"]
+
+    async def snap_protect(self, snap: str) -> None:
+        ret, _ = await self.backend.exec(
+            _header_oid(self.name), "rbd", "snap_protect",
+            _enc({"name": snap}))
+        if ret != 0:
+            raise IOError(f"snap_protect rc={ret}")
+        await self.refresh()
+
+    async def snap_unprotect(self, snap: str) -> None:
+        ret, _ = await self.backend.exec(
+            _header_oid(self.name), "rbd", "snap_unprotect",
+            _enc({"name": snap}))
+        if ret == -16:
+            raise BlockingIOError(f"snap {snap} has clone children")
+        if ret != 0:
+            raise IOError(f"snap_unprotect rc={ret}")
         await self.refresh()
 
     def snap_list(self) -> List[str]:

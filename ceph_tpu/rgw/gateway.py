@@ -178,6 +178,18 @@ class RGWGateway:
         bucket, _, key = path.partition("/")
         return bucket, key, params
 
+    async def _check_owner(self, bucket: str, owner: str) -> None:
+        """Bucket-owner authorization (the rgw ACL subset: private
+        buckets, owner-full-control)."""
+        got = await self.backend.omap_get(BUCKETS_OID, [bucket])
+        if bucket not in got:
+            raise S3Error("NoSuchBucket", bucket)
+        bucket_owner = got[bucket].decode().split("\x00", 1)[0]
+        if bucket_owner != owner:
+            raise S3Error(
+                "AccessDenied", f"bucket {bucket!r} is not yours"
+            )
+
     async def _handle(self, method, target, headers, body):
         bucket, key, params = self._split_target(target)
         resource = "/" + bucket + ("/" + key if key else "")
@@ -189,6 +201,7 @@ class RGWGateway:
         if not key:
             if method == "PUT":
                 return await self._create_bucket(bucket, owner)
+            await self._check_owner(bucket, owner)
             if method == "DELETE":
                 return await self._delete_bucket(bucket)
             if method == "GET":
@@ -196,6 +209,7 @@ class RGWGateway:
                     bucket, params.get("prefix", "")
                 )
             raise S3Error("InvalidRequest", f"{method} on bucket")
+        await self._check_owner(bucket, owner)
         if method == "PUT":
             return await self._put_object(bucket, key, body)
         if method == "GET":
@@ -214,9 +228,13 @@ class RGWGateway:
 
     async def _list_buckets(self, owner: str):
         buckets = await self.backend.omap_get(BUCKETS_OID)
+        mine = [
+            n for n, raw in buckets.items()
+            if raw.decode().split("\x00", 1)[0] == owner
+        ]
         items = "".join(
             f"<Bucket><Name>{escape(n)}</Name></Bucket>"
-            for n in sorted(buckets)
+            for n in sorted(mine)
         )
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'

@@ -217,3 +217,149 @@ def test_striper_object_count_raid0():
     for total in range(1, 40):
         touched = {e[0] for e in s.map_extent(0, total)}
         assert s.object_count(total) == len(touched), total
+
+
+# -- real data snapshots + COW clone layering (round-4 upgrade) ------------
+
+
+def test_image_snapshot_data_readback():
+    """Snapshots capture DATA: overwrite after snap, read the snap back
+    (librbd snapshots over the RADOS self-managed snap layer)."""
+
+    async def main():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("disk", 512 << 10, order=16)  # 8 x 64K objects
+        img = await Image.open(c.backend, "disk")
+        import os as _os
+
+        v1 = _os.urandom(200 << 10)
+        await img.write(0, v1)
+        await img.snap_create("s1")
+        v2 = _os.urandom(200 << 10)
+        await img.write(0, v2)
+        assert await img.read(0, 200 << 10) == v2
+        snap_view = await Image.open(c.backend, "disk", snap="s1")
+        got = await snap_view.read(0, 200 << 10)
+        assert got == v1
+        # rollback restores the head
+        await img.snap_rollback("s1")
+        assert await img.read(0, 200 << 10) == v1
+        # snap_remove trims the RADOS clones
+        await img.snap_remove("s1")
+        assert img.snap_list() == []
+        await c.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_clone_cow_layering_and_copyup():
+    """Clone a protected snap; child reads fall through to the parent,
+    partial child writes copy the parent block up first, flatten severs
+    the dependency (librbd layering / CopyupRequest)."""
+
+    async def main():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("base", 256 << 10, order=16)  # 4 x 64K objects
+        base = await Image.open(c.backend, "base")
+        import os as _os
+
+        golden = _os.urandom(256 << 10)
+        await base.write(0, golden)
+        await base.snap_create("gold")
+        # clone requires protection
+        with pytest.raises(PermissionError):
+            await rbd.clone("base", "gold", "vm1")
+        await base.snap_protect("gold")
+        await rbd.clone("base", "gold", "vm1")
+        child = await Image.open(c.backend, "vm1")
+        assert child.parent["image"] == "base"
+        # unmodified child reads == parent snap data (COW fallthrough)
+        assert await child.read(0, 256 << 10) == golden
+        # parent head changes do NOT leak into the child (snap pinned)
+        await base.write(0, b"\xFF" * (64 << 10))
+        assert await child.read(0, 64 << 10) == golden[:64 << 10]
+        # partial child write: copy-up preserves the rest of the block
+        await child.write(100, b"CHILD")
+        blk = await child.read(0, 64 << 10)
+        assert blk[:100] == golden[:100]
+        assert blk[100:105] == b"CHILD"
+        assert blk[105:] == golden[105:64 << 10]
+        # unprotect is refused while the child exists
+        with pytest.raises(BlockingIOError):
+            await base.snap_unprotect("gold")
+        # flatten copies the remaining blocks and severs the parent
+        await child.flatten()
+        assert child.parent is None
+        assert (await Image.open(c.backend, "vm1")).parent is None
+        assert await child.read(64 << 10, 192 << 10) == golden[64 << 10:]
+        await base.snap_unprotect("gold")  # now allowed
+        await base.snap_remove("gold")
+        await c.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_clone_remove_ordering():
+    async def main():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("p", 128 << 10, order=16)
+        p = await Image.open(c.backend, "p")
+        await p.write(0, b"P" * (128 << 10))
+        await p.snap_create("s")
+        await p.snap_protect("s")
+        await rbd.clone("p", "s", "kid")
+        # parent removal refused while the child references it
+        with pytest.raises(IOError):
+            await rbd.remove("p")
+        await rbd.remove("kid")  # deregisters from the parent
+        await p.snap_unprotect("s")
+        await p.snap_remove("s")
+        await rbd.remove("p")
+        assert await rbd.list() == []
+        await c.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_clone_shrink_regrow_reads_zeros():
+    """Shrinking a clone reduces the parent overlap, so a regrow reads
+    zeros instead of resurfacing parent bytes (review finding)."""
+
+    async def main():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("pp", 192 << 10, order=16)
+        p = await Image.open(c.backend, "pp")
+        await p.write(0, b"P" * (192 << 10))
+        await p.snap_create("s")
+        await p.snap_protect("s")
+        await rbd.clone("pp", "s", "cc")
+        child = await Image.open(c.backend, "cc")
+        await child.resize(64 << 10)
+        await child.resize(192 << 10)
+        data = await child.read(0, 192 << 10)
+        assert data[:64 << 10] == b"P" * (64 << 10)
+        assert data[64 << 10:] == bytes(128 << 10)
+        await c.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
+def test_remove_image_with_snaps_refused():
+    async def main():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("im", 64 << 10, order=16)
+        img = await Image.open(c.backend, "im")
+        await img.write(0, b"z" * 1000)
+        await img.snap_create("keep")
+        with pytest.raises(IOError):
+            await rbd.remove("im")
+        await img.snap_remove("keep")
+        await rbd.remove("im")
+        await c.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())

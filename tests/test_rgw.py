@@ -173,3 +173,33 @@ def test_zero_byte_object():
         await c.shutdown()
 
     run(main())
+
+
+def test_cross_tenant_access_denied():
+    """Bucket-owner authorization: another valid user cannot read,
+    write, list or delete someone else's bucket (review finding)."""
+
+    async def main():
+        c, gw, port = await _gateway()
+        await gw.create_user("mallory", "msecret")
+        await _request(port, "PUT", "/private")
+        await _request(port, "PUT", "/private/secret.txt", body=b"s3cr3t")
+        for method, target in (
+            ("GET", "/private/secret.txt"), ("PUT", "/private/x"),
+            ("DELETE", "/private/secret.txt"), ("GET", "/private"),
+            ("DELETE", "/private"),
+        ):
+            st, _, body = await _request(
+                port, method, target, access="mallory", secret="msecret",
+            )
+            assert st == 403 and b"AccessDenied" in body, (method, target)
+        # the owner's view is intact; mallory's service list shows nothing
+        st, _, got = await _request(port, "GET", "/private/secret.txt")
+        assert st == 200 and got == b"s3cr3t"
+        st, _, body = await _request(port, "GET", "/", access="mallory",
+                                     secret="msecret")
+        assert st == 200 and b"private" not in body
+        await gw.stop()
+        await c.shutdown()
+
+    run(main())
