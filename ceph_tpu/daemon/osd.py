@@ -89,6 +89,9 @@ async def serve(args) -> None:
             "objects": len(shard.store.list_objects()),
             "pools": sorted(shard.pools),
         })
+        from ceph_tpu.utils import perfglue
+
+        perfglue.register(asok)  # cpu_profiler start/stop/status
         await asok.start()
     print(f"{name} up", flush=True)
 

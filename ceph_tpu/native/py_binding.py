@@ -1,0 +1,29 @@
+"""Loader for the CPython C-API extension (the src/pybind role).
+
+Builds ``_ec_native`` on demand (Makefile py_ext target) and imports it
+from the build directory; the module binds the native kernels through
+the C API proper -- PyArg_Parse / buffer protocol / GIL release --
+rather than ctypes marshalling.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load():
+    suffix = sysconfig.get_config_var("EXT_SUFFIX")
+    so = os.path.join(_DIR, f"_ec_native{suffix}")
+    if not os.path.exists(so):
+        subprocess.run(
+            ["make", "-C", _DIR, "py_ext"], check=True, capture_output=True
+        )
+    spec = importlib.util.spec_from_file_location("_ec_native", so)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod

@@ -142,9 +142,15 @@ class HashInfo:
         appended = 0
         for shard, chunk in sorted(to_append.items()):
             appended = len(chunk)
-            self.cumulative_shard_hashes[shard] = crc32c(
-                chunk, self.cumulative_shard_hashes[shard]
-            )
+            if self.cumulative_shard_hashes:
+                # hashes survive only on pure-append histories; once an
+                # overwrite cleared them (ec_overwrites semantics,
+                # reference ECUtil.cc hinfo reset) later appends track
+                # sizes only -- indexing the empty list was a crash on
+                # the append-after-overwrite path
+                self.cumulative_shard_hashes[shard] = crc32c(
+                    chunk, self.cumulative_shard_hashes[shard]
+                )
         self.total_chunk_size += appended
 
     def get_chunk_hash(self, shard: int) -> int:

@@ -1,0 +1,145 @@
+"""Journaler: the append-journal client library.
+
+Reference: src/osdc/Journaler.{h,cc} (CephFS MDLog's transport) and
+src/journal (librbd journaling) -- a logical byte/entry stream striped
+over numbered RADOS objects with four persisted pointers kept in a
+header object: write_pos, expire_pos (trim), and the reader's committed
+position.  Writers append framed entries; readers replay from the
+committed position; trim drops whole journal objects behind expire_pos.
+
+Layout: header omap on ``<name>.journal`` {write_pos, expire_pos,
+commit_pos}; entry data appended to ``<name>.journal.<objno>`` objects
+of ``object_size`` bytes.  Entries are crc-framed with the shared
+encoding framework, so a torn tail (partial append at crash) is
+detected and replay stops cleanly at it -- the same guarantee the
+reference gets from its entry headers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
+
+
+def _enc(v) -> bytes:
+    return Encoder().value(v).bytes()
+
+
+def _dec(b):
+    return Decoder(b).value() if b else None
+
+
+class Journaler:
+    def __init__(self, backend, name: str, object_size: int = 1 << 22):
+        self.backend = backend
+        self.name = name
+        self.object_size = object_size
+        self.write_pos = 0
+        self.expire_pos = 0
+        self.commit_pos = 0
+
+    @property
+    def _header(self) -> str:
+        return f"{self.name}.journal"
+
+    def _data(self, objno: int) -> str:
+        return f"{self.name}.journal.{objno:08x}"
+
+    # -- header ------------------------------------------------------------
+
+    async def create(self) -> None:
+        await self.backend.omap_set(self._header, {
+            "write_pos": _enc(0), "expire_pos": _enc(0),
+            "commit_pos": _enc(0),
+        })
+
+    async def open(self) -> None:
+        omap = await self.backend.omap_get(self._header)
+        if "write_pos" not in omap:
+            await self.create()
+            return
+        self.write_pos = _dec(omap["write_pos"])
+        self.expire_pos = _dec(omap["expire_pos"])
+        self.commit_pos = _dec(omap["commit_pos"])
+
+    async def _save_header(self) -> None:
+        await self.backend.omap_set(self._header, {
+            "write_pos": _enc(self.write_pos),
+            "expire_pos": _enc(self.expire_pos),
+            "commit_pos": _enc(self.commit_pos),
+        })
+
+    # -- append (Journaler::append_entry + flush) --------------------------
+
+    async def append(self, entry) -> int:
+        """Append one entry (any encodable value); returns its start
+        position.  The entry never splits an object boundary mid-frame
+        the hard way: a frame that would cross pads to the next object
+        (the reference pads with a skip entry at object boundaries)."""
+        rec = frame(_enc(entry))
+        osz = self.object_size
+        start = self.write_pos
+        if start // osz != (start + len(rec) - 1) // osz:
+            start = ((start // osz) + 1) * osz  # skip to the next object
+        objno, off = divmod(start, osz)
+        await self.backend.write_range(self._data(objno), off, rec)
+        self.write_pos = start + len(rec)
+        await self._save_header()
+        return start
+
+    # -- replay (Journaler::read_entry loop) -------------------------------
+
+    async def replay(self, from_pos: Optional[int] = None
+                     ) -> List[Tuple[int, object]]:
+        """Entries from ``from_pos`` (default: commit_pos) to the write
+        head; a torn tail (crashed writer) ends replay cleanly."""
+        pos = self.commit_pos if from_pos is None else from_pos
+        pos = max(pos, self.expire_pos)
+        out: List[Tuple[int, object]] = []
+        osz = self.object_size
+        cached_objno, blob = None, b""
+        while pos < self.write_pos:
+            objno, off = divmod(pos, osz)
+            if objno != cached_objno:
+                try:
+                    blob = await self.backend.read(self._data(objno))
+                except IOError:
+                    break  # trimmed/missing object
+                cached_objno = objno
+            rec, newoff = unframe(bytes(blob), off)
+            if rec is None:
+                # torn or padded tail: skip to the next object if the
+                # writer did, else stop (crash tail)
+                next_obj = (objno + 1) * osz
+                if next_obj < self.write_pos:
+                    pos = next_obj
+                    continue
+                break
+            out.append((pos, _dec(rec)))
+            pos = objno * osz + newoff
+        return out
+
+    # -- commit / trim (Journaler::set_expire_pos + trim) ------------------
+
+    async def committed(self, pos: int) -> None:
+        """The reader durably applied everything below ``pos``."""
+        self.commit_pos = max(self.commit_pos, pos)
+        await self._save_header()
+
+    async def trim(self) -> int:
+        """Drop whole journal objects below the commit position
+        (expire); returns objects removed."""
+        osz = self.object_size
+        target = (self.commit_pos // osz) * osz
+        removed = 0
+        for objno in range(self.expire_pos // osz, target // osz):
+            try:
+                await self.backend.remove_object(self._data(objno))
+                removed += 1
+            except IOError:
+                pass
+        if target > self.expire_pos:
+            self.expire_pos = target
+            await self._save_header()
+        return removed
