@@ -34,13 +34,15 @@ async def create(ctx, inp: bytes):
         # seq lives INSIDE the snaps blob: snapshot id allocation and the
         # table update are one CAS, so racing snap_adds cannot reuse ids
         "snaps": _enc({"seq": 0, "by_name": {}}),
+        "features": _enc(sorted(req.get("features", []))),
     })
     return 0, b""
 
 
 @register("rbd", "get_metadata")
 async def get_metadata(ctx, inp: bytes):
-    omap = await ctx.omap_get(["size", "order", "snaps", "parent"])
+    omap = await ctx.omap_get(
+        ["size", "order", "snaps", "parent", "features"])
     if "size" not in omap:
         return -2, b""
     snaps = _dec(omap.get("snaps")) or {"seq": 0, "by_name": {}}
@@ -50,6 +52,7 @@ async def get_metadata(ctx, inp: bytes):
         "snap_seq": snaps["seq"],
         "snaps": snaps["by_name"],
         "parent": _dec(omap.get("parent")),
+        "features": _dec(omap.get("features")) or [],
     })
 
 
@@ -104,6 +107,21 @@ async def snap_remove(ctx, inp: bytes):
         if ok:
             return 0, b""
     return -11, b""
+
+
+@register("rbd", "set_features")
+async def set_features(ctx, inp: bytes):
+    """Enable/disable named features (reference cls_rbd set_features:
+    librbd dynamic feature toggling, e.g. journaling on/off)."""
+    req = _dec(inp)
+    omap = await ctx.omap_get(["features", "size"])
+    if "size" not in omap:
+        return -2, b""
+    feats = set(_dec(omap.get("features")) or [])
+    feats |= set(req.get("enable", []))
+    feats -= set(req.get("disable", []))
+    await ctx.omap_set({"features": _enc(sorted(feats))})
+    return 0, b""
 
 
 @register("rbd", "metadata_set")

@@ -13,6 +13,14 @@ of ``object_size`` bytes.  Entries are crc-framed with the shared
 encoding framework, so a torn tail (partial append at crash) is
 detected and replay stops cleanly at it -- the same guarantee the
 reference gets from its entry headers.
+
+Named clients: src/journal's JournalMetadata keeps a registry of
+clients (the image itself plus mirror peers), each with its own commit
+position; trim may only advance past what EVERY client has consumed
+(src/journal/JournalMetadata.cc client_s / committed()).  Here clients
+live in the same header omap under ``client.<id>`` keys and
+``trim()`` takes the minimum over the master commit position and all
+registered clients.
 """
 
 from __future__ import annotations
@@ -94,9 +102,17 @@ class Journaler:
                      ) -> List[Tuple[int, object]]:
         """Entries from ``from_pos`` (default: commit_pos) to the write
         head; a torn tail (crashed writer) ends replay cleanly."""
+        return [(start, entry) for start, _end, entry in
+                await self.replay_entries(from_pos)]
+
+    async def replay_entries(self, from_pos: Optional[int] = None
+                             ) -> List[Tuple[int, int, object]]:
+        """Like replay but yields (start, end, entry) -- consumers that
+        track their own commit position (mirror peers) need the end
+        offset of each entry to advance past it."""
         pos = self.commit_pos if from_pos is None else from_pos
         pos = max(pos, self.expire_pos)
-        out: List[Tuple[int, object]] = []
+        out: List[Tuple[int, int, object]] = []
         osz = self.object_size
         cached_objno, blob = None, b""
         while pos < self.write_pos:
@@ -116,22 +132,63 @@ class Journaler:
                     pos = next_obj
                     continue
                 break
-            out.append((pos, _dec(rec)))
-            pos = objno * osz + newoff
+            end = objno * osz + newoff
+            out.append((pos, end, _dec(rec)))
+            pos = end
         return out
+
+    # -- client registry (src/journal JournalMetadata clients) -------------
+
+    async def register_client(self, client_id: str,
+                              pos: Optional[int] = None) -> int:
+        """Register a named consumer (e.g. a mirror peer) at ``pos``
+        (default: the current write head).  Idempotent: re-registering
+        returns the existing position."""
+        key = f"client.{client_id}"
+        omap = await self.backend.omap_get(self._header)
+        if key in omap:
+            return _dec(omap[key])
+        start = self.write_pos if pos is None else pos
+        await self.backend.omap_set(self._header, {key: _enc(start)})
+        return start
+
+    async def unregister_client(self, client_id: str) -> None:
+        await self.backend.omap_rm(self._header, [f"client.{client_id}"])
+
+    async def client_pos(self, client_id: str) -> Optional[int]:
+        omap = await self.backend.omap_get(self._header)
+        raw = omap.get(f"client.{client_id}")
+        return None if raw is None else _dec(raw)
+
+    async def clients(self) -> dict:
+        omap = await self.backend.omap_get(self._header)
+        return {k[len("client."):]: _dec(v) for k, v in omap.items()
+                if k.startswith("client.")}
 
     # -- commit / trim (Journaler::set_expire_pos + trim) ------------------
 
-    async def committed(self, pos: int) -> None:
-        """The reader durably applied everything below ``pos``."""
+    async def committed(self, pos: int,
+                        client: Optional[str] = None) -> None:
+        """The reader durably applied everything below ``pos``.  With
+        ``client`` set, advances that registered client's position
+        instead of the master commit pointer."""
+        if client is not None:
+            cur = await self.client_pos(client)
+            if cur is None or pos > cur:
+                await self.backend.omap_set(
+                    self._header, {f"client.{client}": _enc(pos)})
+            return
         self.commit_pos = max(self.commit_pos, pos)
         await self._save_header()
 
     async def trim(self) -> int:
         """Drop whole journal objects below the commit position
-        (expire); returns objects removed."""
+        (expire); returns objects removed.  A lagging registered client
+        pins the journal: trim never passes the slowest consumer."""
         osz = self.object_size
-        target = (self.commit_pos // osz) * osz
+        floor = min([self.commit_pos]
+                    + list((await self.clients()).values()))
+        target = (floor // osz) * osz
         removed = 0
         for objno in range(self.expire_pos // osz, target // osz):
             try:

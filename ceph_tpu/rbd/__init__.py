@@ -2,7 +2,7 @@
 
 Reference: src/librbd (58.7k LoC) reduced to the core image model:
 
-* header object ``rbd_header.<name>`` -- size/order/snaps/metadata in
+* header object ``rbd_header.<name>`` -- size/order/snaps/features in
   omap, managed by the ``rbd`` object class (ceph_tpu/cls/cls_rbd.py,
   reference src/cls/rbd);
 * data objects ``rbd_data.<name>.<object_no:016x>`` -- image extents
@@ -10,13 +10,25 @@ Reference: src/librbd (58.7k LoC) reduced to the core image model:
 * exclusive-lock via cls_lock, header-change notification via
   watch/notify (the reference's ExclusiveLock + ImageWatcher roles);
 * the image directory object ``rbd_directory`` lists images (cls_rbd
-  dir methods' role).
+  dir methods' role);
+* REAL data snapshots via the RADOS self-managed SnapContext, COW
+  clone layering with copy-up, flatten (src/librbd/io + Operations);
+* image journaling (feature ``journaling``): mutations recorded as
+  typed events in a per-image journal before application, crash replay
+  on open (src/librbd/Journal.cc over src/journal);
+* rbd-mirror: journal replay into a peer cluster with a registered
+  journal client pinning trim (src/tools/rbd_mirror).
 
-Reductions vs the reference (documented, not hidden): snapshots are
-header metadata only (no OSD-level COW clones), no journaling/mirroring,
-no parent/child layering.
+Reductions vs the reference (documented, not hidden): no object-map
+feature, no promotion/demotion tags in mirroring (source is always
+primary).
 """
 
 from ceph_tpu.rbd.image import RBD, Image
+from ceph_tpu.rbd.journal import FEATURE_JOURNALING, ImageJournal
+from ceph_tpu.rbd.mirror import (ImageReplayer, MirrorDaemon,
+                                 mirror_disable, mirror_enable, mirror_list)
 
-__all__ = ["RBD", "Image"]
+__all__ = ["RBD", "Image", "FEATURE_JOURNALING", "ImageJournal",
+           "ImageReplayer", "MirrorDaemon", "mirror_disable",
+           "mirror_enable", "mirror_list"]

@@ -15,6 +15,9 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ceph_tpu.osdc.striper import FileLayout, Striper
+from ceph_tpu.rbd.journal import (FEATURE_JOURNALING, MIRROR_DIR_OID,
+                                  ImageJournal, apply_event,
+                                  destroy_journal)
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 _DIR_OID = "rbd_directory"
@@ -42,10 +45,12 @@ class RBD:
     def __init__(self, backend):
         self.backend = backend  # the pool's primary EC engine
 
-    async def create(self, name: str, size: int, order: int = 22) -> None:
+    async def create(self, name: str, size: int, order: int = 22,
+                     features: Optional[List[str]] = None) -> None:
         ret, _ = await self.backend.exec(
             _header_oid(name), "rbd", "create",
-            _enc({"size": size, "order": order}),
+            _enc({"size": size, "order": order,
+                  "features": features or []}),
         )
         if ret == -17:
             raise FileExistsError(name)
@@ -91,15 +96,10 @@ class RBD:
         img = await Image.open(self.backend, name)
         if img.snaps:
             # the reference refuses too: deleting the head would orphan
-            # the snap clone objects with no way to ever trim them
+            # the snap clone objects with no way to ever trim them --
+            # and since clone children attach to snaps, a snapless image
+            # cannot have children either
             raise IOError(f"image {name} has snapshots; remove them first")
-        for ent in img.snaps.values():
-            _, out = await self.backend.exec(
-                _header_oid(name), "rbd", "get_children",
-                _enc({"snap_id": ent["id"]}),
-            )
-            if _dec(out):
-                raise IOError(f"image {name} has clone children")
         if img.parent is not None:
             await self.backend.exec(
                 _header_oid(img.parent["image"]), "rbd", "remove_child",
@@ -111,6 +111,10 @@ class RBD:
                 await self.backend.remove_object(_data_oid(name, object_no))
             except (FileNotFoundError, IOError):
                 pass  # never-written object
+        if img._journal is not None:
+            # drop the journal too, or a recreated same-name image would
+            # attach to the dead image's stream and replay its tail
+            await destroy_journal(self.backend, name)
         await self.backend.omap_clear(_header_oid(name))
         await self.backend.omap_rm(_DIR_OID, [f"name_{name}"])
 
@@ -121,7 +125,8 @@ class Image:
     def __init__(self, backend, name: str, size: int, order: int,
                  snaps: Dict[str, dict], snap_seq: int = 0,
                  parent: Optional[dict] = None,
-                 read_snap: Optional[str] = None):
+                 read_snap: Optional[str] = None,
+                 features: Optional[List[str]] = None):
         self.backend = backend
         self.name = name
         self.size = size
@@ -129,6 +134,11 @@ class Image:
         self.snaps = snaps
         self.snap_seq = snap_seq
         self.parent = parent
+        self.features: List[str] = features or []
+        self._journal: Optional[ImageJournal] = None
+        # set while re-applying journal events so the mutators below run
+        # their plain data path instead of re-journaling (librbd Replay)
+        self._replay_mode = False
         self.read_snap_id: Optional[int] = None
         if read_snap is not None:
             ent = snaps.get(read_snap)
@@ -148,9 +158,78 @@ class Image:
         if ret == -2:
             raise FileNotFoundError(name)
         md = _dec(out)
-        return cls(backend, name, md["size"], md["order"], md["snaps"],
-                   snap_seq=md.get("snap_seq", 0),
-                   parent=md.get("parent"), read_snap=snap)
+        img = cls(backend, name, md["size"], md["order"], md["snaps"],
+                  snap_seq=md.get("snap_seq", 0),
+                  parent=md.get("parent"), read_snap=snap,
+                  features=md.get("features", []))
+        if FEATURE_JOURNALING in img.features and snap is None:
+            img._journal = ImageJournal(backend, name)
+            await img._journal.open()
+            await img._crash_replay()
+        return img
+
+    async def _crash_replay(self) -> None:
+        """Re-apply journal events past the commit position (a writer
+        crashed between append and commit -- librbd Journal replay on
+        dirty open)."""
+        entries = await self._journal.uncommitted()
+        self._replay_mode = True
+        try:
+            for _start, end, ev in entries:
+                await apply_event(self, ev)
+                await self._journal.commit(end)
+        finally:
+            self._replay_mode = False
+
+    async def _journaled(self, event: dict) -> bool:
+        """Record ``event`` in the image journal, apply it through the
+        plain data path, then advance the commit pointer.  Returns False
+        when journaling is off (caller runs its plain path)."""
+        if self._journal is None or self._replay_mode:
+            return False
+        _start, end = await self._journal.append(event)
+        self._replay_mode = True
+        try:
+            await apply_event(self, event)
+        finally:
+            self._replay_mode = False
+        await self._journal.commit(end)
+        return True
+
+    async def update_features(self, enable: Optional[List[str]] = None,
+                              disable: Optional[List[str]] = None) -> None:
+        """Dynamic feature toggle (librbd::Image::update_features)."""
+        dropping_journal = (FEATURE_JOURNALING in (disable or [])
+                            and FEATURE_JOURNALING in self.features)
+        if dropping_journal:
+            # the reference refuses to disable journaling while
+            # mirroring depends on it; same for any registered journal
+            # consumer (a mirror peer's position would dangle).  Checks
+            # go through a fresh journal handle: this Image handle may
+            # predate the feature and have no journal attached.
+            try:
+                mdir = await self.backend.omap_get(MIRROR_DIR_OID)
+            except FileNotFoundError:
+                mdir = {}
+            if f"image_{self.name}" in mdir:
+                raise BlockingIOError(
+                    f"image {self.name} is mirror-enabled; disable "
+                    "mirroring first")
+            jr = ImageJournal(self.backend, self.name)
+            await jr.open()
+            clients = await jr.j.clients()
+            if clients:
+                raise BlockingIOError(
+                    f"journal has registered clients: {sorted(clients)}")
+        ret, _ = await self.backend.exec(
+            _header_oid(self.name), "rbd", "set_features",
+            _enc({"enable": enable or [], "disable": disable or []}))
+        if ret != 0:
+            raise IOError(f"set_features rc={ret}")
+        if dropping_journal:
+            await destroy_journal(self.backend, self.name)
+            self._journal = None
+        await self.refresh()  # attaches/detaches the journal as needed
 
     async def refresh(self) -> None:
         md = _dec((await self.backend.exec(
@@ -161,6 +240,17 @@ class Image:
         self.snaps = md["snaps"]
         self.snap_seq = md.get("snap_seq", 0)
         self.parent = md.get("parent")
+        self.features = md.get("features", [])
+        # track feature changes made through OTHER handles: a handle
+        # that kept writing through the plain path after journaling was
+        # enabled elsewhere would silently starve mirror peers
+        journaled = (FEATURE_JOURNALING in self.features
+                     and self.read_snap_id is None)
+        if journaled and self._journal is None:
+            self._journal = ImageJournal(self.backend, self.name)
+            await self._journal.open()
+        elif not journaled and self._journal is not None:
+            self._journal = None
 
     # -- snap context (the librados self-managed SnapContext) --------------
 
@@ -235,6 +325,15 @@ class Image:
             raise IOError("image opened read-only at a snapshot")
         if offset + len(data) > self.size:
             raise IOError("write past end of image")
+        if self._journal is not None and not self._replay_mode:
+            # bound each journal entry (librbd splits large AIOs into
+            # multiple AioWriteEvents so no event outgrows a journal
+            # object); positional writes keep the split replay-safe
+            step = 256 << 10
+            for i in range(0, len(data), step):
+                await self._journaled({"op": "write", "off": offset + i,
+                                       "data": data[i:i + step]})
+            return
         pos = 0
         osz = 1 << self.order
         for object_no, obj_off, length in self.striper.map_extent(
@@ -280,10 +379,27 @@ class Image:
             pos += take
         return bytes(out)
 
+    async def discard(self, offset: int, length: int) -> None:
+        """Zero a range (librbd::Image::discard).  Runs through the
+        write path so SnapContext COW and clone copy-up semantics hold;
+        trimming whole objects is an optimization the reference applies
+        only when the object has no snap/parent dependency."""
+        if self.read_snap_id is not None:
+            raise IOError("image opened read-only at a snapshot")
+        length = max(0, min(length, self.size - offset))
+        if length == 0:
+            return
+        if await self._journaled({"op": "discard", "off": offset,
+                                  "len": length}):
+            return
+        await self.write(offset, bytes(length))
+
     async def flatten(self) -> None:
         """Copy every still-inherited block from the parent and sever
         the dependency (librbd::Image::flatten)."""
         if self.parent is None:
+            return
+        if await self._journaled({"op": "flatten"}):
             return
         osz = 1 << self.order
         overlap = self.parent["overlap"]
@@ -299,6 +415,8 @@ class Image:
         self.parent = None
 
     async def resize(self, new_size: int) -> None:
+        if await self._journaled({"op": "resize", "size": new_size}):
+            return
         old_size = self.size
         ret, _ = await self.backend.exec(
             _header_oid(self.name), "rbd", "set_size",
@@ -355,6 +473,15 @@ class Image:
     # -- snapshots (REAL data snapshots via the RADOS snap layer) ----------
 
     async def snap_create(self, snap: str) -> int:
+        if self._journal is not None and not self._replay_mode:
+            # validate BEFORE journaling: apply_event tolerates -EEXIST
+            # for crash-replay idempotency, so the live path must raise
+            # it itself (and keep garbage events out of the journal)
+            await self.refresh()
+            if snap in self.snaps:
+                raise IOError("snap_create rc=-17")
+        if await self._journaled({"op": "snap_create", "name": snap}):
+            return self.snaps[snap]["id"]
         ret, out = await self.backend.exec(
             _header_oid(self.name), "rbd", "snap_add", _enc({"name": snap}))
         if ret != 0:
@@ -363,9 +490,15 @@ class Image:
         return _dec(out)
 
     async def snap_remove(self, snap: str) -> None:
+        if self._journal is not None and not self._replay_mode:
+            await self.refresh()
+            if snap not in self.snaps:
+                raise IOError("snap_remove rc=-2")
         ent = self.snaps.get(snap)
         if ent is not None and ent.get("protected"):
             raise PermissionError(f"snap {snap} is protected")
+        if await self._journaled({"op": "snap_remove", "name": snap}):
+            return
         ret, _ = await self.backend.exec(
             _header_oid(self.name), "rbd", "snap_remove",
             _enc({"name": snap}))
@@ -389,9 +522,14 @@ class Image:
     async def snap_rollback(self, snap: str) -> None:
         """Restore the image data+size to the snapshot
         (librbd::Image::snap_rollback)."""
+        if self._journal is not None and not self._replay_mode:
+            await self.refresh()  # stale snaps dict must not journal a
+            # rollback against a dead snap id (same rule as siblings)
         ent = self.snaps.get(snap)
         if ent is None:
             raise FileNotFoundError(f"{self.name}@{snap}")
+        if await self._journaled({"op": "snap_rollback", "name": snap}):
+            return
         max_objs = self.striper.object_count(max(self.size, ent["size"]))
         for object_no in range(max_objs):
             try:
@@ -408,6 +546,12 @@ class Image:
         self.size = ent["size"]
 
     async def snap_protect(self, snap: str) -> None:
+        if self._journal is not None and not self._replay_mode:
+            await self.refresh()
+            if snap not in self.snaps:
+                raise IOError("snap_protect rc=-2")
+        if await self._journaled({"op": "snap_protect", "name": snap}):
+            return
         ret, _ = await self.backend.exec(
             _header_oid(self.name), "rbd", "snap_protect",
             _enc({"name": snap}))
@@ -416,6 +560,21 @@ class Image:
         await self.refresh()
 
     async def snap_unprotect(self, snap: str) -> None:
+        if self._journal is not None and not self._replay_mode:
+            # pre-validate so a doomed op never lands in the journal
+            # (a journaled event that fails to apply would poison every
+            # later replay); the reference records op-finish results
+            await self.refresh()
+            ent = self.snaps.get(snap)
+            if ent is None:
+                raise IOError("snap_unprotect rc=-2")
+            _, out = await self.backend.exec(
+                _header_oid(self.name), "rbd", "get_children",
+                _enc({"snap_id": ent["id"]}))
+            if _dec(out):
+                raise BlockingIOError(f"snap {snap} has clone children")
+        if await self._journaled({"op": "snap_unprotect", "name": snap}):
+            return
         ret, _ = await self.backend.exec(
             _header_oid(self.name), "rbd", "snap_unprotect",
             _enc({"name": snap}))

@@ -1,0 +1,387 @@
+"""Image journaling + rbd-mirror (reference: src/librbd/Journal.cc,
+src/journal client registry, src/tools/rbd_mirror ImageReplayer)."""
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osdc.journaler import Journaler
+from ceph_tpu.rbd import (RBD, FEATURE_JOURNALING, Image, ImageJournal,
+                          MirrorDaemon, mirror_disable, mirror_enable,
+                          mirror_list)
+from ceph_tpu.rbd.journal import journal_name
+from ceph_tpu.utils.perf import PerfCounters
+
+
+def _mk():
+    PerfCounters.reset_all()
+    return ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+
+
+# -- Journaler client registry ----------------------------------------------
+
+
+def test_journaler_named_clients_pin_trim():
+    async def run():
+        c = _mk()
+        j = Journaler(c.backend, "log", object_size=2048)
+        await j.open()
+        for i in range(20):
+            await j.append({"n": i, "pad": b"x" * 300})
+        # a mirror peer registers at position 0 and lags behind
+        await j.register_client("peer", 0)
+        # the master reader consumed everything...
+        await j.committed(j.write_pos)
+        # ...but trim may not pass the slowest client
+        assert await j.trim() == 0
+        # peer consumes half, trim advances only to its position
+        entries = await j.replay_entries(0)
+        mid = entries[len(entries) // 2][1]  # end of entry #10
+        await j.committed(mid, client="peer")
+        assert await j.trim() > 0
+        assert j.expire_pos <= mid
+        # remaining entries still replayable for the peer
+        rest = await j.replay_entries(await j.client_pos("peer"))
+        assert [e["n"] for _, _, e in rest] == list(range(11, 20))
+        await j.unregister_client("peer")
+        assert await j.trim() > 0  # no client left to pin it
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+# -- image journaling --------------------------------------------------------
+
+
+def test_journaled_image_records_and_replays_on_crash():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(c.backend, "img")
+        assert img._journal is not None
+        await img.write(1000, b"hello journal")
+        assert await img.read(1000, 13) == b"hello journal"
+
+        # crash simulation: a writer appends an event to the journal but
+        # dies before applying it -- the data path never saw the write
+        jr = ImageJournal(c.backend, "img")
+        await jr.open()
+        await jr.append({"op": "write", "off": 5000, "data": b"recovered"})
+        assert await img.read(5000, 9) == b"\0" * 9
+
+        # the next open replays the dirty tail (librbd Journal replay)
+        img2 = await Image.open(c.backend, "img")
+        assert await img2.read(5000, 9) == b"recovered"
+        assert await img2.read(1000, 13) == b"hello journal"
+        # and the journal is now clean: a third open applies nothing new
+        jr2 = ImageJournal(c.backend, "img")
+        await jr2.open()
+        assert await jr2.uncommitted() == []
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_journaled_snap_and_resize_events_replay_idempotently():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(c.backend, "img")
+        await img.write(0, b"v1")
+        await img.snap_create("s1")
+        await img.write(0, b"v2")
+        await img.resize(2 << 20)
+        # events were journaled AND applied
+        assert img.size == 2 << 20
+        assert "s1" in img.snaps
+        assert await img.read(0, 2) == b"v2"
+        snap_img = await Image.open(c.backend, "img", snap="s1")
+        assert await snap_img.read(0, 2) == b"v1"
+
+        # crash between apply and commit: re-applying the same snap event
+        # must not fail (librbd Replay tolerates -EEXIST)
+        jr = ImageJournal(c.backend, "img")
+        await jr.open()
+        await jr.append({"op": "snap_create", "name": "s1"})
+        img3 = await Image.open(c.backend, "img")  # replays cleanly
+        assert "s1" in img3.snaps
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_feature_toggle_enables_and_disables_journaling():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16)
+        img = await Image.open(c.backend, "img")
+        assert img._journal is None
+        await img.update_features(enable=[FEATURE_JOURNALING])
+        assert FEATURE_JOURNALING in img.features
+        await img.write(0, b"journaled")
+        jr = ImageJournal(c.backend, "img")
+        await jr.open()
+        assert jr.j.write_pos > 0
+        await img.update_features(disable=[FEATURE_JOURNALING])
+        assert img._journal is None
+        await img.write(0, b"plain few")  # no journal append
+        img2 = await Image.open(c.backend, "img")
+        assert img2._journal is None
+        assert await img2.read(0, 9) == b"plain few"
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_refresh_attaches_journal_enabled_by_other_handle():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16)
+        h1 = await Image.open(c.backend, "img")  # journaling off
+        h2 = await Image.open(c.backend, "img")
+        await h2.update_features(enable=[FEATURE_JOURNALING])
+        # h1 refreshes (e.g. on a header notify) and must start
+        # journaling -- its writes would otherwise never reach a mirror
+        await h1.refresh()
+        assert h1._journal is not None
+        await h1.write(0, b"via h1")
+        jr = ImageJournal(c.backend, "img")
+        await jr.open()
+        assert jr.j.write_pos > 0
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_discard_zeroes_range():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 256 << 10, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(c.backend, "img")
+        await img.write(0, bytes(range(256)) * 16)
+        await img.discard(100, 1000)
+        got = await img.read(0, 4096)
+        assert got[100:1100] == b"\0" * 1000
+        assert got[:100] == (bytes(range(256)) * 16)[:100]
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+# -- rbd-mirror --------------------------------------------------------------
+
+
+def test_mirror_requires_journaling():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("plain", 1 << 20, order=16)
+        with pytest.raises(IOError):
+            await mirror_enable(c.backend, "plain")
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_mirror_bootstrap_and_steady_state_replay():
+    async def run():
+        src = _mk()
+        dst = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        rbd = RBD(src.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(src.backend, "img")
+        await img.write(0, b"pre-mirror data")
+
+        await mirror_enable(src.backend, "img")
+        assert await mirror_list(src.backend) == ["img"]
+        daemon = MirrorDaemon(src.backend, dst.backend)
+        await daemon.run_once()  # bootstraps + replays nothing pending
+
+        dimg = await Image.open(dst.backend, "img")
+        assert await dimg.read(0, 15) == b"pre-mirror data"
+
+        # steady state: new writes/snaps/resizes flow through the journal
+        await img.write(70000, b"incremental")  # crosses object 1
+        await img.snap_create("s1")
+        await img.write(70000, b"INCREMENTAL")
+        await img.resize(2 << 20)
+        applied = await daemon.run_once()
+        assert applied["img"] >= 4
+
+        dimg = await Image.open(dst.backend, "img")
+        assert dimg.size == 2 << 20
+        assert await dimg.read(70000, 11) == b"INCREMENTAL"
+        assert "s1" in dimg.snaps
+        dsnap = await Image.open(dst.backend, "img", snap="s1")
+        assert await dsnap.read(70000, 11) == b"incremental"
+
+        st = await daemon.status()
+        assert st["img"]["state"] == "up+replaying"
+        assert st["img"]["entries_behind"] == 0
+        await src.shutdown()
+        await dst.shutdown()
+
+    asyncio.run(run())
+
+
+def test_mirror_peer_position_survives_daemon_restart():
+    async def run():
+        src = _mk()
+        dst = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        rbd = RBD(src.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(src.backend, "img")
+        await mirror_enable(src.backend, "img")
+        d1 = MirrorDaemon(src.backend, dst.backend)
+        await d1.run_once()
+        await img.write(0, b"first")
+        await d1.run_once()
+
+        # a NEW daemon process resumes from the persisted client position
+        await img.write(0, b"SECON")
+        d2 = MirrorDaemon(src.backend, dst.backend)
+        applied = await d2.run_once()
+        assert applied["img"] >= 1
+        dimg = await Image.open(dst.backend, "img")
+        assert await dimg.read(0, 5) == b"SECON"
+        await src.shutdown()
+        await dst.shutdown()
+
+    asyncio.run(run())
+
+def test_journaled_snap_create_duplicate_still_raises():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(c.backend, "img")
+        await img.snap_create("s1")
+        # the live path must raise -EEXIST exactly like the plain path
+        # (apply_event only tolerates it during crash replay)
+        with pytest.raises(IOError):
+            await img.snap_create("s1")
+        with pytest.raises(IOError):
+            await img.snap_remove("nope")
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_remove_journaled_image_drops_journal():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(c.backend, "img")
+        await img.write(0, b"doomed data")
+        # leave a dirty tail (writer crash) then delete the image
+        jr = ImageJournal(c.backend, "img")
+        await jr.open()
+        await jr.append({"op": "write", "off": 64, "data": b"ghost"})
+        await rbd.remove("img")
+        # a recreated same-name image must NOT replay the dead image's
+        # journal tail
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img2 = await Image.open(c.backend, "img")
+        assert await img2.read(0, 11) == b"\0" * 11
+        assert await img2.read(64, 5) == b"\0" * 5
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_disable_journaling_refused_while_mirrored_then_cleans_up():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(c.backend, "img")
+        await img.write(0, b"x" * 4096)
+        await mirror_enable(c.backend, "img")
+        with pytest.raises(BlockingIOError):
+            await img.update_features(disable=[FEATURE_JOURNALING])
+        # disabling mirroring deregisters the peer; then the feature can
+        # go, and the journal objects (incl. the tail) are removed
+        await mirror_disable(c.backend, "img")
+        await img.update_features(disable=[FEATURE_JOURNALING])
+        try:
+            left = await c.backend.omap_get(f"{journal_name('img')}.journal")
+        except (FileNotFoundError, IOError):
+            left = {}
+        assert left == {}  # no pointers, no client registry left behind
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_daemon_restart_skips_bootstrap_copy(monkeypatch):
+    async def run():
+        src = _mk()
+        dst = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        rbd = RBD(src.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(src.backend, "img")
+        await mirror_enable(src.backend, "img")
+        d1 = MirrorDaemon(src.backend, dst.backend)
+        await d1.run_once()
+
+        # the registered peer client is the durable marker: a fresh
+        # daemon must resume replay without re-copying the image
+        from ceph_tpu.rbd.mirror import ImageReplayer
+
+        async def boom(self):
+            raise AssertionError("re-bootstrap after restart")
+
+        monkeypatch.setattr(ImageReplayer, "bootstrap", boom)
+        await img.write(0, b"after restart")
+        d2 = MirrorDaemon(src.backend, dst.backend)
+        await d2.run_once()
+        dimg = await Image.open(dst.backend, "img")
+        assert await dimg.read(0, 13) == b"after restart"
+        await src.shutdown()
+        await dst.shutdown()
+
+    asyncio.run(run())
+
+
+def test_mirror_peer_pins_journal_trim():
+    async def run():
+        src = _mk()
+        rbd = RBD(src.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img = await Image.open(src.backend, "img")
+        jr = ImageJournal(src.backend, "img")
+        await jr.open()
+        await jr.register_peer("mirror-peer", 0)
+        # image-side appends commit the master position as they apply,
+        # but the registered (never-replaying) peer pins trim at 0
+        # enough payload that the journal spans several 1 MiB objects
+        # (trim drops whole objects only)
+        for i in range(40):
+            await img.write(0, b"Z" * 65536)
+        await jr.open()  # refresh header: master commit is at the head
+        assert jr.j.commit_pos == jr.j.write_pos > 0
+        assert await jr.trim() == 0
+        # peer deregisters -> the journal can finally expire
+        await jr.unregister_peer("mirror-peer")
+        assert await jr.trim() > 0
+        await src.shutdown()
+
+    asyncio.run(run())
