@@ -114,14 +114,20 @@ async def set_features(ctx, inp: bytes):
     """Enable/disable named features (reference cls_rbd set_features:
     librbd dynamic feature toggling, e.g. journaling on/off)."""
     req = _dec(inp)
-    omap = await ctx.omap_get(["features", "size"])
-    if "size" not in omap:
-        return -2, b""
-    feats = set(_dec(omap.get("features")) or [])
-    feats |= set(req.get("enable", []))
-    feats -= set(req.get("disable", []))
-    await ctx.omap_set({"features": _enc(sorted(feats))})
-    return 0, b""
+    for _ in range(16):
+        omap = await ctx.omap_get(["features", "size"])
+        if "size" not in omap:
+            return -2, b""
+        cur_raw = omap.get("features")
+        feats = set(_dec(cur_raw) or [])
+        feats |= set(req.get("enable", []))
+        feats -= set(req.get("disable", []))
+        # CAS like every RMW in this class: cls methods interleave at
+        # awaits, and a lost feature bit silently bypasses journaling
+        ok, _ = await ctx.omap_cas("features", cur_raw, _enc(sorted(feats)))
+        if ok:
+            return 0, b""
+    return -11, b""
 
 
 @register("rbd", "metadata_set")

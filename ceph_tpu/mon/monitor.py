@@ -15,6 +15,9 @@ from typing import Dict, List, Optional
 
 from ceph_tpu.mon.osdmap import OSDMap
 from ceph_tpu.mon.paxos import Paxos
+import copy
+
+from ceph_tpu.mon.services import ClusterLog, ConfigKeyStore, ConfigStore
 from ceph_tpu.osd.messenger import Messenger
 from ceph_tpu.utils.log import dout
 
@@ -27,6 +30,11 @@ class Monitor:
         self.messenger = messenger
         self.paxos = Paxos(rank, n_mons, self._send_to_rank, self._on_commit)
         self.osdmap = OSDMap()
+        # the PaxosService family: slices of replicated state sharing
+        # the one paxos instance (src/mon/PaxosService.h)
+        self.kvstore = ConfigKeyStore()
+        self.configdb = ConfigStore()
+        self.clog = ClusterLog()
         self.leader: Optional[int] = None
         self.quorum: List[int] = []
         self.election_epoch = 0
@@ -187,17 +195,41 @@ class Monitor:
     # -- committed-state application ---------------------------------------
 
     def _on_commit(self, v: int, value: dict) -> None:
-        self.osdmap.apply(value["inc"])
+        inc = value["inc"]
+        op = inc.get("op", "")
+        if op.startswith("kv_"):
+            self.kvstore.apply(inc)
+            return
+        if op.startswith("config_"):
+            self.configdb.apply(inc)
+            # runtime config distribution: every commit pushes the new
+            # sections to subscribers (MonClient config notifications);
+            # daemons pick their own entity_view out of it
+            self._push_to_subscribers({
+                "type": "config",
+                "version": self.configdb.version,
+                "sections": self.configdb.dump(),
+            })
+            return
+        if op == "clog_append":
+            self.clog.apply(inc)
+            return
+        self.osdmap.apply(inc)
         # every mon pushes to its own subscribers (clients subscribe to all
         # mons and dedup by epoch) — gating on is_leader() here would drop
         # broadcasts when leadership flickers mid-commit during elections
+        self._push_to_subscribers(
+            {"type": "osdmap", "map": self.osdmap.to_dict()}
+        )
+
+    def _push_to_subscribers(self, msg: dict) -> None:
         for sub in list(self._subscribers):
+            # deep copy per subscriber: the in-process messenger passes
+            # dicts by reference, and a receiver mutating its nested
+            # map must not corrupt what the others see
             asyncio.get_event_loop().create_task(
-                self.messenger.send_message(
-                    self.name,
-                    sub,
-                    {"type": "osdmap", "map": self.osdmap.to_dict()},
-                )
+                self.messenger.send_message(self.name, sub,
+                                            copy.deepcopy(msg))
             )
 
     # -- commands (OSDMonitor analogue) ------------------------------------
@@ -315,6 +347,55 @@ class Monitor:
             }
             ok = await self._propose({"op": "pool_create", "pool": pool})
             return (0, pool) if ok else (-11, "no quorum")
+        # -- ConfigKeyService (src/mon/ConfigKeyService.cc) ----------------
+        if prefix == "config-key set":
+            ok = await self._propose(
+                {"op": "kv_set", "key": cmd["key"], "value": cmd["value"]})
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "config-key get":
+            v = self.kvstore.kv.get(cmd["key"])
+            return (0, v) if v is not None else (-2, "not found")
+        if prefix == "config-key rm":
+            ok = await self._propose({"op": "kv_rm", "key": cmd["key"]})
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "config-key ls":
+            return 0, sorted(self.kvstore.kv)
+        if prefix == "config-key exists":
+            return (0, "") if cmd["key"] in self.kvstore.kv \
+                else (-2, "not found")
+        # -- centralized config (ConfigMonitor role) -----------------------
+        if prefix == "config set":
+            ok = await self._propose({
+                "op": "config_set", "who": cmd["who"],
+                "name": cmd["name"], "value": str(cmd["value"]),
+            })
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "config rm":
+            ok = await self._propose({
+                "op": "config_rm", "who": cmd["who"], "name": cmd["name"]})
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "config get":
+            return 0, self.configdb.entity_view(cmd["who"])
+        if prefix == "config dump":
+            return 0, self.configdb.dump()
+        # -- cluster log (LogMonitor) --------------------------------------
+        if prefix == "log":
+            level = cmd.get("level", "info")
+            if level not in ClusterLog.LEVELS:
+                return -22, f"bad level {level!r} (want one of " \
+                            f"{'/'.join(ClusterLog.LEVELS)})"
+            ok = await self._propose({
+                "op": "clog_append", "who": cmd.get("who", "client"),
+                "level": level,
+                "message": cmd.get("message", ""),
+                "stamp": cmd.get("stamp", 0.0),
+            })
+            return (0, "logged") if ok else (-11, "no quorum")
+        if prefix == "log last":
+            level = cmd.get("level")
+            if level is not None and level not in ClusterLog.LEVELS:
+                return -22, f"bad level {level!r}"
+            return 0, self.clog.last(cmd.get("num", 20), level)
         if prefix in ("osd out", "osd in", "osd down", "osd up"):
             inc = {"op": f"osd_{prefix.split()[1]}", "osd": cmd["osd"]}
             if prefix == "osd in" and "weight" in cmd:

@@ -223,7 +223,13 @@ class Objecter:
                 if self.messenger.is_down(primary):
                     return None
                 if not await self._probe(primary):
-                    return None
+                    # re-probe before failing over: one missed connect
+                    # under host load must not demote a live primary
+                    # (the reference needs several missed heartbeats
+                    # before an osd is reported failed, OSD.cc
+                    # handle_osd_ping grace)
+                    if not await self._probe(primary):
+                        return None
 
     # -- I/O surface (librados IoCtx ops, one round trip each) -------------
 

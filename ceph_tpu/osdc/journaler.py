@@ -71,13 +71,6 @@ class Journaler:
         self.expire_pos = _dec(omap["expire_pos"])
         self.commit_pos = _dec(omap["commit_pos"])
 
-    async def _save_header(self) -> None:
-        await self.backend.omap_set(self._header, {
-            "write_pos": _enc(self.write_pos),
-            "expire_pos": _enc(self.expire_pos),
-            "commit_pos": _enc(self.commit_pos),
-        })
-
     # -- append (Journaler::append_entry + flush) --------------------------
 
     async def append(self, entry) -> int:
@@ -93,7 +86,11 @@ class Journaler:
         objno, off = divmod(start, osz)
         await self.backend.write_range(self._data(objno), off, rec)
         self.write_pos = start + len(rec)
-        await self._save_header()
+        # persist only the field this writer owns: the header is shared
+        # with committers and trimmers (e.g. a mirror daemon) whose
+        # in-memory copies of the OTHER pointers may be stale
+        await self.backend.omap_set(
+            self._header, {"write_pos": _enc(self.write_pos)})
         return start
 
     # -- replay (Journaler::read_entry loop) -------------------------------
@@ -179,12 +176,19 @@ class Journaler:
                     self._header, {f"client.{client}": _enc(pos)})
             return
         self.commit_pos = max(self.commit_pos, pos)
-        await self._save_header()
+        await self.backend.omap_set(
+            self._header, {"commit_pos": _enc(self.commit_pos)})
 
     async def trim(self) -> int:
         """Drop whole journal objects below the commit position
         (expire); returns objects removed.  A lagging registered client
-        pins the journal: trim never passes the slowest consumer."""
+        pins the journal: trim never passes the slowest consumer.
+
+        Re-reads the header first and writes back only expire_pos:
+        trimmers (a mirror daemon tick) share the header with the live
+        appender, and persisting stale write/commit pointers here would
+        roll back committed appends."""
+        await self.open()
         osz = self.object_size
         floor = min([self.commit_pos]
                     + list((await self.clients()).values()))
@@ -198,5 +202,6 @@ class Journaler:
                 pass
         if target > self.expire_pos:
             self.expire_pos = target
-            await self._save_header()
+            await self.backend.omap_set(
+                self._header, {"expire_pos": _enc(target)})
         return removed

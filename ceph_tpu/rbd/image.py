@@ -94,6 +94,22 @@ class RBD:
 
     async def remove(self, name: str) -> None:
         img = await Image.open(self.backend, name)
+        # refuse while mirroring (or any journal consumer) depends on
+        # the image -- destroying the journal under a registered peer
+        # would leave a dangling enrollment that breaks every daemon
+        # tick (same guard as update_features)
+        try:
+            mdir = await self.backend.omap_get(MIRROR_DIR_OID)
+        except FileNotFoundError:
+            mdir = {}
+        if f"image_{name}" in mdir:
+            raise BlockingIOError(
+                f"image {name} is mirror-enabled; disable mirroring first")
+        if img._journal is not None:
+            clients = await img._journal.j.clients()
+            if clients:
+                raise BlockingIOError(
+                    f"journal has registered clients: {sorted(clients)}")
         if img.snaps:
             # the reference refuses too: deleting the head would orphan
             # the snap clone objects with no way to ever trim them --

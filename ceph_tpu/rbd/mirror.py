@@ -72,6 +72,7 @@ class ImageReplayer:
         self.image = image
         self.peer_id = peer_id
         self._bootstrapped = False
+        self.last_error: str = ""
 
     async def bootstrap(self) -> None:
         """Create the local image, deep-copy the snapshot history
@@ -94,13 +95,15 @@ class ImageReplayer:
         start_pos = jr.j.write_pos
         src_img = await Image.open(self.src, self.image)
         dst_rbd = RBD(self.dst)
+        fresh = True
         try:
             await dst_rbd.create(self.image, src_img.size,
                                  order=src_img.order)
         except FileExistsError:
-            pass
+            # a prior partial bootstrap may have left data: every block
+            # must be rewritten, including zeros over stale bytes
+            fresh = False
         dst_img = await Image.open(self.dst, self.image)
-        fresh = True
         for name, ent in sorted(src_img.snaps.items(),
                                 key=lambda kv: kv[1]["id"]):
             view = await Image.open(self.src, self.image, snap=name)
@@ -157,12 +160,12 @@ class ImageReplayer:
             else:
                 await self.bootstrap()
         entries = await jr.peer_entries(self.peer_id)
-        if not entries:
-            return 0
-        dst_img = await Image.open(self.dst, self.image)
-        for _start, end, ev in entries:
-            await apply_event(dst_img, ev)
-            await jr.peer_committed(self.peer_id, end)
+        if entries:
+            dst_img = await Image.open(self.dst, self.image)
+            for _start, end, ev in entries:
+                await apply_event(dst_img, ev)
+                await jr.peer_committed(self.peer_id, end)
+        await jr.trim()  # reuse this handle; consumed objects can go
         return len(entries)
 
     async def entries_behind(self) -> int:
@@ -198,17 +201,23 @@ class MirrorDaemon:
             if rep is None:
                 rep = self.replayers[image] = ImageReplayer(
                     self.src, self.dst, image, self.peer_id)
-            applied[image] = await rep.replay_once()
-            jr = ImageJournal(self.src, image)
-            await jr.open()
-            await jr.trim()
+            try:
+                applied[image] = await rep.replay_once()
+                rep.last_error = ""
+            except (FileNotFoundError, IOError) as e:
+                # one broken image (deleted source, unreachable pool)
+                # must not abort replay of every other image this tick
+                rep.last_error = str(e) or type(e).__name__
+                applied[image] = 0
         return applied
 
     async def status(self) -> Dict[str, dict]:
         out: Dict[str, dict] = {}
         for image in await mirror_list(self.src):
             rep = self.replayers.get(image)
-            if rep is None or not rep._bootstrapped:
+            if rep is not None and rep.last_error:
+                out[image] = {"state": "error", "error": rep.last_error}
+            elif rep is None or not rep._bootstrapped:
                 out[image] = {"state": "starting_replay"}
             else:
                 behind = await rep.entries_behind()

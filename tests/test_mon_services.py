@@ -1,0 +1,155 @@
+"""PaxosService family: ConfigKeyService, centralized config with
+runtime push, cluster log (reference: src/mon/ConfigKeyService.cc,
+src/mon/ConfigMonitor role, src/mon/LogMonitor.cc +
+src/common/LogClient.cc)."""
+
+from __future__ import annotations
+
+import asyncio
+
+from ceph_tpu.mon.monitor import MonClient, MonCluster
+from ceph_tpu.mon.services import ClusterLog, LogClient
+from ceph_tpu.osd.messenger import Messenger
+
+
+def run(coro):
+    return asyncio.run(coro)
+
+
+def _client(ms, name):
+    cl = MonClient(ms, 3, name)
+    extra = []
+
+    async def dispatch(src, msg):
+        if isinstance(msg, dict) and not await cl.handle_reply(msg):
+            extra.append(msg)
+
+    ms.register(name, dispatch)
+    return cl, extra
+
+
+def test_config_key_store_replicates_and_survives_failover():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl, _ = _client(ms, "client0")
+        rc, _out = await cl.command(
+            {"prefix": "config-key set", "key": "mgr/dash/ssl", "value": "no"})
+        assert rc == 0
+        rc, _out = await cl.command(
+            {"prefix": "config-key set", "key": "rgw/zone", "value": "za"})
+        assert rc == 0
+        rc, out = await cl.command(
+            {"prefix": "config-key get", "key": "rgw/zone"})
+        assert (rc, out) == (0, "za")
+        rc, out = await cl.command({"prefix": "config-key ls"})
+        assert out == ["mgr/dash/ssl", "rgw/zone"]
+        await asyncio.sleep(0.1)
+        # replicated: every mon's kv slice has the data
+        for mon in mc.mons:
+            assert mon.kvstore.kv["rgw/zone"] == "za"
+        # leader dies; the KV survives on the new leader
+        mc.kill(0)
+        await mc.mons[1].start_election()
+        leader = await mc.wait_for_leader()
+        assert leader.rank == 1
+        rc, out = await cl.command(
+            {"prefix": "config-key get", "key": "mgr/dash/ssl"})
+        assert (rc, out) == (0, "no")
+        rc, _out = await cl.command(
+            {"prefix": "config-key rm", "key": "rgw/zone"})
+        assert rc == 0
+        rc, _out = await cl.command(
+            {"prefix": "config-key exists", "key": "rgw/zone"})
+        assert rc == -2
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_centralized_config_sections_merge_and_push():
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl, extra = _client(ms, "client0")
+        await cl.subscribe()
+        await asyncio.sleep(0.05)
+        extra.clear()  # drop the initial osdmap pushes
+        for who, name, value in [
+            ("global", "debug_level", "1"),
+            ("osd", "osd_recovery_max_chunk", "1048576"),
+            ("osd.3", "osd_recovery_max_chunk", "65536"),
+            ("osd", "debug_level", "5"),
+        ]:
+            rc, _out = await cl.command({
+                "prefix": "config set", "who": who,
+                "name": name, "value": value,
+            })
+            assert rc == 0
+        # precedence: global < type < daemon name (the reference's mask
+        # specificity order)
+        rc, view = await cl.command({"prefix": "config get", "who": "osd.3"})
+        assert view == {"debug_level": "5",
+                        "osd_recovery_max_chunk": "65536"}
+        rc, view = await cl.command({"prefix": "config get", "who": "osd.7"})
+        assert view == {"debug_level": "5",
+                        "osd_recovery_max_chunk": "1048576"}
+        rc, view = await cl.command({"prefix": "config get", "who": "mon.0"})
+        assert view == {"debug_level": "1"}
+        # runtime distribution: each commit pushed the sections to the
+        # subscriber
+        await asyncio.sleep(0.1)
+        pushes = [m for m in extra if m.get("type") == "config"]
+        assert pushes, "no config push received"
+        last = pushes[-1]["sections"]
+        assert last["osd.3"] == {"osd_recovery_max_chunk": "65536"}
+        # rm empties the section away entirely
+        rc, _out = await cl.command({
+            "prefix": "config rm", "who": "osd.3",
+            "name": "osd_recovery_max_chunk"})
+        assert rc == 0
+        rc, dump = await cl.command({"prefix": "config dump"})
+        assert "osd.3" not in dump
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_cluster_log_sequenced_filtered_and_bounded(monkeypatch):
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl, _ = _client(ms, "client0")
+        clog = LogClient(cl, "osd.1")
+        await clog.info("osd.1 booted")
+        await clog.warn("slow request")
+        await clog.error("chunk crc mismatch on shard 2")
+        # sent through ANY mon (hunting) but sequenced by the leader
+        rc, out = await cl.command({"prefix": "log last", "num": 10})
+        assert rc == 0
+        assert [e["message"] for e in out] == [
+            "osd.1 booted", "slow request", "chunk crc mismatch on shard 2"]
+        assert [e["seq"] for e in out] == [1, 2, 3]
+        assert all(e["who"] == "osd.1" for e in out)
+        # level filter: `ceph log last 10 error`
+        rc, out = await cl.command(
+            {"prefix": "log last", "num": 10, "level": "error"})
+        assert [e["message"] for e in out] == [
+            "chunk crc mismatch on shard 2"]
+        # replicated to every mon
+        await asyncio.sleep(0.1)
+        for mon in mc.mons:
+            assert mon.clog.seq == 3
+        # the ring is bounded
+        monkeypatch.setattr(ClusterLog, "MAX_ENTRIES", 5)
+        for i in range(8):
+            await clog.info(f"spam {i}")
+        leader = await mc.wait_for_leader()
+        assert len(leader.clog.entries) == 5
+        assert leader.clog.entries[-1]["message"] == "spam 7"
+        await ms.shutdown()
+
+    run(main())

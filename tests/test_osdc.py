@@ -76,7 +76,9 @@ def test_journaler_torn_tail_stops_replay():
         await c.backend.write_range(f"j.journal.{objno:08x}", off,
                                     b"\x01\x02\x03")
         j.write_pos += 40
-        await j._save_header()
+        from ceph_tpu.osdc.journaler import _enc
+        await c.backend.omap_set(
+            "j.journal", {"write_pos": _enc(j.write_pos)})
         j2 = Journaler(c.backend, "j", object_size=4096)
         await j2.open()
         entries = await j2.replay()
