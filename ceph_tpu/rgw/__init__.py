@@ -16,6 +16,6 @@ lands in data-pool objects, user/bucket metadata lives in meta objects
   the (EC) data pool.
 """
 
-from ceph_tpu.rgw.gateway import RGWGateway, sign_v2
+from ceph_tpu.rgw.gateway import RGWGateway, sign_v2, sign_v4
 
-__all__ = ["RGWGateway", "sign_v2"]
+__all__ = ["RGWGateway", "sign_v2", "sign_v4"]

@@ -4,9 +4,11 @@ rgw_rest_s3.cc, rgw_rados.cc).
 Supported S3 surface: service list (GET /), bucket create/delete/list
 (PUT/DELETE/GET /<bucket>), object put/get/head/delete
 (PUT/GET/HEAD/DELETE /<bucket>/<key>), prefix-filtered listing, ETags
-(md5, as S3 defines for single-part uploads), AWS-v2 HMAC auth
-(Authorization: AWS <access>:<sig> over the canonical string), and the
-matching S3 XML error envelopes (NoSuchBucket, NoSuchKey,
+(md5, as S3 defines for single-part uploads), multipart uploads
+(initiate/part/complete/abort/list with the md5-of-md5s "-N" ETag),
+AWS-v2 HMAC auth AND AWS SigV4 (AWS4-HMAC-SHA256 canonical
+request/signing-key chain, signed or UNSIGNED-PAYLOAD), and the
+matching S3 XML error envelopes (NoSuchBucket, NoSuchKey, NoSuchUpload,
 SignatureDoesNotMatch, BucketAlreadyExists, BucketNotEmpty,
 AccessDenied).
 """
@@ -17,6 +19,7 @@ import asyncio
 import base64
 import hashlib
 import hmac
+import re
 import time
 from typing import Dict, Optional, Tuple
 from xml.sax.saxutils import escape
@@ -33,12 +36,46 @@ def obj_oid(bucket: str, key: str) -> str:
     return f"rgw.obj.{bucket}/{key}"
 
 
+def uploads_oid(bucket: str) -> str:
+    # disjoint prefix: "rgw.bucket.<b>.uploads" would collide with the
+    # index of a bucket literally named "<b>.uploads" (dots are legal)
+    return f"rgw.uploads.{bucket}"
+
+
 def sign_v2(secret: str, method: str, resource: str, date: str,
             content_type: str = "", content_md5: str = "") -> str:
     """AWS signature v2 (the rgw_auth_s3.cc canonical string)."""
     to_sign = "\n".join([method, content_md5, content_type, date, resource])
     mac = hmac.new(secret.encode(), to_sign.encode(), hashlib.sha1)
     return base64.b64encode(mac.digest()).decode()
+
+
+def _hmac256(key: bytes, msg: str) -> bytes:
+    return hmac.new(key, msg.encode(), hashlib.sha256).digest()
+
+
+def sign_v4(secret: str, method: str, path: str, params: Dict[str, str],
+            headers: Dict[str, str], signed_headers: str,
+            payload_hash: str, amz_date: str,
+            region: str = "default") -> str:
+    """AWS signature v4 (rgw_auth_s3.cc get_v4_canonical_* chain).
+    ``signed_headers`` is the semicolon-joined lowercase header list;
+    ``payload_hash`` is the value of x-amz-content-sha256 (a hex digest
+    or the UNSIGNED-PAYLOAD literal)."""
+    canonical_q = "&".join(
+        f"{k}={v}" for k, v in sorted(params.items()))
+    names = signed_headers.split(";")
+    canonical_h = "".join(
+        f"{h}:{headers.get(h, '').strip()}\n" for h in names)
+    creq = "\n".join([method, path, canonical_q, canonical_h,
+                      signed_headers, payload_hash])
+    scope = f"{amz_date[:8]}/{region}/s3/aws4_request"
+    sts = "\n".join(["AWS4-HMAC-SHA256", amz_date, scope,
+                     hashlib.sha256(creq.encode()).hexdigest()])
+    k = _hmac256(b"AWS4" + secret.encode(), amz_date[:8])
+    for piece in (region, "s3", "aws4_request"):
+        k = _hmac256(k, piece)
+    return hmac.new(k, sts.encode(), hashlib.sha256).hexdigest()
 
 
 def _xml_error(code: str, message: str) -> str:
@@ -57,6 +94,7 @@ _ERROR_STATUS = {
     "SignatureDoesNotMatch": "403 Forbidden",
     "AccessDenied": "403 Forbidden",
     "InvalidRequest": "400 Bad Request",
+    "NoSuchUpload": "404 Not Found",
 }
 
 
@@ -147,8 +185,13 @@ class RGWGateway:
     # -- request routing (RGWHandler_REST_S3 dispatch) ---------------------
 
     async def _auth(self, method: str, resource: str,
-                    headers: Dict[str, str]) -> str:
+                    headers: Dict[str, str],
+                    path: str = "", params: Optional[Dict[str, str]] = None,
+                    body: bytes = b"") -> str:
         auth = headers.get("authorization", "")
+        if auth.startswith("AWS4-HMAC-SHA256 "):
+            return await self._auth_v4(auth, method, path, params or {},
+                                       headers, body)
         if not auth.startswith("AWS "):
             raise S3Error("AccessDenied", "missing AWS authorization")
         try:
@@ -164,6 +207,42 @@ class RGWGateway:
         )
         if not hmac.compare_digest(want, sig):
             raise S3Error("SignatureDoesNotMatch", "bad signature")
+        return access
+
+    async def _auth_v4(self, auth: str, method: str, path: str,
+                       params: Dict[str, str], headers: Dict[str, str],
+                       body: bytes) -> str:
+        """AWS SigV4 verification (rgw_auth_s3.cc AWSv4ComplMulti /
+        get_v4_canonical_method): rebuild the canonical request from
+        what actually arrived and compare signatures."""
+        fields: Dict[str, str] = {}
+        for piece in auth[len("AWS4-HMAC-SHA256 "):].split(","):
+            k, _, v = piece.strip().partition("=")
+            fields[k] = v
+        try:
+            cred = fields["Credential"]
+            signed_headers = fields["SignedHeaders"]
+            sig = fields["Signature"]
+            access, datestamp, region, svc, term = cred.split("/")
+        except (KeyError, ValueError):
+            raise S3Error("InvalidRequest", "malformed v4 authorization")
+        if (svc, term) != ("s3", "aws4_request"):
+            raise S3Error("InvalidRequest", f"bad credential scope {cred!r}")
+        secret = await self._secret_for(access)
+        if secret is None:
+            raise S3Error("AccessDenied", f"no such access key {access!r}")
+        amz_date = headers.get("x-amz-date", "")
+        if not amz_date.startswith(datestamp):
+            raise S3Error("InvalidRequest", "x-amz-date outside scope")
+        payload_hash = headers.get("x-amz-content-sha256",
+                                   "UNSIGNED-PAYLOAD")
+        if payload_hash not in ("UNSIGNED-PAYLOAD",
+                                hashlib.sha256(body).hexdigest()):
+            raise S3Error("SignatureDoesNotMatch", "payload hash mismatch")
+        want = sign_v4(secret, method, path, params, headers,
+                       signed_headers, payload_hash, amz_date, region)
+        if not hmac.compare_digest(want, sig):
+            raise S3Error("SignatureDoesNotMatch", "bad v4 signature")
         return access
 
     @staticmethod
@@ -193,7 +272,9 @@ class RGWGateway:
     async def _handle(self, method, target, headers, body):
         bucket, key, params = self._split_target(target)
         resource = "/" + bucket + ("/" + key if key else "")
-        owner = await self._auth(method, resource, headers)
+        path = target.partition("?")[0]
+        owner = await self._auth(method, resource, headers,
+                                 path=path, params=params, body=body)
         if not bucket:
             if method == "GET":
                 return await self._list_buckets(owner)
@@ -205,11 +286,31 @@ class RGWGateway:
             if method == "DELETE":
                 return await self._delete_bucket(bucket)
             if method == "GET":
+                if "uploads" in params:
+                    return await self._list_uploads(bucket)
                 return await self._list_objects(
                     bucket, params.get("prefix", "")
                 )
             raise S3Error("InvalidRequest", f"{method} on bucket")
         await self._check_owner(bucket, owner)
+        # multipart upload surface (rgw_multipart: initiate/part/
+        # complete/abort)
+        if method == "POST" and "uploads" in params:
+            return await self._initiate_multipart(bucket, key)
+        if method == "POST" and "uploadId" in params:
+            return await self._complete_multipart(
+                bucket, key, params["uploadId"], body)
+        if method == "PUT" and "uploadId" in params:
+            try:
+                part = int(params.get("partNumber", "0"))
+            except ValueError:
+                raise S3Error("InvalidRequest",
+                              f"bad partNumber {params['partNumber']!r}")
+            return await self._upload_part(
+                bucket, key, params["uploadId"], part, body)
+        if method == "DELETE" and "uploadId" in params:
+            return await self._abort_multipart(
+                bucket, key, params["uploadId"])
         if method == "PUT":
             return await self._put_object(bucket, key, body)
         if method == "GET":
@@ -258,6 +359,21 @@ class RGWGateway:
         index = await self.backend.omap_get(bucket_index_oid(bucket))
         if index:
             raise S3Error("BucketNotEmpty", bucket)
+        # abort any in-progress multipart uploads: leaving their parts
+        # behind would let a future same-name bucket's owner complete
+        # the previous tenant's upload and read its data
+        try:
+            ups = await self.backend.omap_get(uploads_oid(bucket))
+        except (FileNotFoundError, IOError):
+            ups = {}
+        for upload_id, raw_key in ups.items():
+            key = raw_key.decode()
+            try:
+                meta = await self.backend.omap_get(
+                    self._mp_meta_oid(bucket, key, upload_id))
+                await self._drop_upload(bucket, key, upload_id, meta)
+            except (FileNotFoundError, IOError):
+                pass
         await self.backend.omap_rm(BUCKETS_OID, [bucket])
         return "204 No Content", "application/xml", b"", {}
 
@@ -317,6 +433,143 @@ class RGWGateway:
         return "200 OK", "application/octet-stream", b"", {
             "ETag": f'"{etag}"', "X-Object-Size": str(size),
         }
+
+    # -- multipart upload (reference rgw multipart meta objects:
+    # RGWMultipartUpload in rgw_multi.cc -- an upload id names a meta
+    # object tracking parts; complete concatenates them and the S3
+    # multipart ETag is md5-of-part-md5s with a part count suffix) -----
+
+    _upload_counter = 0
+
+    @staticmethod
+    def _mp_meta_oid(bucket: str, key: str, upload_id: str) -> str:
+        return f"rgw.mp.{bucket}/{key}.{upload_id}"
+
+    @staticmethod
+    def _mp_part_oid(bucket: str, key: str, upload_id: str,
+                     part: int) -> str:
+        return f"rgw.mp.{bucket}/{key}.{upload_id}.{part:05d}"
+
+    async def _initiate_multipart(self, bucket: str, key: str):
+        RGWGateway._upload_counter += 1
+        upload_id = hashlib.md5(
+            f"{bucket}/{key}/{time.time()}/{self._upload_counter}".encode()
+        ).hexdigest()
+        await self.backend.omap_set(
+            self._mp_meta_oid(bucket, key, upload_id),
+            {"_meta": f"{int(time.time())}".encode()})
+        # track in-progress uploads on the bucket (list-uploads surface)
+        await self.backend.omap_set(uploads_oid(bucket), {
+            upload_id: key.encode()})
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<InitiateMultipartUploadResult>"
+            f"<Bucket>{escape(bucket)}</Bucket><Key>{escape(key)}</Key>"
+            f"<UploadId>{upload_id}</UploadId>"
+            "</InitiateMultipartUploadResult>"
+        )
+        return "200 OK", "application/xml", xml.encode(), {}
+
+    async def _upload_meta(self, bucket: str, key: str, upload_id: str):
+        meta = await self.backend.omap_get(
+            self._mp_meta_oid(bucket, key, upload_id))
+        if "_meta" not in meta:
+            raise S3Error("NoSuchUpload", upload_id)
+        return meta
+
+    async def _upload_part(self, bucket: str, key: str, upload_id: str,
+                           part: int, body: bytes):
+        if part < 1 or part > 10000:
+            raise S3Error("InvalidRequest", f"bad part number {part}")
+        await self._upload_meta(bucket, key, upload_id)
+        etag = hashlib.md5(body).hexdigest()
+        await self.backend.write(
+            self._mp_part_oid(bucket, key, upload_id, part), body)
+        await self.backend.omap_set(
+            self._mp_meta_oid(bucket, key, upload_id),
+            {f"part.{part:05d}": f"{len(body)}\x00{etag}".encode()})
+        return "200 OK", "application/xml", b"", {"ETag": f'"{etag}"'}
+
+    async def _complete_multipart(self, bucket: str, key: str,
+                                  upload_id: str, body: bytes):
+        meta = await self._upload_meta(bucket, key, upload_id)
+        parts = sorted(
+            (int(k.split(".")[1]), v.decode().split("\x00"))
+            for k, v in meta.items() if k.startswith("part."))
+        if not parts:
+            raise S3Error("InvalidRequest", "no parts uploaded")
+        # honor the client's part list when provided (S3 allows
+        # completing with a subset); minimal XML scrape
+        listed = [int(n) for n in re.findall(
+            r"<PartNumber>(\d+)</PartNumber>", body.decode("utf-8",
+                                                           "ignore"))]
+        if listed:
+            chosen = set(listed)
+            missing = chosen - {p for p, _ in parts}
+            if missing:
+                raise S3Error("InvalidRequest",
+                              f"parts never uploaded: {sorted(missing)}")
+            parts = [(p, info) for p, info in parts if p in chosen]
+        blob = bytearray()
+        md5s = b""
+        for part, (size, etag) in parts:
+            data = await self.backend.read(
+                self._mp_part_oid(bucket, key, upload_id, part))
+            blob += data
+            md5s += bytes.fromhex(etag)
+        final_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
+        await self.backend.write(obj_oid(bucket, key), bytes(blob))
+        await self.backend.omap_set(bucket_index_oid(bucket), {
+            key: f"{len(blob)}\x00{final_etag}\x00"
+                 f"{int(time.time())}".encode(),
+        })
+        await self._drop_upload(bucket, key, upload_id, meta)
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<CompleteMultipartUploadResult>"
+            f"<Key>{escape(key)}</Key>"
+            f'<ETag>"{final_etag}"</ETag>'
+            "</CompleteMultipartUploadResult>"
+        )
+        return "200 OK", "application/xml", xml.encode(), {}
+
+    async def _abort_multipart(self, bucket: str, key: str,
+                               upload_id: str):
+        meta = await self._upload_meta(bucket, key, upload_id)
+        await self._drop_upload(bucket, key, upload_id, meta)
+        return "204 No Content", "application/xml", b"", {}
+
+    async def _drop_upload(self, bucket: str, key: str, upload_id: str,
+                           meta: Dict[str, bytes]) -> None:
+        for k in meta:
+            if k.startswith("part."):
+                try:
+                    await self.backend.remove_object(self._mp_part_oid(
+                        bucket, key, upload_id, int(k.split(".")[1])))
+                except IOError:
+                    pass
+        await self.backend.omap_rm(
+            self._mp_meta_oid(bucket, key, upload_id), list(meta))
+        await self.backend.omap_rm(
+            uploads_oid(bucket), [upload_id])
+
+    async def _list_uploads(self, bucket: str):
+        try:
+            ups = await self.backend.omap_get(
+                uploads_oid(bucket))
+        except (FileNotFoundError, IOError):
+            ups = {}
+        items = "".join(
+            f"<Upload><Key>{escape(v.decode())}</Key>"
+            f"<UploadId>{u}</UploadId></Upload>"
+            for u, v in sorted(ups.items()))
+        xml = (
+            '<?xml version="1.0" encoding="UTF-8"?>'
+            "<ListMultipartUploadsResult>"
+            f"<Bucket>{escape(bucket)}</Bucket>{items}"
+            "</ListMultipartUploadsResult>"
+        )
+        return "200 OK", "application/xml", xml.encode(), {}
 
     async def _delete_object(self, bucket: str, key: str):
         await self._index_entry(bucket, key)  # NoSuchKey check
