@@ -22,7 +22,10 @@ from ceph_tpu.objectstore.filestore import FileStore
 from ceph_tpu.objectstore.kstore import KStore
 
 
-def create(kind: str, path: str = ""):
+def create(kind: str, path: str = "", **kw):
+    """``kind`` may carry a compression suffix for blockstore
+    ("blockstore:zlib" -- the bluestore_compression_algorithm role)."""
+    kind, _, alg = kind.partition(":")
     if kind == "memstore":
         return MemStore()
     if kind == "filestore":
@@ -36,7 +39,9 @@ def create(kind: str, path: str = ""):
     if kind == "blockstore":
         if not path:
             raise ValueError("blockstore needs a data path")
-        return BlockStore(path)
+        kw_alg = kw.pop("compression", None)  # pop BEFORE the or-else:
+        # a short-circuit would leave a duplicate kwarg in **kw
+        return BlockStore(path, compression=alg or kw_alg, **kw)
     raise ValueError(f"unknown objectstore backend {kind!r}")
 
 

@@ -25,6 +25,15 @@ onode extent maps + pending deferred records -- BlueStore's
 NCB/allocation-from-onodes recovery mode rather than a persisted
 freelist.
 
+Compression (BlueStore blob compression, src/os/bluestore/BlueStore.cc
+_do_write_data compress path): big writes covering >= 2 full allocation
+units may be stored as one compressed blob -- fewer physical units than
+the logical span, a crc32 over the compressed payload (the blob csum
+role), and the onode extent map pointing at the blob.  A partial
+overwrite of a compressed span first decompresses it back to plain
+units (BlueStore's blob rewrite on overlap); reads verify the csum and
+raise EIO-style on mismatch.
+
 KV prefixes: "O" onodes, "M" omap ("<oid>\\x00<key>"), "D" deferred
 records keyed by monotonic sequence.
 """
@@ -32,8 +41,10 @@ records keyed by monotonic sequence.
 from __future__ import annotations
 
 import os
+import zlib as _zlib
 from typing import Dict, List, Optional
 
+from ceph_tpu import compressor as compressor_mod
 from ceph_tpu.kv import lsm as lsm_mod
 from ceph_tpu.kv.keyvaluedb import KVTransaction
 from ceph_tpu.osd.types import Transaction
@@ -42,12 +53,15 @@ from ceph_tpu.utils.encoding import Decoder, Encoder
 
 class BlockStore:
     def __init__(self, path: str, alloc_unit: int = 64 * 1024,
-                 deferred_threshold: int = 32 * 1024):
+                 deferred_threshold: int = 32 * 1024,
+                 compression: Optional[str] = None):
         if not path:
             raise ValueError("blockstore needs a data path")
         os.makedirs(path, exist_ok=True)
         self.alloc_unit = alloc_unit
         self.deferred_threshold = min(deferred_threshold, alloc_unit)
+        self._comp = (compressor_mod.create(compression)
+                      if compression and compression != "none" else None)
         self.db = lsm_mod.LSMStore(os.path.join(path, "kv"))
         self.db.open()
         self.block_path = os.path.join(path, "block")
@@ -69,6 +83,8 @@ class BlockStore:
         for oid, raw in self.db.get_iterator("O"):
             onode = Decoder(raw).value()
             used.update(onode["extents"].values())
+            for blob in onode.get("cblobs", {}).values():
+                used.update(blob["phys"])
         replayed = KVTransaction()
         n_deferred = 0
         for seq, raw in self.db.get_iterator("D"):
@@ -119,6 +135,8 @@ class BlockStore:
         onode = Decoder(raw).value()
         # extent keys round-trip as strings; normalize to int logical units
         onode["extents"] = {int(k): v for k, v in onode["extents"].items()}
+        onode["cblobs"] = {int(k): v for k, v in
+                           onode.get("cblobs", {}).items()}
         self._onode_cache[oid] = onode
         return onode
 
@@ -126,7 +144,21 @@ class BlockStore:
     def _onode_bytes(onode: dict) -> bytes:
         enc = dict(onode)
         enc["extents"] = {str(k): v for k, v in onode["extents"].items()}
+        enc["cblobs"] = {str(k): v for k, v in
+                         onode.get("cblobs", {}).items()}
         return Encoder().value(enc).bytes()
+
+    # -- compressed blobs (BlueStore blob compression) ---------------------
+
+    def _read_blob(self, blob: dict) -> bytes:
+        """Reassemble + verify + decompress one blob; csum failure is
+        the EIO the scrub path expects from a bad device."""
+        comp = b"".join(self._dev_read(p) for p in blob["phys"])
+        comp = comp[: blob["clen"]]
+        if _zlib.crc32(comp) != blob["csum"]:
+            raise IOError(
+                f"compressed blob csum mismatch (span {blob['span']})")
+        return compressor_mod.create(blob["alg"]).decompress(comp)
 
     # -- transaction path --------------------------------------------------
 
@@ -144,17 +176,74 @@ class BlockStore:
                 return onodes[oid]  # type: ignore[return-value]
             cur = None if onodes.get(oid, "?") is None else self._get_onode(oid)
             if cur is None:
-                cur = {"size": 0, "attrs": {}, "extents": {}}
+                cur = {"size": 0, "attrs": {}, "extents": {}, "cblobs": {}}
             else:
                 cur = {"size": cur["size"], "attrs": dict(cur["attrs"]),
-                       "extents": dict(cur["extents"])}
+                       "extents": dict(cur["extents"]),
+                       "cblobs": {k: dict(v) for k, v in
+                                  cur.get("cblobs", {}).items()}}
             onodes[oid] = cur
             return cur
+
+        def explode_blobs(onode: dict, u_lo: int, u_hi: int) -> None:
+            """Rewrite compressed blobs overlapping logical units
+            [u_lo, u_hi] as plain COW units (BlueStore decompresses and
+            rewrites a blob a write lands inside)."""
+            au = self.alloc_unit
+            for b0 in sorted(onode["cblobs"]):
+                blob = onode["cblobs"][b0]
+                if b0 > u_hi or b0 + blob["span"] - 1 < u_lo:
+                    continue
+                data = self._read_blob(blob)
+                del onode["cblobs"][b0]
+                freed.extend(blob["phys"])
+                for i in range(blob["span"]):
+                    new_phys = self._alloc()
+                    self._dev_write(
+                        new_phys * au,
+                        data[i * au:(i + 1) * au].ljust(au, b"\x00"))
+                    onode["extents"][b0 + i] = new_phys
 
         def write_units(onode: dict, offset: int, data: bytes) -> None:
             au = self.alloc_unit
             end = offset + len(data)
             u0, u1 = offset // au, (end - 1) // au
+            explode_blobs(onode, u0, u1)
+            if self._comp is not None:
+                # blob compression for the aligned full-unit core of a
+                # big write: stored only when it saves whole units
+                core_lo = (offset + au - 1) // au
+                core_hi = end // au
+                n = core_hi - core_lo
+                if n >= 2:
+                    span = data[core_lo * au - offset:core_hi * au - offset]
+                    comp = self._comp.compress(span)
+                    units_needed = (len(comp) + au - 1) // au
+                    if units_needed < n:
+                        phys = []
+                        for i in range(units_needed):
+                            p = self._alloc()
+                            self._dev_write(
+                                p * au,
+                                comp[i * au:(i + 1) * au].ljust(au, b"\0"))
+                            phys.append(p)
+                        for u in range(core_lo, core_hi):
+                            old = onode["extents"].pop(u, None)
+                            if old is not None:
+                                freed.append(old)
+                        onode["cblobs"][core_lo] = {
+                            "phys": phys, "span": n, "clen": len(comp),
+                            "alg": self._comp.name,
+                            "csum": _zlib.crc32(comp),
+                        }
+                        # head/tail partial pieces go the plain path
+                        if offset < core_lo * au:
+                            write_units(onode, offset,
+                                        data[: core_lo * au - offset])
+                        if core_hi * au < end:
+                            write_units(onode, core_hi * au,
+                                        data[core_hi * au - offset:])
+                        return
             for u in range(u0, u1 + 1):
                 lo = max(offset, u * au)
                 hi = min(end, (u + 1) * au)
@@ -203,6 +292,16 @@ class BlockStore:
             old_size = onode["size"]
             if size < old_size:
                 keep_units = (size + au - 1) // au if size else 0
+                for b0 in sorted(onode["cblobs"]):
+                    blob = onode["cblobs"][b0]
+                    if b0 >= keep_units:
+                        freed.extend(blob["phys"])
+                        del onode["cblobs"][b0]
+                    elif size < (b0 + blob["span"]) * au:
+                        # the cut lands inside the blob (incl. inside
+                        # its LAST unit): back to plain units so the
+                        # tail logic below can zero/free them
+                        explode_blobs(onode, b0, b0 + blob["span"] - 1)
                 for u in list(onode["extents"]):
                     if u >= keep_units:
                         freed.append(onode["extents"].pop(u))
@@ -237,7 +336,7 @@ class BlockStore:
                 src = onode_for(op.oid)
                 au = self.alloc_unit
                 dst = {"size": src["size"], "attrs": dict(src["attrs"]),
-                       "extents": {}}
+                       "extents": {}, "cblobs": {}}
                 for u, phys in src["extents"].items():
                     base = bytearray(self._dev_read(phys))
                     p0 = phys * au
@@ -248,14 +347,25 @@ class BlockStore:
                     new_phys = self._alloc()
                     self._dev_write(new_phys * au, bytes(base))
                     dst["extents"][u] = new_phys
+                for b0, blob in src["cblobs"].items():
+                    phys = []
+                    for p in blob["phys"]:
+                        np_ = self._alloc()
+                        self._dev_write(np_ * au, self._dev_read(p))
+                        phys.append(np_)
+                    dst["cblobs"][b0] = dict(blob, phys=phys)
                 # a clone earlier staged under this name is replaced
                 old = onodes.get(op.attr_name)
                 if old:
                     freed.extend(old["extents"].values())
+                    for blob in old["cblobs"].values():
+                        freed.extend(blob["phys"])
                 onodes[op.attr_name] = dst
             elif op.op == "remove":
                 cur = onode_for(op.oid)
                 freed.extend(cur["extents"].values())
+                for blob in cur["cblobs"].values():
+                    freed.extend(blob["phys"])
                 onodes[op.oid] = None
                 for k in self._omap_db_keys(op.oid):
                     batch.rmkey("M", f"{op.oid}\x00{k}")
@@ -316,11 +426,20 @@ class BlockStore:
         for u in range(offset // au, (end - 1) // au + 1):
             phys = onode["extents"].get(u)
             if phys is None:
-                continue  # hole: zeros
+                continue  # hole or compressed blob (filled below)
             unit = self._dev_read(phys)
             lo = max(offset, u * au)
             hi = min(end, (u + 1) * au)
             out[lo - offset:hi - offset] = unit[lo - u * au:hi - u * au]
+        for b0, blob in onode.get("cblobs", {}).items():
+            blo, bhi = b0 * au, (b0 + blob["span"]) * au
+            if bhi <= offset or blo >= end:
+                continue
+            data = self._read_blob(blob)  # one decompress per blob
+            lo = max(offset, blo)
+            hi = min(end, bhi)
+            out[lo - offset:hi - offset] = \
+                data[lo - blo:hi - blo].ljust(hi - lo, b"\x00")
         return bytes(out)
 
     def getattr(self, oid: str, name: str):
@@ -369,10 +488,21 @@ class BlockStore:
         if onode is None:
             raise FileNotFoundError(oid)
         au = self.alloc_unit
-        phys = onode["extents"].get(offset // au)
+        u = offset // au
+        phys = onode["extents"].get(u)
         if phys is None:
-            return
-        pofs = phys * au + offset % au
+            # the unit may live in a compressed blob: flip a payload
+            # byte so the blob csum (and hence the read) fails -- the
+            # EIO surface scrub repairs from
+            for b0, blob in onode.get("cblobs", {}).items():
+                if b0 <= u < b0 + blob["span"]:
+                    phys = blob["phys"][0]
+                    break
+            if phys is None:
+                return
+            pofs = phys * au
+        else:
+            pofs = phys * au + offset % au
         self._dev.seek(pofs)
         b = self._dev.read(1)
         self._dev.seek(pofs)

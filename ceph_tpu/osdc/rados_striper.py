@@ -73,33 +73,47 @@ class RadosStriper:
         if meta is not None:
             return meta
         lo = self.default_layout
-        await self.backend.omap_set(self._meta_oid(soid), {
-            "striper.layout": _enc({
+        # create-exclusive CAS: racing first writers with different
+        # default layouts must all end up striping under ONE layout
+        # (the reference guards layout creation with its shared lock)
+        ok, _cur = await self.backend.omap_cas(
+            self._meta_oid(soid), "striper.layout", None,
+            _enc({
                 "object_size": lo.object_size,
                 "stripe_unit": lo.stripe_unit,
                 "stripe_count": lo.stripe_count,
-            }),
-            "striper.size": _enc(0),
-        })
+            }))
+        if not ok:
+            meta = await self._load_meta(soid)
+            if meta is not None:
+                return meta  # the winner's layout governs
+        # CAS size init too: a racing writer may already have grown it
+        await self.backend.omap_cas(
+            self._meta_oid(soid), "striper.size", None, _enc(0))
         await self.backend.omap_set(self._DIR_OID, {f"soid_{soid}": b"1"})
         return Striper(lo), 0
 
-    async def _grow_size(self, soid: str, new_size: int) -> None:
-        """Racing appenders keep the max via CAS retry (the reference
-        updates the size xattr under its shared lock; a plain
-        read-check-write here would let a smaller racing write persist
-        a smaller size and logically truncate the file)."""
+    async def _cas_max(self, soid: str, key: str, new_val: int) -> None:
+        """CAS-retry a monotonically-growing integer omap field (the
+        reference updates these xattrs under its shared lock; a plain
+        read-check-write would let a smaller racing write persist a
+        smaller value and logically truncate the file)."""
         for _ in range(16):
             raw = (await self.backend.omap_get(
-                self._meta_oid(soid))).get("striper.size")
-            cur = _dec(raw) or 0
-            if new_size <= cur:
+                self._meta_oid(soid))).get(key)
+            if (_dec(raw) or 0) >= new_val:
                 return
             ok, _cur = await self.backend.omap_cas(
-                self._meta_oid(soid), "striper.size", raw, _enc(new_size))
+                self._meta_oid(soid), key, raw, _enc(new_val))
             if ok:
                 return
-        raise IOError(f"striper.size update contended on {soid}")
+        raise IOError(f"{key} update contended on {soid}")
+
+    async def _grow_size(self, soid: str, new_size: int) -> None:
+        await self._cas_max(soid, "striper.size", new_size)
+        # maxsize never shrinks (truncate only zeroes): remove() uses it
+        # to find every stripe object ever written
+        await self._cas_max(soid, "striper.maxsize", new_size)
 
     # -- I/O ---------------------------------------------------------------
 
@@ -138,8 +152,11 @@ class RadosStriper:
             try:
                 piece = await self.backend.read_range(
                     self._obj(soid, object_no), obj_off, take)
-            except (FileNotFoundError, IOError):
+            except FileNotFoundError:
                 piece = b""  # sparse stripe object reads as zeros
+            # other IOErrors (e.g. degraded below k shards) propagate:
+            # returning zeros there would hand the caller silent
+            # corruption instead of an EIO
             out[pos:pos + len(piece)] = piece
             pos += take
         return bytes(out)
@@ -186,14 +203,20 @@ class RadosStriper:
                 return
             raise FileNotFoundError(soid)
         striper, size = meta
-        n_objects = max(1, striper.object_count(size))
+        # delete by the historical high-water size: a truncate-shrink
+        # leaves whole stripe objects in place (it only zeroes), and
+        # sizing by the current length would leak them forever
+        maxsize = _dec((await self.backend.omap_get(
+            self._meta_oid(soid))).get("striper.maxsize")) or size
+        n_objects = max(1, striper.object_count(max(size, maxsize)))
         for object_no in range(n_objects):
             try:
                 await self.backend.remove_object(self._obj(soid, object_no))
             except (FileNotFoundError, IOError):
                 pass
         await self.backend.omap_rm(
-            self._meta_oid(soid), ["striper.layout", "striper.size"])
+            self._meta_oid(soid),
+            ["striper.layout", "striper.size", "striper.maxsize"])
         await self.backend.omap_rm(self._DIR_OID, [f"soid_{soid}"])
 
     async def list_striped(self) -> List[str]:

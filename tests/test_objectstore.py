@@ -13,7 +13,8 @@ from ceph_tpu.osd.types import Transaction
 from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
 
 
-@pytest.fixture(params=["memstore", "filestore", "kstore", "blockstore"])
+@pytest.fixture(params=["memstore", "filestore", "kstore", "blockstore",
+                        "blockstore:zlib"])
 def store(request, tmp_path):
     s = os_mod.create(request.param, str(tmp_path / "store"))
     yield s
@@ -379,3 +380,99 @@ def test_blockstore_cluster_crash_remount(tmp_path):
 
     asyncio.new_event_loop().run_until_complete(write_phase())
     asyncio.new_event_loop().run_until_complete(read_phase())
+
+
+# -- blockstore blob compression (bluestore compression role) ---------------
+
+
+def _mkbs(tmp_path, name="c", **kw):
+    return os_mod.BlockStore(str(tmp_path / name), alloc_unit=4096,
+                             deferred_threshold=2048, **kw)
+
+
+def test_blockstore_compression_saves_units(tmp_path):
+    s = _mkbs(tmp_path, compression="zlib")
+    data = b"A" * 65536  # 16 units logical, compresses to ~1
+    s.queue_transaction(Transaction().write("big", 0, data))
+    assert s.read("big") == data
+    onode = s._get_onode("big")
+    assert onode["cblobs"], "compressible big write not stored as a blob"
+    blob = next(iter(onode["cblobs"].values()))
+    assert blob["span"] == 16 and len(blob["phys"]) < 16
+    # incompressible data stays plain
+    import os as _os
+    rnd = _os.urandom(65536)
+    s.queue_transaction(Transaction().write("rand", 0, rnd))
+    assert s.read("rand") == rnd
+    assert not s._get_onode("rand")["cblobs"]
+    s.umount()
+
+
+def test_blockstore_compressed_survives_remount(tmp_path):
+    s = _mkbs(tmp_path, compression="zlib")
+    data = bytes(range(256)) * 256  # 64 KiB, compressible
+    s.queue_transaction(Transaction().write("o", 0, data))
+    used_before = s._high_water - len(s._free)
+    s.umount()
+    # reopen WITHOUT compression enabled: old blobs must still decode
+    s2 = _mkbs(tmp_path)
+    assert s2.read("o") == data
+    # the allocator must account the blob's physical units as used
+    assert s2._high_water - len(s2._free) == used_before
+    s2.umount()
+
+
+def test_blockstore_partial_overwrite_explodes_blob(tmp_path):
+    s = _mkbs(tmp_path, compression="zlib")
+    data = b"B" * 32768  # 8 units -> one blob
+    s.queue_transaction(Transaction().write("o", 0, data))
+    assert s._get_onode("o")["cblobs"]
+    # overwrite 100 bytes inside the span: blob decompressed back to
+    # plain units, bytes land, everything else preserved
+    s.queue_transaction(Transaction().write("o", 5000, b"x" * 100))
+    got = s.read("o")
+    assert got[:5000] == b"B" * 5000
+    assert got[5000:5100] == b"x" * 100
+    assert got[5100:] == b"B" * (32768 - 5100)
+    assert not s._get_onode("o")["cblobs"]
+    s.umount()
+
+
+def test_blockstore_compressed_csum_detects_corruption(tmp_path):
+    s = _mkbs(tmp_path, compression="zlib")
+    data = b"C" * 65536
+    s.queue_transaction(Transaction().write("o", 0, data))
+    s.corrupt("o", 8192)  # lands inside the blob payload
+    with pytest.raises(IOError):
+        s.read("o")
+    s.umount()
+
+
+def test_blockstore_truncate_and_clone_with_blobs(tmp_path):
+    s = _mkbs(tmp_path, compression="zlib")
+    data = b"D" * 65536
+    s.queue_transaction(Transaction().write("o", 0, data))
+    s.queue_transaction(Transaction().clone("o", "o2"))
+    assert s.read("o2") == data
+    # truncating through the blob explodes/frees correctly
+    s.queue_transaction(Transaction().truncate("o", 10_000))
+    assert s.read("o") == b"D" * 10_000
+    assert s.read("o2") == data  # clone unaffected
+    # regrow reads zeros past the cut
+    s.queue_transaction(Transaction().write("o", 20_000, b"E"))
+    got = s.read("o")
+    assert got[10_000:20_000] == bytes(10_000)
+    s.umount()
+
+
+def test_blockstore_truncate_inside_blob_last_unit_zeroes_tail(tmp_path):
+    s = _mkbs(tmp_path, compression="zlib")
+    data = b"F" * 65536  # 16 units, one blob
+    s.queue_transaction(Transaction().write("o", 0, data))
+    cut = 65536 - 100  # inside the blob's LAST unit
+    s.queue_transaction(Transaction().truncate("o", cut))
+    s.queue_transaction(Transaction().truncate("o", 65536))  # regrow
+    got = s.read("o")
+    assert got[:cut] == b"F" * cut
+    assert got[cut:] == bytes(100), "stale blob tail resurfaced on regrow"
+    s.umount()

@@ -81,3 +81,42 @@ def test_remove_and_directory():
         await c.shutdown()
 
     asyncio.run(run())
+
+
+def test_remove_after_shrink_deletes_all_stripe_objects():
+    async def run():
+        c = _mk()
+        rs = RadosStriper(c.backend, object_size=32 << 10,
+                          stripe_unit=8 << 10, stripe_count=2)
+        await rs.write("f", os.urandom(300_000))  # many stripe objects
+        await rs.truncate("f", 100)
+        await rs.remove("f")
+        # no stripe object of the ORIGINAL extent may survive
+        from ceph_tpu.osdc.striper import FileLayout, Striper
+        n = Striper(FileLayout(object_size=32 << 10, stripe_unit=8 << 10,
+                               stripe_count=2)).object_count(300_000)
+        for object_no in range(n):
+            size, hinfo = await c.backend.stat(f"f.{object_no:016x}")
+            assert size == 0 and hinfo is None, f"leaked f.{object_no:016x}"
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_degraded_read_raises_instead_of_zeros():
+    async def run():
+        c = _mk()  # k=2,m=1: two down OSDs -> below k
+        rs = RadosStriper(c.backend, object_size=32 << 10,
+                          stripe_unit=8 << 10, stripe_count=2)
+        payload = os.urandom(100_000)
+        await rs.write("f", payload)
+        c.kill_osd(0)
+        c.kill_osd(1)
+        try:
+            got = await rs.read("f")
+            assert got == payload, "read returned WRONG data silently"
+        except IOError:
+            pass  # EIO is the correct signal below k shards
+        await c.shutdown()
+
+    asyncio.run(run())
