@@ -68,6 +68,8 @@ async def serve(args) -> None:
         asok_path = args.admin_socket or f"{args.data_path}/{name}.asok"
         asok = AdminSocket(asok_path)
         asok.register("perf dump", lambda cmd: shard.perf.snapshot())
+        asok.register("perf histogram dump",
+                      lambda cmd: shard.op_hist.snapshot())
         asok.register(
             "ops", lambda cmd: shard.optracker.dump_ops_in_flight()
         )

@@ -82,6 +82,19 @@ class TCPMessenger:
         #: live incoming-connection handler tasks (cancelled on shutdown;
         #: Server.wait_closed would otherwise block on them forever)
         self._serve_tasks: set = set()
+        #: inbound dispatch byte budget (DispatchThrottler /
+        #: osd_client_message_size_cap, default 500 MiB): budget is held
+        #: from socket read until the dispatcher finishes, so a flood of
+        #: large messages back-pressures the senders' sockets instead of
+        #: ballooning memory
+        from ceph_tpu.utils.config import get_config
+        from ceph_tpu.utils.throttle import Throttle
+
+        try:
+            cap = int(get_config().get_val("osd_client_message_size_cap"))
+        except (KeyError, ValueError, TypeError):
+            cap = 500 * 1024 * 1024
+        self.dispatch_throttle = Throttle(f"{node}.msgr-dispatch", cap)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -131,19 +144,48 @@ class TCPMessenger:
     async def _dispatch_loop(self, name: str) -> None:
         queue = self._local_queues[name]
         while True:
-            src, msg = await queue.get()
-            if name in self._marked_down:
-                continue
-            try:
-                await self._dispatchers[name](src, msg)
-            except asyncio.CancelledError:
-                raise
-            except Exception:  # noqa: BLE001 -- a dispatcher crash must
-                # not kill the loop (reference logs and drops)
-                import sys
-                import traceback
+            item = await queue.get()
+            src, msg = item[0], item[1]
+            cost = item[2] if len(item) > 2 else 0
+            released = [False]
 
-                traceback.print_exc(file=sys.stderr)
+            def release(released=released, cost=cost):
+                if not released[0]:
+                    released[0] = True
+                    self.dispatch_throttle.put(cost)
+
+            claimed = [False]
+            if cost and isinstance(msg, dict) and "op" in msg:
+                # budget hand-off: a dispatcher that only ENQUEUES the
+                # op (OSDShard's QoS queue) may claim the budget and
+                # release it when the op actually executes -- that is
+                # what makes the byte cap a real memory bound for
+                # daemons instead of a transit-only throttle.  Blocking
+                # here instead would deadlock: sub-op replies for
+                # in-flight ops arrive through this same loop.
+                msg["_budget_release"] = release
+                msg["_budget_claim"] = (
+                    lambda claimed=claimed: claimed.__setitem__(0, True))
+            try:
+                if name in self._marked_down:
+                    continue
+                try:
+                    await self._dispatchers[name](src, msg)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 -- a dispatcher crash
+                    # must not kill the loop (reference logs and drops)
+                    import sys
+                    import traceback
+
+                    traceback.print_exc(file=sys.stderr)
+            finally:
+                if isinstance(msg, dict):
+                    msg.pop("_budget_claim", None)
+                if cost and not claimed[0]:
+                    if isinstance(msg, dict):
+                        msg.pop("_budget_release", None)
+                    release()
 
     # -- server side -------------------------------------------------------
 
@@ -206,7 +248,10 @@ class TCPMessenger:
             msg = decode_message(dec.blob())
             queue = self._local_queues.get(dst)
             if queue is not None and dst not in self._marked_down:
-                await queue.put((src, msg))
+                cost = len(rec)
+                # back-pressure this socket while the daemon is choked
+                await self.dispatch_throttle.get(cost)
+                await queue.put((src, msg, cost))
         writer.close()
 
     async def _auth_accept(self, reader, writer, peer_node: str,

@@ -146,6 +146,19 @@ class OSDShard:
         self._meta_tid = 0
         self._meta_pending: Dict[int, tuple] = {}
         self.optracker = OpTracker()
+        #: entity -> OSDCap; entities absent here run with the open
+        #: default (client.admin allow *).  Populated via
+        #: set_client_caps from keyring "caps osd" strings.
+        self.client_caps: Dict[str, object] = {}
+        # 2D latency x size grid (PerfHistogram<2>, dumped by the
+        # admin-socket `perf histogram dump` like l_osd_op_*_lat_*)
+        from ceph_tpu.utils.perf import HistogramAxis, PerfHistogram
+
+        self.op_hist = PerfHistogram(
+            f"osd.{osd_id}.op_latency_size",
+            HistogramAxis("latency_usec", 0, 64, 32, "log2"),
+            HistogramAxis("size_bytes", 0, 512, 24, "log2"),
+        )
         self.op_queue_type = op_queue
         if op_queue == "mclock":
             self.opq = MClockQueue(dict(MCLOCK_DEFAULTS))
@@ -190,6 +203,13 @@ class OSDShard:
         )
         self.pools[pool] = backend
         return backend
+
+    def set_client_caps(self, entity: str, caps: str) -> None:
+        """Confine ``entity``'s client ops to an OSDCap string (the
+        keyring 'caps osd' line, ref src/osd/OSDCap.h)."""
+        from ceph_tpu.auth.caps import OSDCap
+
+        self.client_caps[entity] = OSDCap.parse(caps)
 
     # -- background tick: peering-driven recovery (OSD::tick role) ---------
 
@@ -355,6 +375,12 @@ class OSDShard:
             if op == "client_op":
                 # a client op lands in the QoS queue like any other work
                 # (reference: ms_fast_dispatch -> enqueue_op, OSD.cc:6439)
+                claim = msg.pop("_budget_claim", None)
+                if claim is not None:
+                    # keep the messenger's dispatch-throttle budget held
+                    # until the op EXECUTES (released in _run_client_op)
+                    # so queued bytes stay under the daemon's cap
+                    claim()
                 cost = max(1, len(msg.get("data") or b"") // 4096)
                 if self.op_queue_type == "mclock":
                     self.opq.enqueue(
@@ -731,17 +757,49 @@ class OSDShard:
             f"client_op({msg.get('kind')} oid={msg.get('oid')} from={src})"
         )
         reply = {"op": "client_reply", "tid": msg["tid"]}
+        try:
+            await self._run_client_op_inner(src, msg, op, reply)
+        finally:
+            release = msg.pop("_budget_release", None)
+            if release is not None:
+                release()  # claimed messenger dispatch-throttle budget
+
+    async def _run_client_op_inner(self, src: str, msg: dict, op,
+                                   reply: dict) -> None:
         async with self._cop_sem:
             op.mark_event("started")
-            backend = self.pools.get(msg.get("pool") or "")
+            pool_name = msg.get("pool") or ""
+            backend = self.pools.get(pool_name)
             if backend is None and self.pools:
-                backend = next(iter(self.pools.values()))
-            if backend is None:
+                # fall back to the hosted pool -- and make the cap
+                # check below use the pool the op will actually RUN on,
+                # never the requested name (a grant on an unhosted name
+                # must not leak onto the hosted pool)
+                pool_name = next(iter(self.pools))
+                backend = self.pools[pool_name]
+            cap = self.client_caps.get(src.split("[")[0])
+            if cap is not None and backend is not None:
+                # OSDCap enforcement (PrimaryLogPG
+                # op_has_sufficient_caps): an entity with registered
+                # caps is confined to them; unregistered entities keep
+                # the open-cluster default (client.admin allow *)
+                from ceph_tpu.auth.caps import op_capable
+
+                if not op_capable(cap, pool_name,
+                                  msg.get("oid", ""), msg.get("kind", "")):
+                    reply.update(
+                        ok=False, etype="PermissionError",
+                        error=f"{src} caps do not permit "
+                              f"{msg.get('kind')} on {msg.get('oid')}",
+                    )
+                    backend = None
+                    self.perf.inc("cap_denied")
+            if backend is None and "etype" not in reply:
                 reply.update(
                     ok=False, etype="IOError",
                     error=f"{self.name} hosts no pool",
                 )
-            else:
+            elif backend is not None:
                 try:
                     reply.update(ok=True, result=await backend.client_op(msg))
                 except asyncio.CancelledError:
@@ -753,6 +811,8 @@ class OSDShard:
                     )
             op.mark_event("replied")
         op.finish()
+        self.op_hist.inc(op.duration * 1e6,
+                         len(msg.get("data") or b""))
         if self.frozen or self.messenger.is_down(self.name):
             return
         await self.messenger.send_message(self.name, src, reply)

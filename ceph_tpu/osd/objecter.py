@@ -31,6 +31,7 @@ _EXCEPTIONS = {
     "KeyError": KeyError,
     "ValueError": ValueError,
     "RuntimeError": RuntimeError,
+    "PermissionError": PermissionError,  # OSDCap denial (-EACCES)
 }
 
 #: op kinds that must NOT be silently resent after a primary died with the

@@ -71,6 +71,10 @@ OPTIONS: Dict[str, Option] = {
         _opt("osd_scrub_objects_per_tick", int, 4, LEVEL_ADVANCED,
              "deep-scrub at most this many objects per background tick "
              "(rate limit; 0 disables background scrub)"),
+        _opt("osd_client_message_size_cap", int, 500 * 1024 * 1024,
+             LEVEL_ADVANCED,
+             "max bytes of in-flight inbound messages a daemon holds "
+             "before back-pressuring senders (dispatch throttle)"),
         _opt("ms_inject_socket_failures", int, 0, LEVEL_DEV,
              "inject a message drop roughly every N messages"),
         _opt("ms_inject_internal_delays", float, 0.0, LEVEL_DEV,

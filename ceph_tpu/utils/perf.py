@@ -78,3 +78,79 @@ class PerfCounters:
     def reset_all(cls) -> None:
         with cls._collection_lock:
             cls._collection.clear()
+            PerfHistogram._collection.clear()
+
+
+class HistogramAxis:
+    """One axis of a 2D perf histogram (src/perf_histogram.h
+    axis_config_d): ``scale`` is "linear" or "log2"; values below
+    ``min`` land in bucket 0, values past the last bucket in the last
+    (the reference's underflow/overflow buckets)."""
+
+    def __init__(self, name: str, min_value: int, quant_size: int,
+                 buckets: int, scale: str = "log2"):
+        if scale not in ("linear", "log2"):
+            raise ValueError(f"unknown axis scale {scale!r}")
+        self.name = name
+        self.min = min_value
+        self.quant = quant_size
+        self.buckets = buckets
+        self.scale = scale
+
+    def bucket_for(self, value: float) -> int:
+        if value < self.min:
+            return 0
+        off = value - self.min
+        if self.scale == "linear":
+            b = 1 + int(off // self.quant)
+        else:
+            b = 1
+            span = self.quant
+            while off >= span and b < self.buckets - 1:
+                off -= span
+                span *= 2
+                b += 1
+        return min(b, self.buckets - 1)
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "min": self.min, "quant_size": self.quant,
+                "buckets": self.buckets, "scale_type": self.scale}
+
+
+class PerfHistogram:
+    """2D counter grid (src/perf_histogram.h PerfHistogram<2>): e.g.
+    request latency x request size, dumped by ``perf histogram dump``.
+    Cells are x-major."""
+
+    _collection: Dict[str, "PerfHistogram"] = {}
+
+    def __init__(self, name: str, x: HistogramAxis, y: HistogramAxis):
+        self.name = name
+        self.x = x
+        self.y = y
+        self._lock = threading.Lock()
+        self._values = [0] * (x.buckets * y.buckets)
+        with PerfCounters._collection_lock:
+            PerfHistogram._collection[name] = self
+
+    def inc(self, x_value: float, y_value: float, amount: int = 1) -> None:
+        bx = self.x.bucket_for(x_value)
+        by = self.y.bucket_for(y_value)
+        with self._lock:
+            self._values[bx * self.y.buckets + by] += amount
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "axes": [self.x.to_dict(), self.y.to_dict()],
+                "values": list(self._values),
+            }
+
+    @classmethod
+    def dump(cls) -> str:
+        """The ``perf histogram dump`` admin-socket command."""
+        with PerfCounters._collection_lock:
+            return json.dumps(
+                {name: h.snapshot() for name, h in cls._collection.items()},
+                indent=2, sort_keys=True,
+            )
