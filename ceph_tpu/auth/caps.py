@@ -121,10 +121,12 @@ OP_NEEDS = {
     "omap_cas": (False, True, False),
     "snap_trim": (False, True, False),
     "snap_rollback": (False, True, False),
+    # watch mutates primary-side watcher state: the reference's
+    # CEPH_OSD_OP_WATCH is a write-mode op (may_write), and unwatch must
+    # mirror it so a watcher can always unregister what it registered
     "exec": (True, False, True),
-    "watch": (True, False, False),
-    "unwatch": (True, False, False),  # must mirror watch: an r-only
-    # client may otherwise register a watch it can never unregister
+    "watch": (False, True, False),
+    "unwatch": (False, True, False),
     "notify": (True, False, False),
     "scrub": (True, False, False),
     "recover": (False, True, False),

@@ -23,7 +23,8 @@ from ceph_tpu.utils.log import dout
 
 
 class Monitor:
-    def __init__(self, rank: int, n_mons: int, messenger: Messenger):
+    def __init__(self, rank: int, n_mons: int, messenger: Messenger,
+                 store_path: Optional[str] = None):
         self.rank = rank
         self.n_mons = n_mons
         self.name = f"mon.{rank}"
@@ -35,6 +36,20 @@ class Monitor:
         self.kvstore = ConfigKeyStore()
         self.configdb = ConfigStore()
         self.clog = ClusterLog()
+        self._store_db = None
+        if store_path is not None:
+            # MonitorDBStore role: paxos state on an LSM KeyValueDB; a
+            # restarted mon rebuilds its services by replaying the
+            # committed values (Monitor::preinit + PaxosService
+            # update_from_paxos)
+            from ceph_tpu.kv.lsm import LSMStore
+
+            self._store_db = LSMStore(store_path)
+            self._store_db.open()
+            self.paxos.store.attach(self._store_db)
+            for v in sorted(self.paxos.store.values):
+                if v <= self.paxos.store.last_committed:
+                    self._apply_commit(self.paxos.store.values[v])
         self.leader: Optional[int] = None
         self.quorum: List[int] = []
         self.election_epoch = 0
@@ -44,6 +59,14 @@ class Monitor:
         self._cmd_lock = asyncio.Lock()
         self._last_lease = 0.0
         messenger.register(self.name, self.dispatch)
+
+    def close_store(self) -> None:
+        """Release the durable store (a stopped mon; the tool can then
+        open it offline)."""
+        if self._store_db is not None:
+            self._store_db.close()
+            self._store_db = None
+            self.paxos.store.db = None
 
     def start_tick(self, interval: float = 0.1, miss_factor: float = 4.0):
         """Lease probing (reference: Paxos lease extend/ack + Elector
@@ -160,7 +183,10 @@ class Monitor:
             ):
                 await self._send_to_rank(rank, reply)
         elif t == "paxos_last":
-            self.paxos.handle_last(int(src.split(".")[1]), msg)
+            for rank, reply in self.paxos.handle_last(
+                int(src.split(".")[1]), msg
+            ):
+                await self._send_to_rank(rank, reply)
         elif t == "paxos_begin":
             for rank, reply in self.paxos.handle_begin(
                 int(src.split(".")[1]), msg
@@ -194,14 +220,27 @@ class Monitor:
 
     # -- committed-state application ---------------------------------------
 
-    def _on_commit(self, v: int, value: dict) -> None:
+    def _apply_commit(self, value: dict) -> str:
+        """Route one committed increment to its service slice; returns
+        the slice name (also used for startup replay from the durable
+        store, where nothing is pushed)."""
         inc = value["inc"]
         op = inc.get("op", "")
         if op.startswith("kv_"):
             self.kvstore.apply(inc)
-            return
+            return "kv"
         if op.startswith("config_"):
             self.configdb.apply(inc)
+            return "config"
+        if op == "clog_append":
+            self.clog.apply(inc)
+            return "clog"
+        self.osdmap.apply(inc)
+        return "osdmap"
+
+    def _on_commit(self, v: int, value: dict) -> None:
+        kind = self._apply_commit(value)
+        if kind == "config":
             # runtime config distribution: every commit pushes the new
             # sections to subscribers (MonClient config notifications);
             # daemons pick their own entity_view out of it
@@ -210,17 +249,14 @@ class Monitor:
                 "version": self.configdb.version,
                 "sections": self.configdb.dump(),
             })
-            return
-        if op == "clog_append":
-            self.clog.apply(inc)
-            return
-        self.osdmap.apply(inc)
-        # every mon pushes to its own subscribers (clients subscribe to all
-        # mons and dedup by epoch) — gating on is_leader() here would drop
-        # broadcasts when leadership flickers mid-commit during elections
-        self._push_to_subscribers(
-            {"type": "osdmap", "map": self.osdmap.to_dict()}
-        )
+        elif kind == "osdmap":
+            # every mon pushes to its own subscribers (clients subscribe
+            # to all mons and dedup by epoch) — gating on is_leader()
+            # here would drop broadcasts when leadership flickers
+            # mid-commit during elections
+            self._push_to_subscribers(
+                {"type": "osdmap", "map": self.osdmap.to_dict()}
+            )
 
     def _push_to_subscribers(self, msg: dict) -> None:
         for sub in list(self._subscribers):
@@ -466,9 +502,15 @@ class MonClient:
 class MonCluster:
     """n monitors on one messenger (the mon side of a vstart cluster)."""
 
-    def __init__(self, n_mons: int, messenger: Messenger, tick: bool = True):
+    def __init__(self, n_mons: int, messenger: Messenger, tick: bool = True,
+                 store_dir: Optional[str] = None):
         self.messenger = messenger
-        self.mons = [Monitor(r, n_mons, messenger) for r in range(n_mons)]
+        self.mons = [
+            Monitor(r, n_mons, messenger,
+                    store_path=(f"{store_dir}/mon.{r}" if store_dir
+                                else None))
+            for r in range(n_mons)
+        ]
         self._tick = tick
 
     async def form_quorum(self, timeout: float = 3.0) -> Monitor:
@@ -498,3 +540,7 @@ class MonCluster:
 
     def revive(self, rank: int) -> None:
         self.messenger.mark_up(f"mon.{rank}")
+
+    def close_stores(self) -> None:
+        for mon in self.mons:
+            mon.close_store()

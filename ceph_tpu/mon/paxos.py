@@ -25,6 +25,54 @@ class PaxosStore:
     # uncommitted value carried across recovery (Paxos.cc handle_last)
     uncommitted_v: Optional[int] = None
     uncommitted_value: Optional[dict] = None
+    #: optional durable backing (MonitorDBStore over the LSM KeyValueDB;
+    #: the reference's store.db RocksDB).  When attached, every paxos
+    #: state change lands on disk before the next message goes out.
+    db: object = None
+
+    # kv layout: prefix "P" version -> value, prefix "T" paxos metadata
+    def attach(self, db) -> None:
+        from ceph_tpu.utils.encoding import Decoder
+
+        self.db = db
+        for key, raw in db.get_iterator("P"):
+            self.values[int(key)] = Decoder(raw).value()
+        meta = db.get("T", "meta")
+        if meta is not None:
+            m = Decoder(meta).value()
+            self.last_committed = m["last_committed"]
+            self.accepted_pn = m["accepted_pn"]
+            self.uncommitted_v = m["uncommitted_v"]
+            self.uncommitted_value = m["uncommitted_value"]
+
+    def persist_meta(self, txn=None) -> None:
+        if self.db is None:
+            return
+        from ceph_tpu.kv.keyvaluedb import KVTransaction
+        from ceph_tpu.utils.encoding import Encoder
+
+        batch = txn or KVTransaction()
+        batch.set("T", "meta", Encoder().value({
+            "last_committed": self.last_committed,
+            "accepted_pn": self.accepted_pn,
+            "uncommitted_v": self.uncommitted_v,
+            "uncommitted_value": self.uncommitted_value,
+        }).bytes())
+        if txn is None:
+            self.db.submit_transaction(batch)
+
+    def persist_commit(self, v: int) -> None:
+        """Committed value + metadata in ONE batch (the reference's
+        single MonitorDBStore transaction per commit)."""
+        if self.db is None:
+            return
+        from ceph_tpu.kv.keyvaluedb import KVTransaction
+        from ceph_tpu.utils.encoding import Encoder
+
+        batch = KVTransaction()
+        batch.set("P", str(v), Encoder().value(self.values[v]).bytes())
+        self.persist_meta(batch)
+        self.db.submit_transaction(batch)
 
 
 class Paxos:
@@ -74,6 +122,7 @@ class Paxos:
     async def _collect_once(self, quorum: List[int], timeout: float) -> bool:
         pn = self.new_pn()
         self.store.accepted_pn = pn
+        self.store.persist_meta()
         self._lasts = {
             self.rank: {
                 "last_committed": self.store.last_committed,
@@ -129,27 +178,32 @@ class Paxos:
         }
         if msg["pn"] >= self.store.accepted_pn:
             self.store.accepted_pn = msg["pn"]
+            self.store.persist_meta()
         else:
             reply["nack_pn"] = self.store.accepted_pn
         return [(src_rank, reply)]
 
-    def handle_last(self, src_rank: int, msg: dict) -> None:
-        # catch up on commits the peer has and we lack (Paxos.cc share);
-        # committed values are safe to apply even from a stale round
+    def handle_last(self, src_rank: int, msg: dict) -> List[tuple]:
+        """Leader side; returns [(rank, msg)] share traffic to send.
+        Catches up on commits the peer has and we lack AND shares our
+        commits with a lagging peer (Paxos.cc share_state both ways --
+        without the leader->peon half, a mon that missed commits while
+        down would stay behind forever unless it won an election)."""
         for v, val in sorted(msg.get("values", {}).items()):
             v = int(v)
             if v == self.store.last_committed + 1:
                 self._commit(v, val)
         if msg["pn"] != self.store.accepted_pn:
-            return  # stale round (incl. late nacks): ignore
+            return []  # stale round (incl. late nacks): ignore
         if "nack_pn" in msg:
             # a peon promised newer: adopt, so new_pn() goes above it and
             # the collect retry loop can win the next round
             if msg["nack_pn"] > self.store.accepted_pn:
                 self.store.accepted_pn = msg["nack_pn"]
+                self.store.persist_meta()
             if self._collect_done and not self._collect_done.done():
                 self._collect_done.set_result(False)
-            return
+            return []
         self._lasts[src_rank] = msg
         if (
             len(self._lasts) >= self.majority
@@ -157,6 +211,15 @@ class Paxos:
             and not self._collect_done.done()
         ):
             self._collect_done.set_result(True)
+        out = []
+        for v in range(int(msg["last_committed"]) + 1,
+                       self.store.last_committed + 1):
+            if v in self.store.values:
+                out.append((src_rank, {
+                    "type": "paxos_commit", "pn": msg["pn"],
+                    "v": v, "value": self.store.values[v],
+                }))
+        return out
 
     # -- leader: proposal (phase 2) ---------------------------------------
 
@@ -169,6 +232,7 @@ class Paxos:
         # leader accepts its own proposal first (begin writes to store)
         self.store.uncommitted_v = v
         self.store.uncommitted_value = value
+        self.store.persist_meta()
         self._accepts = {self.rank}
         self._proposal_done = asyncio.get_event_loop().create_future()
         for r in quorum:
@@ -210,6 +274,7 @@ class Paxos:
         self.store.accepted_pn = msg["pn"]
         self.store.uncommitted_v = msg["v"]
         self.store.uncommitted_value = msg["value"]
+        self.store.persist_meta()
         return [
             (src_rank, {"type": "paxos_accept", "pn": msg["pn"], "v": msg["v"]})
         ]
@@ -218,6 +283,7 @@ class Paxos:
         if "nack_pn" in msg:
             if msg["nack_pn"] > self.store.accepted_pn:
                 self.store.accepted_pn = msg["nack_pn"]
+                self.store.persist_meta()
             if self._proposal_done and not self._proposal_done.done():
                 self._proposal_done.set_result(False)
             return
@@ -244,4 +310,6 @@ class Paxos:
         if self.store.uncommitted_v == v:
             self.store.uncommitted_v = None
             self.store.uncommitted_value = None
+        # durable BEFORE application/broadcast (one MonitorDBStore batch)
+        self.store.persist_commit(v)
         self.on_commit(v, value)

@@ -248,10 +248,18 @@ class TCPMessenger:
             msg = decode_message(dec.blob())
             queue = self._local_queues.get(dst)
             if queue is not None and dst not in self._marked_down:
-                cost = len(rec)
-                # back-pressure this socket while the daemon is choked
-                await self.dispatch_throttle.get(cost)
-                await queue.put((src, msg, cost))
+                if isinstance(msg, dict) and msg.get("op") == "client_op":
+                    # throttle CLIENT ops only (the reference's
+                    # DispatchThrottler guards the client messenger):
+                    # sub-op replies must NEVER block here, or claimed
+                    # client budget could wait on replies that are
+                    # themselves stuck behind the throttle -- a
+                    # distributed deadlock
+                    cost = len(rec)
+                    await self.dispatch_throttle.get(cost)
+                    await queue.put((src, msg, cost))
+                else:
+                    await queue.put((src, msg))
         writer.close()
 
     async def _auth_accept(self, reader, writer, peer_node: str,

@@ -707,6 +707,14 @@ class OSDShard:
                 # execute (a "hung" OSD mutating its store would defeat
                 # the fault model the flag simulates)
                 if self.frozen or self.messenger.is_down(self.name):
+                    # a dropped op must still return its claimed
+                    # dispatch-throttle budget or repeated freeze cycles
+                    # would shrink the messenger's byte cap forever
+                    dropped = item[1]
+                    if isinstance(dropped, dict):
+                        release = dropped.pop("_budget_release", None)
+                        if release is not None:
+                            release()
                     continue
                 src, msg = item
                 try:

@@ -240,7 +240,10 @@ def test_tcp_dispatch_throttle_backpressures_without_deadlock():
         mb.register("b", dispatch)
         ma.register("a", lambda s, m: asyncio.sleep(0))
         for i in range(20):
-            await ma.send_message("a", "b", {"n": i, "pad": b"x" * 2000})
+            # only client ops are throttled (sub-op replies must bypass
+            # or claimed budget could deadlock on them)
+            await ma.send_message(
+                "a", "b", {"op": "client_op", "n": i, "pad": b"x" * 2000})
         await asyncio.wait_for(done.wait(), 10.0)
         assert [m["n"] for m in got] == list(range(20))  # ordered, complete
         assert mb.dispatch_throttle.n_waits > 0  # it really throttled

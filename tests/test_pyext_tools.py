@@ -101,3 +101,24 @@ def test_ceph_volume_prepare_activate_list(tmp_path):
          "--id", "7"],
         capture_output=True, text=True)
     assert r.returncode == 1 and "not prepared" in r.stderr
+
+
+def test_osdmaptool_lifecycle(tmp_path, capsys):
+    from tools import osdmaptool
+
+    path = str(tmp_path / "map.json")
+    assert osdmaptool.main([path, "--createsimple", "10"]) == 0
+    assert osdmaptool.main([path, "--create-pool", "data",
+                            "--k", "4", "--m", "2", "--pg-num", "32"]) == 0
+    assert osdmaptool.main([path, "--mark-out", "3"]) == 0
+    capsys.readouterr()
+    assert osdmaptool.main([path, "--test-map-pgs", "--pool", "data"]) == 0
+    out = capsys.readouterr().out
+    # the out osd takes no PGs; others carry the 32*6 shard placements
+    lines = {ln.split("\t")[0]: ln for ln in out.splitlines()
+             if ln.startswith("osd.")}
+    assert lines["osd.3"].split("\t")[1] == "0"
+    assert "holes 0" in out
+    assert osdmaptool.main([path, "--test-map-object", "obj1"]) == 0
+    out = capsys.readouterr().out
+    assert "-> pg" in out and "osd.3" not in out
