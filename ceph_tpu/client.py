@@ -165,6 +165,9 @@ class IoCtx:
         names = set()
         for osd in self._cluster.osds:
             for soid in osd.store.list_objects():
+                if soid.endswith("@meta") and \
+                        osd.store.getattr(soid, "_meta_removed"):
+                    continue  # removal tombstone, not a live object
                 names.add(soid.rsplit("@", 1)[0])
         return sorted(names)
 

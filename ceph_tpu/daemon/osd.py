@@ -86,11 +86,19 @@ async def serve(args) -> None:
                 {cmd["key"]: cmd["value"]}
             ) or {"success": True},
         )
+        def _live_objects():
+            # removal tombstones are durable state but not live objects:
+            # ls and df must agree the deleted name is gone
+            return [o for o in shard.store.list_objects()
+                    if not (o.endswith("@meta")
+                            and shard.store.getattr(o, "_meta_removed"))]
+
         asok.register("status", lambda cmd: {
             "name": name,
-            "objects": len(shard.store.list_objects()),
+            "objects": len(_live_objects()),
             "pools": sorted(shard.pools),
         })
+        asok.register("list_objects", lambda cmd: sorted(_live_objects()))
         from ceph_tpu.utils import perfglue
 
         perfglue.register(asok)  # cpu_profiler start/stop/status

@@ -77,8 +77,15 @@ class TCPMessenger:
         self._server: Optional[asyncio.AbstractServer] = None
         #: administratively dead entities (mark_down -- the thrasher hook)
         self._marked_down: set = set()
-        #: peers whose last connect/send failed; retried on next send
-        self._unreachable: set = set()
+        #: peers whose last connect/send failed, with WHEN it failed:
+        #: unreachability is a cached observation, not a verdict, and it
+        #: expires -- a revived daemon whose boot races one failed
+        #: connect must not be treated as down forever (its primary
+        #: would otherwise refuse reads with "only N shards" while every
+        #: peer is in fact alive)
+        self._unreachable: dict = {}
+        self._unreachable_ttl = 3.0
+        self._reprobing: set = set()
         #: live incoming-connection handler tasks (cancelled on shutdown;
         #: Server.wait_closed would otherwise block on them forever)
         self._serve_tasks: set = set()
@@ -221,7 +228,7 @@ class TCPMessenger:
             if session_key is None:
                 writer.close()  # failed handshake: refuse (-EACCES)
                 return
-        self._unreachable.discard(peer_node)
+        self._unreachable.pop(peer_node, None)
         # the peer (re)connected: any cached outgoing connection to it may
         # be a dead socket from its previous incarnation (writes into one
         # are silently buffered by TCP, losing replies) -- drop it so the
@@ -358,17 +365,17 @@ class TCPMessenger:
             try:
                 conn = await self._connect(node)
             except OSError:
-                self._unreachable.add(node)
+                self._unreachable[node] = asyncio.get_event_loop().time()
                 return
             self._conns[node] = conn
-            self._unreachable.discard(node)
+            self._unreachable.pop(node, None)
         _, writer, lock, skey = conn
         rec = frame(self._seal(payload, skey))
         async with lock:
             try:
                 writer.write(rec)
                 await writer.drain()
-                self._unreachable.discard(node)
+                self._unreachable.pop(node, None)
             except (ConnectionError, OSError):
                 self._conns.pop(node, None)
                 writer.close()
@@ -379,9 +386,9 @@ class TCPMessenger:
                     rec = frame(self._seal(payload, conn[3]))
                     conn[1].write(rec)
                     await conn[1].drain()
-                    self._unreachable.discard(node)
+                    self._unreachable.pop(node, None)
                 except OSError:
-                    self._unreachable.add(node)
+                    self._unreachable[node] = asyncio.get_event_loop().time()
 
     @staticmethod
     def _seal(payload: bytes, session_key) -> bytes:
@@ -405,10 +412,10 @@ class TCPMessenger:
         try:
             conn = await asyncio.wait_for(self._connect(node), timeout)
         except (OSError, asyncio.TimeoutError):
-            self._unreachable.add(node)
+            self._unreachable[node] = asyncio.get_event_loop().time()
             return False
         self._conns[node] = conn
-        self._unreachable.discard(node)
+        self._unreachable.pop(node, None)
         return True
 
     # -- liveness view (thrasher + _shard_up hooks) ------------------------
@@ -418,10 +425,35 @@ class TCPMessenger:
 
     def mark_up(self, name: str) -> None:
         self._marked_down.discard(name)
-        self._unreachable.discard(self._node_of(name) or name)
+        self._unreachable.pop(self._node_of(name) or name, None)
 
     def is_down(self, name: str) -> bool:
         if name in self._marked_down:
             return True
         node = self._node_of(name)
-        return node in self._unreachable if node is not None else False
+        if node is None:
+            return False
+        t = self._unreachable.get(node)
+        if t is None:
+            return False
+        if asyncio.get_event_loop().time() - t > self._unreachable_ttl:
+            # stale observation: still report down (a genuinely dead
+            # peer must not flap back up on a timer) but re-probe in the
+            # background -- a live peer clears itself, a dead one
+            # refreshes the timestamp
+            self._schedule_reprobe(node)
+        return True
+
+    def _schedule_reprobe(self, node: str) -> None:
+        if node in self._reprobing:
+            return
+        self._reprobing.add(node)
+
+        async def reprobe():
+            try:
+                await self.probe(node)
+            finally:
+                self._reprobing.discard(node)
+
+        task = asyncio.get_event_loop().create_task(reprobe())
+        self.adopt_task(f"reprobe.{node}", task)

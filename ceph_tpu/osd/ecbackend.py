@@ -547,11 +547,13 @@ class OSDShard:
             try:
                 omap = self.store.omap_get(soid)
                 ver = self.store.getattr(soid, "_meta_version") or 0
+                removed = bool(self.store.getattr(soid, "_meta_removed"))
             except FileNotFoundError:
-                omap, ver = None, 0
+                omap, ver, removed = None, 0, False
             await self.messenger.send_message(self.name, src, {
                 "op": "meta_get_reply", "tid": msg["tid"],
-                "omap": omap, "version": ver, "from": self.name,
+                "omap": omap, "version": ver, "removed": removed,
+                "from": self.name,
             })
         elif op == "meta_apply":
             # replicated metadata write: the message carries the FULL
@@ -565,12 +567,38 @@ class OSDShard:
                 cur = self.store.getattr(soid, "_meta_version") or 0
             except FileNotFoundError:
                 cur = 0
+            if msg.get("remove"):
+                # object removal leaves a VERSIONED TOMBSTONE (cleared
+                # omap + removed flag), not a bare delete: a replica
+                # that missed the remove holds the old keys at a lower
+                # version, and highest-version-wins recovery must
+                # propagate the removal, never resurrect the keys.
+                # Written even when no twin exists here: the removal
+                # record must survive somewhere, or a down replica's
+                # stale keys would be the only (hence winning) state
+                # when it revives.
+                if ver >= cur:
+                    self.pglog.append(soid, "remove", (ver, ""),
+                                      rollbackable=False)
+                    self.pglog.maybe_trim()
+                    self.store.queue_transaction(
+                        Transaction()
+                        .omap_clear(soid)
+                        .setattr(soid, "_meta_version", ver)
+                        .setattr(soid, "_meta_removed", True)
+                    )
+                await self.messenger.send_message(self.name, src, {
+                    "op": "meta_apply_reply", "tid": msg["tid"],
+                    "from": self.name, "applied": ver >= cur,
+                })
+                return
             if ver >= cur:
                 txn = (
                     Transaction()
                     .omap_clear(soid)
                     .omap_setkeys(soid, msg["omap"])
                     .setattr(soid, "_meta_version", ver)
+                    .setattr(soid, "_meta_removed", False)
                 )
                 # log the apply so delta peering discovers meta staleness
                 # the same way it does chunk staleness (full-state omap
@@ -1103,6 +1131,34 @@ class ECBackend:
             f"osd.{acting[s]}"
         )
 
+    async def _reconfirm_up(self, acting, up_shards):
+        """Probe down-looking acting holders (concurrently, at most once
+        per second) and return the refreshed up set.  No-op on
+        messengers without a probe (the in-process bus's is_down is
+        authoritative).  A genuinely-dead cluster pays one probe round
+        per second, not one per read."""
+        probe = getattr(self.messenger, "probe", None)
+        if probe is None:
+            return up_shards
+        now = asyncio.get_event_loop().time()
+        if now - getattr(self, "_last_reconfirm", 0.0) < 1.0:
+            return up_shards
+        self._last_reconfirm = now
+
+        async def one(entity):
+            try:
+                await probe(entity, timeout=1.0)
+            except TypeError:
+                await probe(entity)
+            except (OSError, asyncio.TimeoutError):
+                pass
+
+        await asyncio.gather(*(
+            one(f"osd.{acting[s]}") for s in range(self.km)
+            if s not in up_shards and acting[s] is not None
+        ))
+        return [s for s in range(self.km) if self._shard_up(acting, s)]
+
     # -- write path --------------------------------------------------------
 
     async def dispatch(self, src: str, msg) -> None:
@@ -1586,6 +1642,12 @@ class ECBackend:
             for s in range(self.km)
             if self._shard_up(acting, s)
         ]
+        if len(up_shards) < self.k:
+            # don't fail on a possibly-stale liveness view: probe the
+            # down-looking holders once (the reference re-peers on
+            # heartbeat recovery; a just-revived primary's messenger may
+            # carry unreachable marks from boot-time connect races)
+            up_shards = await self._reconfirm_up(acting, up_shards)
         want = ecutil.data_positions(self.ec)
         minimum = self.ec.minimum_to_decode(want, up_shards)
         chunks, logical_size, _, _ = await self._gather_consistent(
@@ -1891,6 +1953,16 @@ class ECBackend:
         # "removed" object readable again.  m+1 deletions cap survivors
         # at k-1 (the reference gets this from PG-log replay at peering).
         await self._await_commits(oid, tid, done, min_acks=self.m + 1)
+        # librados remove deletes the object's omap with it (omap lives
+        # IN the object there); drop the replicated meta twin too or a
+        # recreated same-name object inherits stale keys and listings
+        # keep showing the deleted name
+        try:
+            await self._meta_remove(oid)
+        except IOError:
+            # every replica unreachable right now: flag for peering so
+            # the tombstone is retried rather than silently forgotten
+            self._dirty_meta.add(oid)
         self.extent_cache.invalidate(oid)
 
     # -- metadata plane: replicated omap / CAS / watch-notify / cls --------
@@ -1937,19 +2009,27 @@ class ECBackend:
         state = self._pending.pop(tid)
         return state["replies"]
 
-    async def _meta_read(self, oid: str) -> Dict[str, bytes]:
-        """Highest-versioned replica's omap (+ learn the version)."""
+    async def _meta_read_full(self, oid: str):
+        """(omap, version, removed) of the highest-versioned replica
+        (+ learn the version).  A removed tombstone reads as empty."""
         targets = self._meta_targets(oid)
         replies = await self._meta_roundtrip(
             targets, {"op": "meta_get", "oid": oid}
         )
-        best_ver, best = 0, None
+        best_ver, best, removed = 0, None, False
         for r in replies.values():
             if r.get("omap") is not None and r["version"] >= best_ver:
                 best_ver, best = r["version"], r["omap"]
+                removed = bool(r.get("removed"))
         if best_ver > self._meta_versions.get(oid, 0):
             self._meta_versions[oid] = best_ver
-        return best if best is not None else {}
+        if removed or best is None:
+            return {}, best_ver, removed
+        return best, best_ver, removed
+
+    async def _meta_read(self, oid: str) -> Dict[str, bytes]:
+        omap, _ver, _removed = await self._meta_read_full(oid)
+        return omap
 
     async def _meta_write(self, oid: str, sets=None, rms=None,
                           clear=False) -> None:
@@ -1974,6 +2054,31 @@ class ECBackend:
             raise IOError(f"metadata write for {oid} reached no OSD")
         if len(replies) < len(targets):
             self._dirty_meta.add(oid)  # a replica missed this version
+
+    #: tombstones jump a whole version GENERATION: a down replica whose
+    #: solo-acked writes put it a few versions ahead of what the remover
+    #: could read must still lose to the tombstone under highest-version
+    #: recovery.  Packing the generation into the integer keeps every
+    #: existing comparison (peering tuples included) working unchanged.
+    TOMBSTONE_GEN = 1 << 32
+
+    async def _meta_remove(self, oid: str) -> None:
+        """Tombstone the meta twin on every replica (object removal).
+        Versioned like any meta write so a replica that missed it is
+        repaired by highest-version-wins recovery -- towards the
+        tombstone, never back to the deleted keys."""
+        targets = self._meta_targets(oid, mark_dirty=True)
+        await self._meta_read(oid)  # learn the current version
+        ver = self._meta_versions.get(oid, 0) + self.TOMBSTONE_GEN
+        self._meta_versions[oid] = ver
+        replies = await self._meta_roundtrip(targets, {
+            "op": "meta_apply", "oid": oid, "version": ver,
+            "remove": True, "omap": {},
+        })
+        if not replies:
+            raise IOError(f"metadata remove for {oid} reached no OSD")
+        if len(replies) < len(targets):
+            self._dirty_meta.add(oid)  # a replica missed the tombstone
 
     async def omap_set(self, oid: str, kvs: Dict[str, bytes]) -> None:
         await self._meta_write(oid, sets=dict(kvs))
@@ -2753,12 +2858,15 @@ class ECBackend:
             async def recover_meta(oid, stale):
                 async with sem:
                     try:
-                        # full-state re-apply: replicas converge in one step
-                        omap = await self._meta_read(oid)
-                        ver = self._meta_versions.get(oid, 0)
+                        # full-state re-apply: replicas converge in one
+                        # step; a removal tombstone propagates AS a
+                        # tombstone (re-applying it as a plain write
+                        # would resurrect the deleted name)
+                        omap, ver, removed = await self._meta_read_full(oid)
                         await self._meta_roundtrip(stale, {
                             "op": "meta_apply", "oid": oid,
                             "version": ver, "omap": omap,
+                            "remove": removed,
                         })
                     except asyncio.CancelledError:
                         raise

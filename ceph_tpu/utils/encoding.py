@@ -31,7 +31,7 @@ _MAGIC = 0xCE9B10C5
 
 # value tags
 _T_NONE, _T_FALSE, _T_TRUE, _T_INT, _T_NEGINT, _T_BYTES, _T_STR, _T_LIST, \
-    _T_DICT, _T_TUPLE = range(10)
+    _T_DICT, _T_TUPLE, _T_FLOAT = range(11)
 
 
 class Encoder:
@@ -89,6 +89,9 @@ class Encoder:
                 self.u8(_T_INT).varint(v)
             else:
                 self.u8(_T_NEGINT).varint(-v)
+        elif isinstance(v, float):
+            self.u8(_T_FLOAT)
+            self._parts.append(struct.pack("<d", v))
         elif isinstance(v, (bytes, bytearray, memoryview)):
             self.u8(_T_BYTES).blob(bytes(v))
         elif isinstance(v, str):
@@ -180,6 +183,8 @@ class Decoder:
             return tuple(self.value() for _ in range(self.varint()))
         if tag == _T_DICT:
             return {self.string(): self.value() for _ in range(self.varint())}
+        if tag == _T_FLOAT:
+            return struct.unpack("<d", self._take(8))[0]
         raise ValueError(f"bad value tag {tag}")
 
 

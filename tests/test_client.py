@@ -57,3 +57,99 @@ def test_lrc_pool(rados):
     data = os.urandom(5000)
     io.write_full("x", data)
     assert io.read("x") == data
+
+
+def test_remove_drops_omap_with_object():
+    """librados remove deletes the object's omap with it: a recreated
+    same-name object must not inherit stale keys, and listings must not
+    keep showing the deleted name through an empty meta twin."""
+    import asyncio
+
+    from ceph_tpu.osd.cluster import ECCluster
+    from ceph_tpu.utils.perf import PerfCounters
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        await c.backend.write("obj", b"data")
+        await c.backend.omap_set("obj", {"k": b"v"})
+        await c.backend.remove_object("obj")
+        for osd in c.osds:
+            for stored in osd.store.list_objects():
+                if stored == "obj@meta":
+                    # a VERSIONED tombstone (not live state) may remain
+                    assert osd.store.getattr(stored, "_meta_removed")
+                    assert osd.store.omap_get(stored) == {}
+                else:
+                    assert not stored.startswith("obj@"), stored
+        await c.backend.write("obj", b"fresh")
+        assert await c.backend.omap_get("obj") == {}
+        await c.shutdown()
+
+    asyncio.run(main())
+
+
+def test_removed_omap_never_resurrects_from_stale_replica():
+    """A replica that missed the removal holds the old keys at a LOWER
+    version; the tombstone must win highest-version recovery and a
+    recreated object must not inherit the dead keys (the unversioned-
+    delete design failed exactly this)."""
+    import asyncio
+
+    from ceph_tpu.osd.cluster import ECCluster
+    from ceph_tpu.utils.perf import PerfCounters
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        await c.backend.write("obj", b"data")
+        for i in range(3):  # meta version climbs
+            await c.backend.omap_set("obj", {"k": f"v{i}".encode()})
+        # one meta replica misses the removal
+        meta_holder = c.backend.acting_set("obj")[0]
+        c.kill_osd(meta_holder if meta_holder is not None else 0)
+        await c.backend.remove_object("obj")
+        c.revive_osd(meta_holder if meta_holder is not None else 0)
+        # recreate: stale replica's old keys must NOT merge back in
+        await c.backend.write("obj", b"fresh")
+        await c.backend.omap_set("obj", {"new": b"x"})
+        assert await c.backend.omap_get("obj") == {"new": b"x"}
+        await c.shutdown()
+
+    asyncio.run(main())
+
+
+def test_tombstone_outranks_higher_versioned_stale_replica():
+    """A down replica may hold solo-acked omap writes at a HIGHER
+    version than anything the remover could read; the tombstone's
+    generation jump must still outrank it in recovery."""
+    import asyncio
+
+    from ceph_tpu.osd.cluster import ECCluster
+    from ceph_tpu.utils.perf import PerfCounters
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        await c.backend.write("obj", b"data")
+        await c.backend.omap_set("obj", {"k": b"v1"})  # all replicas
+        acting = [a for a in c.backend.acting_set("obj") if a is not None]
+        survivor, others = acting[0], acting[1:]
+        # writes acked ONLY by the survivor push its version ahead
+        for o in others:
+            c.kill_osd(o)
+        await c.backend.omap_set("obj", {"k": b"v2-solo"})
+        for o in others:
+            c.revive_osd(o)
+        c.kill_osd(survivor)  # now IT misses the removal
+        await c.backend.remove_object("obj")
+        c.revive_osd(survivor)
+        # recreate through a FRESH client (no version cache)
+        fresh = c.new_client("client.fresh")
+        await fresh.write("obj", b"new life")
+        await fresh.omap_set("obj", {"n": b"1"})
+        assert await fresh.omap_get("obj") == {"n": b"1"}
+        assert await c.backend.omap_get("obj") == {"n": b"1"}
+        await c.shutdown()
+
+    asyncio.run(main())

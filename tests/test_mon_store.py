@@ -127,3 +127,34 @@ def test_minority_survivor_recovers_committed_state(tmp_path):
         mc.close_stores()
 
     run(main())
+
+
+def test_clog_with_float_stamp_persists(tmp_path):
+    """Cluster-log entries carry float stamps; the durable store must
+    encode them (a TypeError here silently killed every 'log' command
+    on store-backed monitors before floats entered the encoding
+    framework)."""
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms, store_dir=str(tmp_path))
+        await mc.form_quorum()
+        cl = _client(ms)
+        rc, _out = await cl.command({
+            "prefix": "log", "who": "osd.0", "level": "warn",
+            "message": "slow request", "stamp": 1234.5678})
+        assert rc == 0
+        rc, out = await cl.command({"prefix": "log last", "num": 5})
+        assert out[-1]["stamp"] == 1234.5678
+        await ms.shutdown()
+        mc.close_stores()
+
+        # restart: the entry survived the durable store round-trip
+        ms2 = Messenger()
+        mc2 = MonCluster(3, ms2, store_dir=str(tmp_path))
+        assert mc2.mons[0].clog.entries[-1]["stamp"] == 1234.5678
+        assert mc2.mons[0].clog.entries[-1]["message"] == "slow request"
+        await ms2.shutdown()
+        mc2.close_stores()
+
+    run(main())

@@ -8,7 +8,7 @@ framework's JSON-serialized OSDMap (ceph_tpu/mon/osdmap.py) and the
 real CRUSH engine (ceph_tpu/osd/placement.py).
 
 Usage:
-  osdmaptool.py <mapfile> --createsimple <numosd> [--pg-num N]
+  osdmaptool.py <mapfile> --createsimple <numosd>
   osdmaptool.py <mapfile> --create-pool <name> --k K --m M [--pg-num N]
   osdmaptool.py <mapfile> --print
   osdmaptool.py <mapfile> --mark-out <osd> | --mark-in <osd>

@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""rados: the object CLI against a running vstart cluster.
+
+Reference: src/tools/rados/rados.cc -- put/get/rm/stat/ls/df plus omap
+key commands against a live pool.  Connects through the same
+RemoteClient/Objecter path every librados user takes; ``ls`` and ``df``
+aggregate over the daemons' admin sockets (the reference lists via PG
+listing; the admin-socket union serves the same operator need on the
+mini-cluster).
+
+Usage:
+  rados_cli.py --dir RUN put <obj> <file>
+  rados_cli.py --dir RUN get <obj> [<file>]      (default: stdout)
+  rados_cli.py --dir RUN rm <obj>
+  rados_cli.py --dir RUN stat <obj>
+  rados_cli.py --dir RUN ls
+  rados_cli.py --dir RUN df
+  rados_cli.py --dir RUN setomapval <obj> <key> <value>
+  rados_cli.py --dir RUN listomapvals <obj>
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.daemon.client import RemoteClient  # noqa: E402
+from ceph_tpu.utils.admin_socket import admin_command  # noqa: E402
+
+
+async def _connect(run_dir: str) -> RemoteClient:
+    with open(os.path.join(run_dir, "cluster.json")) as f:
+        conf = json.load(f)
+    keyring = None
+    kr_path = os.path.join(run_dir, "keyring")
+    if conf.get("auth") and os.path.exists(kr_path):
+        keyring = kr_path
+    c = await RemoteClient.connect(
+        os.path.join(run_dir, "addr_map.json"), dict(conf["profile"]),
+        keyring=keyring,
+    )
+    await c.probe_osds()  # learn down daemons up front so ops route
+    # around them instead of burning the full op timeout
+    return c
+
+
+def _asoks(run_dir: str):
+    # daemons drop sockets next to their data dir (RUN/data by default)
+    return sorted(glob.glob(os.path.join(run_dir, "osd.*.asok"))
+                  + glob.glob(os.path.join(run_dir, "data", "osd.*.asok")))
+
+
+async def _run(args) -> int:
+    if args.cmd == "ls":
+        seen = set()
+        for sock in _asoks(args.dir):
+            for stored in await admin_command(sock, "list_objects"):
+                # "<oid>@<shard|meta>" storage names -> logical oid
+                base, sep, _tag = stored.rpartition("@")
+                seen.add(base if sep else stored)
+        for oid in sorted(seen):
+            print(oid)
+        return 0
+    if args.cmd == "df":
+        total = 0
+        for sock in _asoks(args.dir):
+            st = await admin_command(sock, "status")
+            print(f"{st['name']}\t{st['objects']} stored objects")
+            total += st["objects"]
+        print(f"total\t{total}")
+        return 0
+
+    c = await _connect(args.dir)
+    try:
+        if args.cmd == "put":
+            with open(args.args[1], "rb") as f:
+                data = f.read()
+            await c.write(args.args[0], data)
+            print(f"wrote {len(data)} bytes to {args.args[0]}")
+        elif args.cmd == "get":
+            data = await c.read(args.args[0])
+            if len(args.args) > 1 and args.args[1] != "-":
+                with open(args.args[1], "wb") as f:
+                    f.write(data)
+                print(f"read {len(data)} bytes from {args.args[0]}")
+            else:
+                sys.stdout.buffer.write(data)
+        elif args.cmd == "rm":
+            await c.backend.remove_object(args.args[0])
+            print(f"removed {args.args[0]}")
+        elif args.cmd == "stat":
+            size, _hinfo = await c.backend.stat(args.args[0])
+            print(f"{args.args[0]} size {size}")
+        elif args.cmd == "setomapval":
+            await c.backend.omap_set(
+                args.args[0], {args.args[1]: args.args[2].encode()})
+            print("set")
+        elif args.cmd == "listomapvals":
+            omap = await c.backend.omap_get(args.args[0])
+            for k in sorted(omap):
+                v = omap[k]
+                print(f"{k}\t{v!r}")
+        else:
+            print(__doc__)
+            return 1
+    finally:
+        await c.close()
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--dir", required=True, help="vstart run dir")
+    p.add_argument("cmd")
+    p.add_argument("args", nargs="*")
+    args = p.parse_args(argv if argv is not None else sys.argv[1:])
+    return asyncio.new_event_loop().run_until_complete(_run(args))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
