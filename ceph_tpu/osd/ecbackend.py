@@ -1931,6 +1931,13 @@ class ECBackend:
             self.extent_cache.invalidate(oid)
             return
         self._snapsets.pop(oid, None)
+        # tombstone the meta twin BEFORE destroying data: if the
+        # tombstone cannot land anywhere the remove fails cleanly with
+        # the object intact, instead of leaving deleted data whose
+        # stale omap resurrects at the next recovery pass (the
+        # reference orders its delete the same way: the PG-log entry
+        # is durable before the objects go)
+        await self._meta_remove(oid)
         version = self._next_version(oid)
         tid = self._new_tid()
         done = asyncio.get_event_loop().create_future()
@@ -1953,16 +1960,6 @@ class ECBackend:
         # "removed" object readable again.  m+1 deletions cap survivors
         # at k-1 (the reference gets this from PG-log replay at peering).
         await self._await_commits(oid, tid, done, min_acks=self.m + 1)
-        # librados remove deletes the object's omap with it (omap lives
-        # IN the object there); drop the replicated meta twin too or a
-        # recreated same-name object inherits stale keys and listings
-        # keep showing the deleted name
-        try:
-            await self._meta_remove(oid)
-        except IOError:
-            # every replica unreachable right now: flag for peering so
-            # the tombstone is retried rather than silently forgotten
-            self._dirty_meta.add(oid)
         self.extent_cache.invalidate(oid)
 
     # -- metadata plane: replicated omap / CAS / watch-notify / cls --------

@@ -7,7 +7,9 @@ lands in data-pool objects, user/bucket metadata lives in meta objects
 (rgw_main.cc, rgw_rados.cc, rgw_bucket.cc).  Same decomposition here:
 
 * ``RGWGateway``   -- asyncio HTTP frontend (the civetweb/beast role)
-  with AWS-v2-style HMAC request signing;
+  serving S3 (AWS-v2 HMAC + SigV4 signing, multipart uploads) and
+  Swift (TempAuth tokens, account/container/object ops) over ONE
+  bucket namespace, like the reference's dual REST stacks;
 * users            -- omap on ``rgw.users`` (access -> secret, display);
 * buckets          -- omap on ``rgw.buckets`` (the bucket.instance
   metadata role) + one ``rgw.bucket.<name>`` index object per bucket

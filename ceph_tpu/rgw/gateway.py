@@ -110,6 +110,12 @@ class RGWGateway:
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
+        #: Swift auth tokens (X-Auth-Token -> (account, issued_at));
+        #: the reference keeps these in its expiring token cache
+        #: (rgw_swift_auth.cc)
+        self._swift_tokens: Dict[str, tuple] = {}
+
+    SWIFT_TOKEN_TTL = 3600.0
 
     # -- user admin (radosgw-admin user create role) -----------------------
 
@@ -270,6 +276,13 @@ class RGWGateway:
             )
 
     async def _handle(self, method, target, headers, body):
+        # Swift routing needs more than the path prefix: an S3 bucket
+        # may legitimately be NAMED "v1" or "auth", and its signed
+        # requests must not be diverted into the Swift stack
+        auth = headers.get("authorization", "")
+        if target.startswith(("/auth/", "/v1/")) and not \
+                auth.startswith(("AWS ", "AWS4-HMAC-SHA256 ")):
+            return await self._handle_swift(method, target, headers, body)
         bucket, key, params = self._split_target(target)
         resource = "/" + bucket + ("/" + key if key else "")
         path = target.partition("?")[0]
@@ -319,6 +332,94 @@ class RGWGateway:
             return await self._head_object(bucket, key)
         if method == "DELETE":
             return await self._delete_object(bucket, key)
+        raise S3Error("InvalidRequest", f"{method} on object")
+
+    # -- Swift API (rgw_rest_swift.cc + rgw_swift_auth.cc subset) ----------
+    #
+    # TempAuth flow: GET /auth/v1.0 with X-Storage-User "<account>:<user>"
+    # (the access key) + X-Storage-Pass (the secret) returns X-Auth-Token
+    # and X-Storage-Url; data ops are /v1/AUTH_<account>/<container>[/obj]
+    # with the token header.  Containers map onto the same bucket
+    # objects the S3 side uses, so both protocols see one namespace
+    # (the reference stores Swift containers as rgw buckets too).
+
+    async def _handle_swift(self, method, target, headers, body):
+        path = target.partition("?")[0]
+        if path == "/auth/v1.0":
+            user = headers.get("x-storage-user", "")
+            access = user.split(":", 1)[0]
+            secret = await self._secret_for(access)
+            if secret is None or not hmac.compare_digest(
+                    headers.get("x-storage-pass", ""), secret):
+                raise S3Error("AccessDenied", "bad swift credentials")
+            now = time.time()
+            # expire old tokens (the reference's token cache ages
+            # entries out; an immortal dict would leak AND keep stolen
+            # tokens valid forever)
+            self._swift_tokens = {
+                t: (acct, ts) for t, (acct, ts) in
+                self._swift_tokens.items()
+                if now - ts < self.SWIFT_TOKEN_TTL
+            }
+            tok = "AUTH_tk" + hashlib.sha256(
+                f"{access}:{secret}:{now}".encode()).hexdigest()[:32]
+            self._swift_tokens[tok] = (access, now)
+            return "200 OK", "text/plain", b"", {
+                "X-Auth-Token": tok,
+                "X-Storage-Url": f"http://{self.host}:{self.port}"
+                                 f"/v1/AUTH_{access}",
+            }
+        ent = self._swift_tokens.get(headers.get("x-auth-token", ""))
+        if ent is None or time.time() - ent[1] >= self.SWIFT_TOKEN_TTL:
+            raise S3Error("AccessDenied", "missing or expired auth token")
+        owner = ent[0]
+        parts = path.split("/", 4)  # ['', 'v1', 'AUTH_x', container, obj]
+        if len(parts) < 3 or parts[2] != f"AUTH_{owner}":
+            raise S3Error("AccessDenied", "token does not match account")
+        container = parts[3] if len(parts) > 3 else ""
+        obj = parts[4] if len(parts) > 4 else ""
+        if not container:
+            if method == "GET":  # account listing: containers, plain text
+                buckets = await self.backend.omap_get(BUCKETS_OID)
+                mine = sorted(
+                    n for n, raw in buckets.items()
+                    if raw.decode().split("\x00", 1)[0] == owner)
+                return "200 OK", "text/plain", \
+                    ("\n".join(mine) + "\n" if mine else "").encode(), {}
+            raise S3Error("InvalidRequest", f"{method} on account")
+        if not obj:
+            if method == "PUT":
+                try:
+                    await self._create_bucket(container, owner)
+                except S3Error as e:
+                    if e.code != "BucketAlreadyExists":
+                        raise
+                    # idempotent ONLY for the owner: 201 on someone
+                    # else's container would be a silent false success
+                    await self._check_owner(container, owner)
+                return "201 Created", "text/plain", b"", {}
+            await self._check_owner(container, owner)
+            if method == "DELETE":
+                await self._delete_bucket(container)
+                return "204 No Content", "text/plain", b"", {}
+            if method == "GET":  # object listing, plain text
+                index = await self.backend.omap_get(
+                    bucket_index_oid(container))
+                names = sorted(index)
+                return "200 OK", "text/plain", \
+                    ("\n".join(names) + "\n" if names else "").encode(), {}
+            raise S3Error("InvalidRequest", f"{method} on container")
+        await self._check_owner(container, owner)
+        if method == "PUT":
+            status, ctype, out, extra = await self._put_object(
+                container, obj, body)
+            return "201 Created", ctype, out, extra
+        if method == "GET":
+            return await self._get_object(container, obj)
+        if method == "HEAD":
+            return await self._head_object(container, obj)
+        if method == "DELETE":
+            return await self._delete_object(container, obj)
         raise S3Error("InvalidRequest", f"{method} on object")
 
     # -- bucket ops (rgw_bucket.cc) ----------------------------------------

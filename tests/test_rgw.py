@@ -375,3 +375,91 @@ def test_bucket_delete_aborts_inflight_uploads():
         await gw.stop(); await c.shutdown()
 
     run(main())
+
+
+# -- Swift API (rgw_rest_swift subset) ---------------------------------------
+
+
+async def _swift_request(port, method, target, body=b"", headers=None):
+    lines = [f"{method} {target} HTTP/1.1", "Host: localhost",
+             f"Content-Length: {len(body)}"]
+    for k, v in (headers or {}).items():
+        lines.append(f"{k}: {v}")
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    writer.write("\r\n".join(lines).encode() + b"\r\n\r\n" + body)
+    await writer.drain()
+    raw = await reader.read()
+    writer.close()
+    head, _, payload = raw.partition(b"\r\n\r\n")
+    status = int(head.split()[1])
+    hdrs = {}
+    for ln in head.decode().split("\r\n")[1:]:
+        k, _, v = ln.partition(":")
+        hdrs[k.strip().lower()] = v.strip()
+    return status, hdrs, payload
+
+
+def test_swift_auth_and_object_lifecycle():
+    async def main():
+        c, gw, port = await _gateway()
+        # TempAuth: bad pass refused, good pass issues a token
+        st, _, _b = await _swift_request(port, "GET", "/auth/v1.0", headers={
+            "X-Storage-User": f"{ACCESS}:swift", "X-Storage-Pass": "wrong"})
+        assert st == 403
+        st, hdrs, _b = await _swift_request(port, "GET", "/auth/v1.0",
+            headers={"X-Storage-User": f"{ACCESS}:swift",
+                     "X-Storage-Pass": SECRET})
+        assert st == 200 and "x-auth-token" in hdrs
+        tok = {"X-Auth-Token": hdrs["x-auth-token"]}
+        # no/bad token refused
+        st, _, _b = await _swift_request(
+            port, "PUT", f"/v1/AUTH_{ACCESS}/cont")
+        assert st == 403
+        # container + object lifecycle
+        st, _, _b = await _swift_request(
+            port, "PUT", f"/v1/AUTH_{ACCESS}/cont", headers=tok)
+        assert st == 201
+        payload = os.urandom(60_000)
+        st, hdrs, _b = await _swift_request(
+            port, "PUT", f"/v1/AUTH_{ACCESS}/cont/data.bin",
+            body=payload, headers=tok)
+        assert st == 201
+        assert hdrs["etag"].strip('"') == hashlib.md5(payload).hexdigest()
+        st, _, got = await _swift_request(
+            port, "GET", f"/v1/AUTH_{ACCESS}/cont/data.bin", headers=tok)
+        assert st == 200 and got == payload
+        st, _, listing = await _swift_request(
+            port, "GET", f"/v1/AUTH_{ACCESS}/cont", headers=tok)
+        assert listing == b"data.bin\n"
+        st, _, accounts = await _swift_request(
+            port, "GET", f"/v1/AUTH_{ACCESS}", headers=tok)
+        assert accounts == b"cont\n"
+        # one namespace with S3: the same object is visible via S3 GET
+        st, _, s3got = await _request(port, "GET", "/cont/data.bin")
+        assert st == 200 and s3got == payload
+        st, _, _b = await _swift_request(
+            port, "DELETE", f"/v1/AUTH_{ACCESS}/cont/data.bin", headers=tok)
+        assert st == 204
+        st, _, _b = await _swift_request(
+            port, "DELETE", f"/v1/AUTH_{ACCESS}/cont", headers=tok)
+        assert st == 204
+        await gw.stop(); await c.shutdown()
+
+    run(main())
+
+
+def test_swift_cross_account_denied():
+    async def main():
+        c, gw, port = await _gateway()
+        await gw.create_user("other", "othersecret", "Other")
+        st, hdrs, _b = await _swift_request(port, "GET", "/auth/v1.0",
+            headers={"X-Storage-User": "other:swift",
+                     "X-Storage-Pass": "othersecret"})
+        tok = {"X-Auth-Token": hdrs["x-auth-token"]}
+        # other's token cannot address ACCESS's account path
+        st, _, _b = await _swift_request(
+            port, "PUT", f"/v1/AUTH_{ACCESS}/steal", headers=tok)
+        assert st == 403
+        await gw.stop(); await c.shutdown()
+
+    run(main())
