@@ -99,6 +99,11 @@ async def serve(args) -> None:
             "pools": sorted(shard.pools),
         })
         asok.register("list_objects", lambda cmd: sorted(_live_objects()))
+        asok.register("hit_set ls", lambda cmd: shard.hitsets.dump())
+        asok.register("hit_set temperature", lambda cmd: {
+            "oid": cmd.get("oid", ""),
+            "temperature": shard.hitsets.temperature(cmd.get("oid", "")),
+        })
         from ceph_tpu.utils import perfglue
 
         perfglue.register(asok)  # cpu_profiler start/stop/status

@@ -159,6 +159,11 @@ class OSDShard:
             HistogramAxis("latency_usec", 0, 64, 32, "log2"),
             HistogramAxis("size_bytes", 0, 512, 24, "log2"),
         )
+        # object-access temperature tracking (src/osd/HitSet.h; feeds
+        # the tiering-agent role and the admin-socket hit_set commands)
+        from ceph_tpu.osd.hitset import HitSetTracker
+
+        self.hitsets = HitSetTracker()
         self.op_queue_type = op_queue
         if op_queue == "mclock":
             self.opq = MClockQueue(dict(MCLOCK_DEFAULTS))
@@ -849,6 +854,8 @@ class OSDShard:
         op.finish()
         self.op_hist.inc(op.duration * 1e6,
                          len(msg.get("data") or b""))
+        if msg.get("oid"):
+            self.hitsets.record(msg["oid"])
         if self.frozen or self.messenger.is_down(self.name):
             return
         await self.messenger.send_message(self.name, src, reply)

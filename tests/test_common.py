@@ -252,3 +252,79 @@ def test_tcp_dispatch_throttle_backpressures_without_deadlock():
         await mb.shutdown()
 
     asyncio.run(main())
+
+
+# -- HitSet (src/osd/HitSet.h) -----------------------------------------------
+
+
+def test_hitset_explicit_and_bloom_membership():
+    from ceph_tpu.osd.hitset import BloomHitSet, ExplicitHitSet
+
+    e = ExplicitHitSet()
+    for i in range(100):
+        e.insert(f"obj{i}")
+    assert all(e.contains(f"obj{i}") for i in range(100))
+    assert not e.contains("never")
+    b = BloomHitSet(target_size=1000, fpp=0.01)
+    for i in range(1000):
+        b.insert(f"obj{i}")
+    assert all(b.contains(f"obj{i}") for i in range(1000))  # no false neg
+    false_pos = sum(b.contains(f"other{i}") for i in range(10_000))
+    assert false_pos < 10_000 * 0.03  # ~1% target, 3x slack
+
+
+def test_hitset_tracker_rollover_and_temperature():
+    from ceph_tpu.osd.hitset import HitSetTracker
+
+    import time
+
+    t = HitSetTracker(kind="explicit_hash", period=10.0, count=3)
+    now = time.time()  # tracker stamps its first period at wall-now
+    t.current_start = now
+    # hot object touched every period; cold only in the oldest
+    for p in range(5):
+        t.record("hot", now=now + p * 10)
+        if p == 0:
+            t.record("cold_once", now=now + p * 10)
+    assert t.temperature("hot", now=now + 41) == 1.0
+    # the oldest period fell out of the window (count=3 archives)
+    assert t.temperature("cold_once", now=now + 41) == 0.0
+    assert t.temperature("never", now=now + 41) == 0.0
+    d = t.dump()
+    assert d["kind"] == "explicit_hash" and len(d["archived"]) == 3
+
+
+def test_hitset_idle_gap_cools_objects():
+    """An object untouched for many periods must read cold even though
+    no record() call rolled the window in between (one roll spanning N
+    idle periods would keep it hot)."""
+    import time
+
+    from ceph_tpu.osd.hitset import HitSetTracker
+
+    t = HitSetTracker(kind="explicit_hash", period=10.0, count=3)
+    now = time.time()
+    t.current_start = now
+    t.record("x", now=now)
+    assert t.temperature("x", now=now + 1) > 0
+    # silence for 10 periods, then a single query
+    assert t.temperature("x", now=now + 100) == 0.0
+
+def test_hitset_wired_into_client_ops():
+    import asyncio
+
+    from ceph_tpu.osd.cluster import ECCluster
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        for _ in range(3):
+            await c.write("hot-obj", b"x" * 100)
+        # the primary's tracker saw the accesses
+        primary = c.primary_backend("hot-obj")
+        shard = next(o for o in c.osds if o.pools.get(c.pool) is primary)
+        assert shard.hitsets.temperature("hot-obj") > 0
+        assert shard.hitsets.temperature("cold-obj") == 0.0
+        await c.shutdown()
+
+    asyncio.run(main())

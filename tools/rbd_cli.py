@@ -80,6 +80,56 @@ async def _run(args) -> int:
                 else:
                     await img.snap_remove(snap)
                     print(f"removed snap {snap}")
+        elif args.cmd == "feature":
+            img = await Image.open(c.backend, args.image)
+            feat = args.feature_name
+            if args.feature_cmd == "enable":
+                await img.update_features(enable=[feat])
+            else:
+                await img.update_features(disable=[feat])
+            print(f"features of {args.image}: "
+                  f"{', '.join(img.features) or '(none)'}")
+        elif args.cmd == "journal":
+            from ceph_tpu.rbd import FEATURE_JOURNALING, ImageJournal
+
+            img = await Image.open(c.backend, args.image)
+            if FEATURE_JOURNALING not in img.features:
+                print(f"error: image {args.image} has no journaling "
+                      "feature (a status command must not create one)")
+                return 1
+            jr = ImageJournal(c.backend, args.image)
+            await jr.open()
+            if args.journal_cmd == "status":
+                clients = await jr.j.clients()
+                print(f"journal for {args.image}: "
+                      f"write_pos {jr.j.write_pos} "
+                      f"commit_pos {jr.j.commit_pos} "
+                      f"expire_pos {jr.j.expire_pos}")
+                for cid, pos in sorted(clients.items()):
+                    print(f"\tclient {cid}: position {pos}")
+            elif args.journal_cmd == "inspect":
+                for start, _end, ev in await jr.j.replay_entries(
+                        jr.j.expire_pos):
+                    desc = {k: (f"<{len(v)} bytes>"
+                                if isinstance(v, bytes) else v)
+                            for k, v in ev.items()}
+                    print(f"{start}\t{desc}")
+        elif args.cmd == "mirror":
+            from ceph_tpu.rbd import mirror_disable, mirror_enable, \
+                mirror_list
+
+            if args.mirror_cmd in ("enable", "disable") and not args.image:
+                print(f"error: mirror {args.mirror_cmd} requires an image")
+                return 2
+            if args.mirror_cmd == "enable":
+                await mirror_enable(c.backend, args.image)
+                print(f"mirroring enabled for {args.image}")
+            elif args.mirror_cmd == "disable":
+                await mirror_disable(c.backend, args.image)
+                print(f"mirroring disabled for {args.image}")
+            elif args.mirror_cmd == "ls":
+                for name in await mirror_list(c.backend):
+                    print(name)
         elif args.cmd == "bench":
             img = await Image.open(c.backend, args.image)
             payload = os.urandom(args.io_size)
@@ -115,6 +165,18 @@ def main(argv=None) -> int:
         p = sub.add_parser(name, parents=[common])
         p.add_argument("image")
     sub.add_parser("ls", parents=[common])
+    p = sub.add_parser("feature", parents=[common])
+    p.add_argument("feature_cmd", choices=["enable", "disable"])
+    p.add_argument("image")
+    # only features the framework implements; a typo must not be
+    # persisted verbatim into the image header
+    p.add_argument("feature_name", choices=["journaling"])
+    p = sub.add_parser("journal", parents=[common])
+    p.add_argument("journal_cmd", choices=["status", "inspect"])
+    p.add_argument("image")
+    p = sub.add_parser("mirror", parents=[common])
+    p.add_argument("mirror_cmd", choices=["enable", "disable", "ls"])
+    p.add_argument("image", nargs="?", default="")
     p = sub.add_parser("import", parents=[common])
     p.add_argument("src")
     p.add_argument("image")
