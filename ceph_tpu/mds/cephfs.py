@@ -47,6 +47,45 @@ class CephFS:
     async def stat(self, path: str) -> dict:
         return await self.mds.stat(path)
 
+    async def lstat(self, path: str) -> dict:
+        """stat that does NOT follow a final symlink."""
+        _, dentry = await self.mds.resolve(path, follow=False)
+        if dentry is None:
+            raise FSError(2, f"no such file or directory: {path!r}")
+        return dentry
+
+    async def symlink(self, path: str, target: str) -> None:
+        await self.mds.symlink(path, target)
+
+    async def readlink(self, path: str) -> str:
+        return await self.mds.readlink(path)
+
+    # -- user xattrs -------------------------------------------------------
+
+    async def setxattr(self, path: str, name: str, value: bytes) -> None:
+        await self.mds.setxattr(path, name, value)
+
+    async def getxattr(self, path: str, name: str) -> bytes:
+        xattrs = await self.mds.getxattrs(path)
+        if name not in xattrs:
+            raise FSError(61, f"no xattr {name!r} on {path!r}")
+        return xattrs[name]
+
+    async def listxattr(self, path: str) -> List[str]:
+        return sorted(await self.mds.getxattrs(path))
+
+    async def removexattr(self, path: str, name: str) -> None:
+        await self.mds.removexattr(path, name)
+
+    # -- advisory locks ----------------------------------------------------
+
+    async def flock(self, path: str, owner: str,
+                    exclusive: bool = True) -> None:
+        await self.mds.flock(path, owner, exclusive=exclusive)
+
+    async def funlock(self, path: str, owner: str) -> None:
+        await self.mds.funlock(path, owner)
+
     async def rename(self, src: str, dst: str) -> None:
         await self.mds.rename(src, dst)
 
@@ -55,8 +94,11 @@ class CephFS:
 
     async def unlink(self, path: str) -> None:
         """Remove the file and purge its data objects (the purge-queue
-        role, client-side)."""
+        role, client-side; the MDS purges flock state under its mutate
+        lock so a racing flock cannot recreate it)."""
         dentry = await self.mds.unlink(path)
+        if dentry["type"] == "l":
+            return  # a symlink has no data objects
         layout = FileLayout(*self._layout_tuple(dentry))
         striper = Striper(layout)
         for objno in range(striper.object_count(dentry["size"])):

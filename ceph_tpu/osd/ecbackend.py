@@ -1149,12 +1149,20 @@ class ECBackend:
             return up_shards
         now = asyncio.get_event_loop().time()
         if now - getattr(self, "_last_reconfirm", 0.0) < 1.0:
-            return up_shards
+            # rate-limit the probe I/O only -- the liveness VIEW must
+            # still be recomputed, or an op arriving just after another
+            # op's probe round would fail on the stale argument even
+            # though that round (or a background reprobe) healed it
+            return [s for s in range(self.km)
+                    if self._shard_up(acting, s)]
         self._last_reconfirm = now
 
         async def one(entity):
             try:
-                await probe(entity, timeout=1.0)
+                # generous timeout: under host load this process's
+                # event loop can stall past a short deadline while the
+                # peer is perfectly alive
+                await probe(entity, timeout=2.5)
             except TypeError:
                 await probe(entity)
             except (OSError, asyncio.TimeoutError):
@@ -1363,6 +1371,8 @@ class ECBackend:
             if self._shard_up(acting, s)
         ]
         # min_size: an EC pool needs at least k live shards to accept writes
+        if len(up) < self.k:
+            up = await self._reconfirm_up(acting, up)  # stale liveness?
         if len(up) < self.k:
             raise IOError(f"cannot write {oid}: only {len(up)} shards up")
         placed = [s for s in range(self.km) if acting[s] is not None]
@@ -1838,6 +1848,8 @@ class ECBackend:
             for s in range(self.km)
             if self._shard_up(acting, s)
         ]
+        if len(up) < self.k:
+            up = await self._reconfirm_up(acting, up)  # stale liveness?
         if len(up) < self.k:
             raise IOError(f"cannot write {oid}: only {len(up)} shards up")
         if len(up) < len([s for s in range(self.km) if acting[s] is not None]):

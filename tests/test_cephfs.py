@@ -211,3 +211,161 @@ def test_journal_seq_survives_clean_restart():
         await c.shutdown()
 
     run(main())
+
+
+def test_symlinks_follow_and_lstat():
+    async def main():
+        c, fs = await _mkfs()
+        await fs.mkdirs("/data/real")
+        await fs.write_file("/data/real/file.txt", b"through the link")
+        await fs.symlink("/shortcut", "/data/real")
+        # mid-path traversal follows the link
+        assert await fs.read_file("/shortcut/file.txt") == \
+            b"through the link"
+        assert (await fs.stat("/shortcut"))["type"] == "d"  # followed
+        assert (await fs.lstat("/shortcut"))["type"] == "l"
+        assert await fs.readlink("/shortcut") == "/data/real"
+        # unlink removes the LINK, never the target
+        await fs.unlink("/shortcut")
+        assert await fs.read_file("/data/real/file.txt") == \
+            b"through the link"
+        # dangling symlink + loop protection
+        await fs.symlink("/a", "/b")
+        await fs.symlink("/b", "/a")
+        try:
+            await fs.stat("/a")
+            raise AssertionError("symlink loop resolved?!")
+        except OSError as e:
+            assert e.errno in (2, 40)
+        await c.shutdown()
+
+    run(main())
+
+
+def test_xattrs_journal_and_survive_mds_takeover():
+    async def main():
+        c, fs = await _mkfs()
+        await fs.write_file("/doc", b"x")
+        await fs.setxattr("/doc", "user.owner", b"alice")
+        await fs.setxattr("/doc", "user.tag", b"blue")
+        assert await fs.getxattr("/doc", "user.owner") == b"alice"
+        assert await fs.listxattr("/doc") == ["user.owner", "user.tag"]
+        await fs.removexattr("/doc", "user.tag")
+        assert await fs.listxattr("/doc") == ["user.owner"]
+        try:
+            await fs.getxattr("/doc", "user.tag")
+            raise AssertionError("removed xattr still present")
+        except OSError as e:
+            assert e.errno == 61
+        # a standby MDS taking over sees the xattrs (journaled state)
+        from ceph_tpu.mds import CephFS
+
+        fs2 = await CephFS.mount(c.backend)
+        assert await fs2.getxattr("/doc", "user.owner") == b"alice"
+        await c.shutdown()
+
+    run(main())
+
+
+def test_flock_shared_exclusive_semantics():
+    async def main():
+        c, fs = await _mkfs()
+        await fs.write_file("/db", b"data")
+        await fs.flock("/db", "client.a", exclusive=True)
+        try:
+            await fs.flock("/db", "client.b", exclusive=True)
+            raise AssertionError("second exclusive lock granted")
+        except BlockingIOError:
+            pass
+        try:
+            await fs.flock("/db", "client.b", exclusive=False)
+            raise AssertionError("shared lock granted under exclusive")
+        except BlockingIOError:
+            pass
+        await fs.funlock("/db", "client.a")
+        # shared locks coexist; exclusive then refused
+        await fs.flock("/db", "client.a", exclusive=False)
+        await fs.flock("/db", "client.b", exclusive=False)
+        try:
+            await fs.flock("/db", "client.c", exclusive=True)
+            raise AssertionError("exclusive granted over shared holders")
+        except BlockingIOError:
+            pass
+        # re-upgrade by the sole holder after the other releases
+        await fs.funlock("/db", "client.b")
+        await fs.flock("/db", "client.a", exclusive=True)
+        await c.shutdown()
+
+    run(main())
+
+
+def test_write_through_symlink_updates_real_file():
+    """Mutations through a final symlink must land on the TARGET's
+    dentry (journaled under the resolved name, not the link name)."""
+
+    async def main():
+        c, fs = await _mkfs()
+        await fs.mkdirs("/data")
+        await fs.write_file("/data/file.txt", b"")
+        await fs.symlink("/link", "/data/file.txt")
+        await fs.write_file("/link", b"written via link")
+        assert await fs.read_file("/data/file.txt") == b"written via link"
+        assert (await fs.stat("/data/file.txt"))["size"] == 16
+        await fs.setxattr("/link", "user.k", b"v")
+        assert await fs.getxattr("/data/file.txt", "user.k") == b"v"
+        await c.shutdown()
+
+    run(main())
+
+
+def test_rename_cycle_guard_sees_through_symlinks():
+    async def main():
+        c, fs = await _mkfs()
+        await fs.mkdirs("/data/sub")
+        await fs.symlink("/alias", "/data")
+        try:
+            await fs.rename("/data", "/alias/trap")
+            raise AssertionError("subtree orphaned via symlink alias")
+        except OSError as e:
+            assert e.errno == 22
+        # the tree is intact and still usable
+        await fs.write_file("/data/sub/ok", b"alive")
+        assert await fs.read_file("/data/sub/ok") == b"alive"
+        await c.shutdown()
+
+    run(main())
+
+
+def test_unlink_purges_flock_state():
+    async def main():
+        c, fs = await _mkfs()
+        await fs.write_file("/f", b"x")
+        ino = (await fs.stat("/f"))["ino"]
+        await fs.flock("/f", "holder")
+        await fs.unlink("/f")
+        # the lock object went with the file
+        omap = await c.backend.omap_get(f"{ino:x}.flock")
+        assert omap == {}
+        await c.shutdown()
+
+    run(main())
+
+
+def test_rmdir_on_symlink_is_enotdir():
+    """POSIX: rmdir of a symlink fails ENOTDIR and must never delete
+    the target directory through the link."""
+
+    async def main():
+        c, fs = await _mkfs()
+        await fs.mkdir("/real")
+        await fs.symlink("/alias", "/real")
+        try:
+            await fs.rmdir("/alias")
+            raise AssertionError("rmdir followed the symlink")
+        except OSError as e:
+            assert e.errno == 20
+        assert (await fs.stat("/real"))["type"] == "d"  # target intact
+        assert (await fs.lstat("/alias"))["type"] == "l"
+        await c.shutdown()
+
+    run(main())
