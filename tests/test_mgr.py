@@ -197,3 +197,41 @@ def test_mgr_daemon_metrics_via_module():
         await c.shutdown()
 
     run(main())
+
+
+def test_balancer_module_scores_and_reweights():
+    """pybind/mgr/balancer role: score the shard distribution, bounded
+    CRUSH down-weighting of overloaded OSDs on optimize."""
+    from ceph_tpu.mgr.module_host import PyModuleRegistry
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(5, {"plugin": "jerasure", "k": "2", "m": "1"})
+        for i in range(40):
+            await c.write(f"obj{i}", b"d" * 3000)
+        host = PyModuleRegistry(c, modules=["balancer"])
+        rc, out, _ = host.handle_command({"prefix": "balancer status"})
+        assert rc == 0 and "score" in out
+        rc, out, _ = host.handle_command({"prefix": "balancer eval"})
+        assert rc == 0 and "ideal shards/osd" in out
+        before = [w / 0x10000 for w in c.placement.weights]
+        epoch0 = c.placement.epoch
+        rc, out, _ = host.handle_command({"prefix": "balancer optimize"})
+        assert rc == 0
+        after = [w / 0x10000 for w in c.placement.weights]
+        # bounded; from a pristine all-1.0 state only decreases happen
+        for w, b in zip(after, before):
+            assert 0.25 <= w <= 1.0
+            assert w <= b + 1e-9
+        if "reweighted" in out:
+            assert c.placement.epoch > epoch0  # remap epoch bumped
+            assert any(w < 1.0 for w in after)
+        # an admin-drained osd (weight 0) must never be resurrected
+        c.placement.mark_out(1)
+        host.handle_command({"prefix": "balancer optimize"})
+        assert c.placement.weights[1] == 0
+        rc, out, _ = host.handle_command({"prefix": "balancer bogus"})
+        assert rc == -22
+        await c.shutdown()
+
+    run(main())

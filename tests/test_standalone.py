@@ -248,3 +248,80 @@ def test_admin_socket_perf_config_ops(cluster):
         assert "error" in bad
 
     asyncio.new_event_loop().run_until_complete(run())
+
+
+def test_process_cluster_thrash_with_auto_recovery(tmp_path):
+    """Chaos over REAL daemons (the qa/suites thrash-erasure-code tier,
+    §4.4): random SIGKILL/revive of OSD processes while a client keeps
+    writing, with each daemon's background peering+recovery tick live.
+    Every object must be readable and current at the end, with no
+    manual recovery calls."""
+    import random
+    import time as _t
+
+    rng = random.Random(0xCE9B)
+    run_dir = str(tmp_path / "run")
+    vstart.start_cluster(run_dir, 5, PROFILE, objectstore="filestore",
+                         wait=30.0)
+
+    async def run():
+        c = await _connect(run_dir)
+        expected = {}
+        down = set()
+        try:
+            for round_no in range(6):
+                # mutate a few objects (some new, some overwrites)
+                for i in range(4):
+                    oid = f"thrash-{rng.randrange(8)}"
+                    payload = bytes([rng.randrange(256)]) * \
+                        rng.randrange(2000, 60000)
+                    # a write can legally fail while shards die under
+                    # it; retrying the (idempotent) write makes the
+                    # expected final state deterministic
+                    for _attempt in range(10):
+                        try:
+                            await c.write(oid, payload)
+                            break
+                        except IOError:
+                            await asyncio.sleep(1.0)
+                            await c.probe_osds()
+                    else:
+                        raise AssertionError(f"write {oid} never landed")
+                    expected[oid] = payload
+                # chaos: at most ONE osd down at a time -- with k=2,m=1
+                # acting sets of width 3, two dead OSDs can legally
+                # block a pg entirely (min_size), which is unavailability
+                # by design, not a bug to thrash through
+                if not down and rng.random() < 0.8:
+                    victim = rng.randrange(5)
+                    vstart.kill_osd(run_dir, victim, sig=signal.SIGKILL)
+                    down.add(victim)
+                elif down and rng.random() < 0.7:
+                    back = down.pop()
+                    vstart.revive_osd(run_dir, back)
+                    await asyncio.sleep(1.0)
+                    await c.probe_osds()
+            # let everyone back up; auto-recovery converges the cluster
+            for osd in sorted(down):
+                vstart.revive_osd(run_dir, osd)
+            down.clear()
+            await asyncio.sleep(1.0)
+            await c.probe_osds()
+            deadline = _t.time() + 45
+            while True:
+                try:
+                    for oid, payload in expected.items():
+                        assert await c.read(oid) == payload
+                    break
+                except (IOError, AssertionError):
+                    if _t.time() > deadline:
+                        raise
+                    await asyncio.sleep(2.0)
+            assert len(expected) > 0
+        finally:
+            await c.close()
+
+    try:
+        asyncio.run(run())
+    finally:
+        vstart.stop_cluster(run_dir)
