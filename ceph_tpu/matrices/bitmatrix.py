@@ -75,3 +75,28 @@ def invert_bitmatrix(B: np.ndarray) -> np.ndarray:
                 B[r, :] ^= B[col, :]
                 inv[r, :] ^= inv[col, :]
     return inv
+
+
+def survivor_decode_bitmatrix(bitmatrix: np.ndarray, k: int, w: int,
+                              sel, erased_data) -> np.ndarray:
+    """Decode rows for erased DATA chunks: assemble the survivor
+    equation system (identity rows for surviving data chunks, coding
+    bitmatrix rows for surviving parities), invert it, and return the
+    rows that reconstruct each erased chunk -- the one GF(2) recipe the
+    CPU oracle, the XLA engine and the benchmark all share.
+
+    ``sel``: k surviving chunk ids (data ids < k, parity ids >= k);
+    ``erased_data``: erased data-chunk ids; returns a
+    [len(erased_data)*w, k*w] bitmatrix applied to the survivors in
+    ``sel`` order."""
+    A = np.zeros((k * w, k * w), dtype=np.uint8)
+    for r, cid in enumerate(sel):
+        if cid < k:
+            A[r * w:(r + 1) * w, cid * w:(cid + 1) * w] = np.eye(
+                w, dtype=np.uint8)
+        else:
+            A[r * w:(r + 1) * w, :] = bitmatrix[
+                (cid - k) * w:(cid - k + 1) * w, :]
+    inv = invert_bitmatrix(A)
+    return np.concatenate(
+        [inv[e * w:(e + 1) * w, :] for e in erased_data])

@@ -25,7 +25,7 @@ from __future__ import annotations
 import numpy as np
 
 from ceph_tpu.ops.gf import gf
-from ceph_tpu.matrices.bitmatrix import invert_bitmatrix
+from ceph_tpu.matrices.bitmatrix import survivor_decode_bitmatrix
 
 
 def _as_words(chunk: np.ndarray, w: int) -> np.ndarray:
@@ -160,24 +160,14 @@ def bitmatrix_decode(
     erased_data = [e for e in erased if e < k]
     if erased_data:
         sel = available[:k]
-        A = np.zeros((k * w, k * w), dtype=np.uint8)
-        for r, cid in enumerate(sel):
-            if cid < k:
-                A[r * w : (r + 1) * w, cid * w : (cid + 1) * w] = np.eye(
-                    w, dtype=np.uint8
-                )
-            else:
-                A[r * w : (r + 1) * w, :] = bitmatrix[
-                    (cid - k) * w : (cid - k + 1) * w, :
-                ]
-        inv = invert_bitmatrix(A)
+        D = survivor_decode_bitmatrix(bitmatrix, k, w, sel, erased_data)
         srows = np.concatenate(
             [_to_packet_rows(out[cid][None, :], w, packetsize) for cid in sel]
         )  # [k*w, S, P]
-        for e in erased_data:
+        for j, e in enumerate(erased_data):
             rec = np.zeros((w,) + srows.shape[1:], dtype=np.uint8)
             for l in range(w):
-                idx = np.nonzero(inv[e * w + l])[0]
+                idx = np.nonzero(D[j * w + l])[0]
                 if len(idx):
                     rec[l] = np.bitwise_xor.reduce(srows[idx], axis=0)
             out[e] = _from_packet_rows(rec, w, packetsize)[0]

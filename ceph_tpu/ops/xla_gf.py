@@ -234,7 +234,7 @@ def bitmatrix_decode(
     size: int,
     packetsize: int,
 ) -> dict:
-    from ceph_tpu.matrices.bitmatrix import invert_bitmatrix
+    from ceph_tpu.matrices.bitmatrix import survivor_decode_bitmatrix
 
     available = sorted(chunks.keys())
     erased = [i for i in range(k + m) if i not in chunks]
@@ -247,20 +247,8 @@ def bitmatrix_decode(
     erased_data = [e for e in erased if e < k]
     if erased_data:
         sel = available[:k]
-        A = np.zeros((k * w, k * w), dtype=np.uint8)
-        for r, cid in enumerate(sel):
-            if cid < k:
-                A[r * w : (r + 1) * w, cid * w : (cid + 1) * w] = np.eye(
-                    w, dtype=np.uint8
-                )
-            else:
-                A[r * w : (r + 1) * w, :] = bitmatrix[
-                    (cid - k) * w : (cid - k + 1) * w, :
-                ]
-        inv = invert_bitmatrix(A)
-        rec_rows = np.concatenate(
-            [inv[e * w : (e + 1) * w, :] for e in erased_data]
-        )
+        rec_rows = survivor_decode_bitmatrix(bitmatrix, k, w, sel,
+                                             erased_data)
         survivors = np.stack([out[cid] for cid in sel])
         srows = _to_packet_rows(survivors, w, packetsize)
         rec = _from_packet_rows(

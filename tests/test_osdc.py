@@ -185,3 +185,109 @@ def test_cacher_clean_extents_never_flush_as_dirty():
         await c.shutdown()
 
     run(main())
+
+
+# -- key_value_store (KvFlatBtreeAsync role) --------------------------------
+
+
+def test_kv_store_sorted_ops_and_split():
+    from ceph_tpu.osdc.kv_store import KvStore
+
+    async def main():
+        c = _mk()
+        kv = KvStore(c.backend, "t", max_per_bucket=8)
+        import random
+
+        rng = random.Random(3)
+        keys = [f"k{rng.randrange(10_000):05d}" for _ in range(60)]
+        for k in keys:
+            await kv.set(k, k.encode())
+        st = await kv.stats()
+        assert st["buckets"] > 1, "never split"
+        assert all(n <= 8 for n in st["per_bucket"].values())
+        # sorted enumeration across buckets
+        want = sorted(set(keys))
+        assert await kv.keys() == want
+        for k in want:
+            assert await kv.get(k) == k.encode()
+        # prefix scan
+        pre = [k for k in want if k.startswith("k1")]
+        assert await kv.keys("k1") == pre
+        # removal + missing-key errors
+        await kv.remove(want[0])
+        try:
+            await kv.get(want[0])
+            raise AssertionError("removed key still present")
+        except KeyError:
+            pass
+        try:
+            await kv.remove("nope")
+            raise AssertionError("removing missing key succeeded")
+        except KeyError:
+            pass
+        await c.shutdown()
+
+    run(main())
+
+
+def test_kv_store_empty_bucket_merges_away():
+    from ceph_tpu.osdc.kv_store import KvStore
+
+    async def main():
+        c = _mk()
+        kv = KvStore(c.backend, "m", max_per_bucket=4)
+        for i in range(12):
+            await kv.set(f"a{i:03d}", b"x")
+        before = (await kv.stats())["buckets"]
+        assert before > 1
+        # empty out the lowest bucket entirely
+        for k in list(await kv.keys())[:6]:
+            await kv.remove(k)
+        after = (await kv.stats())["buckets"]
+        assert after < before
+        assert await kv.keys() == [f"a{i:03d}" for i in range(6, 12)]
+        await c.shutdown()
+
+    run(main())
+
+
+def test_kv_store_concurrent_writers_lose_nothing():
+    """Rebalances racing writers/removers must never destroy a landed
+    write (split carry-over, drop-bucket restore, validation retry)."""
+    from ceph_tpu.osdc.kv_store import KvStore
+
+    async def main():
+        c = _mk()
+        kv = KvStore(c.backend, "race", max_per_bucket=6)
+
+        async def writer(base):
+            for i in range(25):
+                await kv.set(f"w{base:02d}-{i:03d}", b"v")
+
+        await asyncio.gather(*(writer(b) for b in range(6)))
+        keys = await kv.keys()
+        assert len(keys) == 6 * 25, f"lost {6*25 - len(keys)} writes"
+        for k in keys:
+            assert await kv.get(k) == b"v"
+        st = await kv.stats()
+        assert st["entries"] == 150
+
+        # removers racing writers: removals must stick (no split-copy
+        # resurrection) and every surviving key must remain readable
+        async def remover(base):
+            for i in range(25):
+                await kv.remove(f"w{base:02d}-{i:03d}")
+
+        async def writer2(base):
+            for i in range(25):
+                await kv.set(f"x{base:02d}-{i:03d}", b"y")
+
+        await asyncio.gather(remover(0), remover(1),
+                             writer2(0), writer2(1))
+        keys = await kv.keys()
+        assert not any(k.startswith(("w00", "w01")) for k in keys), \
+            "removed keys resurrected by a racing split"
+        assert sum(k.startswith("x") for k in keys) == 50
+        await c.shutdown()
+
+    run(main())
