@@ -204,8 +204,6 @@ def _probe_device_alive(timeout_s: float = None) -> bool:
     if timeout_s is None:
         timeout_s = float(os.environ.get(
             "CEPH_TPU_BENCH_PROBE_TIMEOUT", "180"))
-    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
-        return False
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
@@ -220,17 +218,27 @@ def main() -> int:
     import os
 
     forced_cpu = os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    plugin_on_path = any(
+        part in ("axon", ".axon_site")
+        for p in os.environ.get("PYTHONPATH", "").split(":")
+        for part in p.split("/"))
     if not os.environ.get("CEPH_TPU_BENCH_FALLBACK") and \
-            not forced_cpu and not _probe_device_alive():
-        # re-exec WITHOUT the axon sitecustomize on PYTHONPATH: a hung
-        # relay wedges backend init in-process even when the platform
-        # is forced to cpu, so the only safe fallback is a fresh
-        # interpreter that never registers the plugin
+            plugin_on_path and not _probe_device_alive():
+        # re-exec WITHOUT the plugin sitecustomize on PYTHONPATH: a
+        # hung relay wedges backend init in-process EVEN when the
+        # platform is forced to cpu (the registered plugin still
+        # initializes), so the only safe fallback is a fresh
+        # interpreter that never registers it.  The probe subprocess
+        # inherits this env and hangs the same way the main process
+        # would -- its timeout IS the detection.
         print("bench: device backend unreachable; re-exec on cpu",
               file=sys.stderr)
         env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
-        env["CEPH_TPU_BENCH_FALLBACK"] = "device-unreachable"
+        # a user-forced cpu run is not a device failure: keep the JSON
+        # platform honest in that case
+        env["CEPH_TPU_BENCH_FALLBACK"] = (
+            "forced-cpu-clean" if forced_cpu else "device-unreachable")
         env["PYTHONPATH"] = ":".join(
             p for p in env.get("PYTHONPATH", "").split(":")
             # drop only the plugin's own site dir (component match: a
@@ -258,8 +266,6 @@ def main() -> int:
     const_payload = np.full(SIZE, ord("X"), dtype=np.uint8)  # reference fill
 
     # -- TPU plugin at the tool surface (host-to-host, honest) -------------
-    import os
-
     tpu_ec = registry.factory("tpu", dict(profile), "")
     prior_cache_env = os.environ.get("CEPH_TPU_NO_H2D_CACHE")
     os.environ["CEPH_TPU_NO_H2D_CACHE"] = "1"
@@ -311,8 +317,9 @@ def main() -> int:
         "device_resident_GiBs": round(dev, 3),
         "device_resident_decode_GiBs": round(dev_dec, 3),
         "platform": jax.devices()[0].platform + (
-            "-fallback" if os.environ.get("CEPH_TPU_BENCH_FALLBACK")
-            else ""),
+            "-fallback"
+            if os.environ.get("CEPH_TPU_BENCH_FALLBACK")
+            == "device-unreachable" else ""),
     }
     print(
         f"tool-path tpu encode {enc:.3f} / decode {dec:.3f} GiB/s vs cpu "

@@ -68,7 +68,7 @@ class KvStore:
         except (FileNotFoundError, IOError):
             omap = {}
         out = {k: _dec(v) for k, v in omap.items()
-       if k not in (self.SEQ_KEY, self.LOCK_KEY)}
+               if k not in (self.SEQ_KEY, self.LOCK_KEY)}
         if not out:
             b = await self._new_bucket()
             ok, _ = await self.backend.omap_cas(
@@ -185,6 +185,17 @@ class KvStore:
     # -- scans (sorted by construction) ------------------------------------
 
     async def items(self, prefix: str = "") -> List[Tuple[str, bytes]]:
+        for _ in range(4):
+            result = await self._items_once(prefix)
+            if result is not None:
+                return result
+        raise IOError("scan kept losing to rebalances")
+
+    async def _items_once(self, prefix: str):
+        """One scan pass; None when a bucket vanished mid-scan (a split
+        deleted it after our index read -- its keys live on in the new
+        buckets, so the whole enumeration must restart on the fresh
+        index rather than silently omit them)."""
         index = await self._index_map()
         out: List[Tuple[str, bytes]] = []
         prev_high = ""
@@ -199,6 +210,10 @@ class KvStore:
                     not prev_high.startswith(prefix):
                 break
             omap = await self.backend.omap_get(index[high])
+            if not omap:
+                fresh = await self._index_map()
+                if index[high] not in fresh.values():
+                    return None  # bucket rebalanced away mid-scan
             for k in sorted(omap):
                 if k.startswith(prefix):
                     out.append((k, omap[k]))
@@ -239,6 +254,22 @@ class KvStore:
         await self.backend.omap_cas(
             self._index, self.LOCK_KEY, token, None)
 
+    async def _rollback_new_bucket(self, new_bucket: str,
+                                   planned: Dict[str, bytes],
+                                   old_bucket: str) -> None:
+        """Undo an uncommitted split bucket.  A writer may have landed
+        in it during its brief index visibility (including a stolen-
+        lock race): anything beyond the planned copy is carried back to
+        the still-live old bucket before the object goes."""
+        try:
+            cur = await self.backend.omap_get(new_bucket)
+        except (FileNotFoundError, IOError):
+            cur = {}
+        for k, v in cur.items():
+            if planned.get(k) != v:
+                await self._bucket_put(old_bucket, k, v)
+        await self._delete_bucket_obj(new_bucket)
+
     async def _delete_bucket_obj(self, bucket: str) -> None:
         await self.backend.omap_clear(bucket)
         try:
@@ -275,8 +306,8 @@ class KvStore:
             self._index, low_keys[-1], None, _enc(lo_bucket))
         if not ok:
             # a concurrent rebalance created this boundary: yield
-            await self._delete_bucket_obj(lo_bucket)
-            await self._delete_bucket_obj(hi_bucket)
+            await self._rollback_new_bucket(lo_bucket, entries, bucket)
+            await self._rollback_new_bucket(hi_bucket, entries, bucket)
             return
         # 3. commit point: CAS the old high key to the new high bucket;
         #    a loser rolls everything back (the old state was correct,
@@ -286,8 +317,8 @@ class KvStore:
         if not ok:
             await self.backend.omap_cas(
                 self._index, low_keys[-1], _enc(lo_bucket), None)
-            await self._delete_bucket_obj(lo_bucket)
-            await self._delete_bucket_obj(hi_bucket)
+            await self._rollback_new_bucket(lo_bucket, entries, bucket)
+            await self._rollback_new_bucket(hi_bucket, entries, bucket)
             return
         # writes that slipped into the OLD bucket between our copy and
         # the commit (and passed their validation against the
