@@ -64,7 +64,8 @@ class TCPMessenger:
         #: this process's node name; must appear in addr_map for serving
         self.node = node
         self.addr_map = dict(addr_map)
-        self.fault = fault or FaultInjector()
+        self.fault = fault if fault is not None else \
+            FaultInjector.from_config()
         #: cephx-style auth: when a KeyRing is given, every connection
         #: must pass the mutual challenge-response handshake and every
         #: frame is signed with the derived session key (ms_sign_messages)

@@ -1505,7 +1505,14 @@ class ECBackend:
                 self.name, f"osd.{acting[s]}", sub
             )
         try:
-            await asyncio.wait_for(done, timeout=5)
+            # config-driven (osd_op_thread_timeout role): 5s starves
+            # freshly-revived peers on a contended host and a read that
+            # gathers < k shards fails outright -- give stragglers the
+            # headroom the client op budget already allows
+            from ceph_tpu.utils.config import get_config
+
+            await asyncio.wait_for(done, timeout=float(
+                get_config().get_val("osd_read_gather_timeout")))
         except asyncio.TimeoutError:
             pass  # missing shards handled by the caller
         state = self._pending.pop(tid)

@@ -30,6 +30,50 @@ class FaultInjector:
         self._rng = random.Random(seed)
         self.dropped = 0
 
+    @classmethod
+    def from_config(cls) -> "FaultInjector":
+        """Build from the ms_inject_* options AND track runtime changes
+        through a config observer (reference: the injection knobs in
+        src/common/options.cc drive the messenger directly and respond
+        to injectargs; qa suites just set the config, before OR after
+        the daemons boot)."""
+        import weakref
+
+        from ceph_tpu.utils.config import get_config
+
+        cfg = get_config()
+        inj = cls()
+
+        def _sync(target):
+            n = int(cfg.get_val("ms_inject_socket_failures") or 0)
+            delay_p = float(cfg.get_val("ms_inject_internal_delays")
+                            or 0.0)
+            target.drop_probability = (1.0 / n) if n > 0 else 0.0
+            target.delay_probability = delay_p
+            target.max_delay = 0.05 if delay_p else 0.0
+
+        _sync(inj)
+        # the observer must not keep the injector (and its messenger)
+        # alive forever: hold it weakly and self-remove once the owner
+        # is gone, or a harness that churns clusters would grow the
+        # global observer list without bound
+        ref = weakref.ref(inj)
+
+        def _obs(changed):
+            target = ref()
+            if target is None:
+                try:
+                    cfg._observers.remove(_obs)
+                except ValueError:
+                    pass
+                return
+            if changed & {"ms_inject_socket_failures",
+                          "ms_inject_internal_delays"}:
+                _sync(target)
+
+        cfg.add_observer(_obs)
+        return inj
+
     def maybe_drop(self) -> bool:
         if self.drop_probability and self._rng.random() < self.drop_probability:
             self.dropped += 1
@@ -49,7 +93,8 @@ class Messenger:
         self._dispatchers: Dict[str, Callable] = {}
         self._tasks: Dict[str, asyncio.Task] = {}
         self._down: set = set()
-        self.fault = fault or FaultInjector()
+        self.fault = fault if fault is not None else \
+            FaultInjector.from_config()
         self._seq = 0
 
     def register(self, name: str, dispatcher: Callable[[str, object], Awaitable[None]]):

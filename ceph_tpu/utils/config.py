@@ -68,6 +68,9 @@ OPTIONS: Dict[str, Option] = {
              "seconds a primary waits for sub-write commit acks before "
              "failing the op (fault-injection tests shrink this to "
              "manufacture torn writes)"),
+        _opt("osd_read_gather_timeout", float, 15.0, LEVEL_ADVANCED,
+             "seconds a primary waits for sub-read replies before "
+             "serving with whatever arrived (degraded decode or EIO)"),
         _opt("osd_scrub_objects_per_tick", int, 4, LEVEL_ADVANCED,
              "deep-scrub at most this many objects per background tick "
              "(rate limit; 0 disables background scrub)"),
@@ -130,8 +133,9 @@ class Config:
         for key, val in changes.items():
             self.set_val(key, val)
             changed.add(key)
-        for fn in self._observers:
-            fn(changed)
+        for fn in list(self._observers):  # snapshot: observers may
+            fn(changed)                   # self-remove when their owner
+                                          # was garbage-collected
 
     def show_config(self) -> Dict[str, Any]:
         return {name: self.get_val(name) for name in sorted(OPTIONS)}
