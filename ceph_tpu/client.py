@@ -28,9 +28,20 @@ class Rados:
 
     # -- pool ops (mon-role: profile validation at create time) ------------
 
-    def pool_create(self, name: str, profile: Optional[Dict[str, str]] = None):
+    def pool_create(self, name: str, profile: Optional[Dict[str, str]] = None,
+                    pool_type: str = "erasure", size: int = 3):
+        """Create a pool.  ``pool_type`` mirrors `ceph osd pool create
+        <name> replicated|erasure` (reference src/mon/OSDMonitor.cc:5529):
+        replicated pools take ``size`` full copies and no EC profile."""
         if name in self._pools:
             raise ValueError(f"pool {name} exists")
+        if pool_type == "replicated":
+            if size < 1 or size > self.n_osds:
+                raise ValueError(f"bad replicated size {size}")
+            self._pools[name] = self._run(
+                self._make_pool({"size": str(size)}, pool_type)
+            )
+            return self.open_ioctx(name)
         if profile is None:
             text = get_config().get_val("osd_pool_default_erasure_code_profile")
             profile = dict(kv.split("=", 1) for kv in text.split())
@@ -38,11 +49,11 @@ class Rados:
         check = dict(profile)
         plugin = check.pop("plugin", "jerasure")
         registry_mod.instance().factory(plugin, check)
-        self._pools[name] = self._run(self._make_pool(profile))
+        self._pools[name] = self._run(self._make_pool(profile, pool_type))
         return self.open_ioctx(name)
 
-    async def _make_pool(self, profile):
-        return ECCluster(self.n_osds, dict(profile))
+    async def _make_pool(self, profile, pool_type="erasure"):
+        return ECCluster(self.n_osds, dict(profile), pool_type=pool_type)
 
     def pool_delete(self, name: str) -> None:
         pool = self._pools.pop(name, None)
@@ -145,31 +156,58 @@ class IoCtx:
         )
 
     def stat(self, oid: str) -> int:
-        """Logical object size (from the first reachable shard's xattr)."""
+        """Logical object size (from the first reachable shard's xattr).
+        A replicated-pool removal tombstone (whiteout "removed",
+        ceph_tpu/osd/replicated.py) stats as absent, matching the EC
+        pool's physical delete."""
+        from ceph_tpu.osd.pg import WHITEOUT_KEY
+
         backend = self._cluster.backend
         acting = backend.acting_set(oid)
         for s in range(backend.km):
             if acting[s] is None:
                 continue
+            store = self._cluster.osds[acting[s]].store
             try:
-                size = self._cluster.osds[acting[s]].store.getattr(
-                    shard_oid(oid, s), SIZE_KEY
-                )
+                size = store.getattr(shard_oid(oid, s), SIZE_KEY)
             except FileNotFoundError:
                 continue
+            if store.getattr(shard_oid(oid, s), WHITEOUT_KEY) == "removed":
+                raise FileNotFoundError(oid)
             if size is not None:
                 return size
         raise FileNotFoundError(oid)
 
     def list_objects(self) -> List[str]:
-        names = set()
+        from ceph_tpu.osd.pg import POOL_KEY, VERSION_KEY, WHITEOUT_KEY, vt
+
+        live: Dict[str, tuple] = {}     # base -> newest live version
+        removed: Dict[str, tuple] = {}  # base -> newest tombstone version
         for osd in self._cluster.osds:
             for soid in osd.store.list_objects():
                 if soid.endswith("@meta") and \
                         osd.store.getattr(soid, "_meta_removed"):
                     continue  # removal tombstone, not a live object
-                names.add(soid.rsplit("@", 1)[0])
-        return sorted(names)
+                ptag = osd.store.getattr(soid, POOL_KEY)
+                if ptag is not None and ptag != self._cluster.pool:
+                    continue  # a co-hosted pool's object
+                base = soid.rsplit("@", 1)[0]
+                ver = vt(osd.store.getattr(soid, VERSION_KEY))
+                # replicated plain-removal tombstone (whiteout "removed",
+                # ceph_tpu/osd/replicated.py): a dead name unless a NEWER
+                # live copy exists (the object was re-created after)
+                bucket = removed if osd.store.getattr(
+                    soid, WHITEOUT_KEY) == "removed" else live
+                prev = bucket.get(base)
+                # None sentinel: version-less objects (omap-only meta
+                # twins, pre-versioning writes) decode as (0, "") and
+                # must still register as live
+                if prev is None or ver > prev:
+                    bucket[base] = ver
+        return sorted(
+            b for b, v in live.items()
+            if b not in removed or v > removed[b]
+        )
 
     def scrub(self, oid: str) -> dict:
         return self._rados._run(self._cluster.deep_scrub(oid))

@@ -50,12 +50,15 @@ class RemoteClient:
         messenger = TCPMessenger(name, addr_map, keyring=keyring)
         await messenger.start()
 
-        # the client needs only the profile's k+m for placement; chunk
-        # math happens on the primary OSD
+        # the client needs only the pool width (k+m, or replica count)
+        # for placement; chunk math happens on the primary OSD
         profile = dict(profile)
-        plugin = profile.pop("plugin", "jerasure")
-        ec = registry_mod.instance().factory(plugin, profile)
-        km = ec.get_chunk_count()
+        if profile.pop("pool_type", "erasure") == "replicated":
+            km = int(profile.get("size", 3))
+        else:
+            plugin = profile.pop("plugin", "jerasure")
+            ec = registry_mod.instance().factory(plugin, profile)
+            km = ec.get_chunk_count()
         placement = CrushPlacement(n_osds, km, hosts=hosts)
         backend = Objecter(
             messenger, km, n_osds, placement=placement, name=name,

@@ -46,16 +46,24 @@ async def serve(args) -> None:
         with open(args.cluster_conf) as f:
             conf = json.load(f)
         profile = dict(conf["profile"])
-        plugin = profile.pop("plugin", "jerasure")
         from ceph_tpu.osd.placement import CrushPlacement
-        from ceph_tpu.plugins import registry as registry_mod
 
-        ec = registry_mod.instance().factory(plugin, profile)
         n_osds = sum(1 for k in addr_map if k.startswith("osd."))
-        placement = CrushPlacement(
-            n_osds, ec.get_chunk_count(), hosts=conf.get("hosts")
-        )
-        shard.host_pool(conf.get("pool", "ecpool"), ec, n_osds, placement)
+        pool_type = profile.pop("pool_type", conf.get("pool_type", "erasure"))
+        if pool_type == "replicated":
+            # TYPE_REPLICATED pool (reference build_pg_backend,
+            # src/osd/PGBackend.cc:533-570): size full copies, no codec
+            ec = None
+            km = int(profile.get("size", 3))
+        else:
+            plugin = profile.pop("plugin", "jerasure")
+            from ceph_tpu.plugins import registry as registry_mod
+
+            ec = registry_mod.instance().factory(plugin, profile)
+            km = ec.get_chunk_count()
+        placement = CrushPlacement(n_osds, km, hosts=conf.get("hosts"))
+        shard.host_pool(conf.get("pool", "ecpool"), ec, n_osds, placement,
+                        pool_type=pool_type, size=km)
         # daemons run peering-driven auto recovery by default (OSD::tick)
         shard.start_tick()
     # admin socket (src/common/admin_socket.cc): perf dump / ops /
@@ -88,10 +96,21 @@ async def serve(args) -> None:
         )
         def _live_objects():
             # removal tombstones are durable state but not live objects:
-            # ls and df must agree the deleted name is gone
-            return [o for o in shard.store.list_objects()
-                    if not (o.endswith("@meta")
-                            and shard.store.getattr(o, "_meta_removed"))]
+            # ls and df must agree the deleted name is gone.  Two kinds:
+            # meta-plane tombstones (_meta_removed) and replicated-pool
+            # data tombstones (whiteout "removed",
+            # ceph_tpu/osd/replicated.py).
+            from ceph_tpu.osd.pg import WHITEOUT_KEY
+
+            out = []
+            for o in shard.store.list_objects():
+                if o.endswith("@meta") and \
+                        shard.store.getattr(o, "_meta_removed"):
+                    continue
+                if shard.store.getattr(o, WHITEOUT_KEY) == "removed":
+                    continue
+                out.append(o)
+            return out
 
         asok.register("status", lambda cmd: {
             "name": name,

@@ -363,6 +363,30 @@ class Monitor:
             name = cmd["name"]
             if name in self.osdmap.pools:
                 return -17, "pool exists"  # EEXIST
+            if cmd.get("pool_type") == "replicated":
+                # TYPE_REPLICATED arm (reference OSDMonitor::prepare_new_pool,
+                # src/mon/OSDMonitor.cc:5529; pg_pool_t size/min_size)
+                size = int(cmd.get("size", 3))
+                if size < 1 or (
+                    self.osdmap.max_osd and size > self.osdmap.max_osd
+                ):
+                    return -22, f"bad replicated size {size}"
+                min_size = int(
+                    cmd.get("min_size", max(1, size - size // 2)))
+                if not 1 <= min_size <= size:
+                    # reference OSDMonitor rejects min_size outside
+                    # [1, size] (a pool that could never accept a write)
+                    return -22, f"bad min_size {min_size} (size {size})"
+                pool = {
+                    "name": name,
+                    "pool_type": "replicated",
+                    "size": size,
+                    "min_size": min_size,
+                    "pg_num": cmd.get("pg_num", 128),
+                    "hosts": cmd.get("hosts"),
+                }
+                ok = await self._propose({"op": "pool_create", "pool": pool})
+                return (0, pool) if ok else (-11, "no quorum")
             pname = cmd["profile"]
             profile = self.osdmap.ec_profiles.get(pname)
             if profile is None:
@@ -375,6 +399,7 @@ class Monitor:
             )
             pool = {
                 "name": name,
+                "pool_type": "erasure",
                 "profile_name": pname,
                 "k": ec.get_data_chunk_count(),
                 "m": ec.get_chunk_count() - ec.get_data_chunk_count(),

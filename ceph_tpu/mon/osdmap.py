@@ -17,9 +17,15 @@ from typing import Dict, List, Optional
 @dataclass
 class PoolInfo:
     name: str
-    profile_name: str
-    k: int
-    m: int
+    # pg_pool_t type (reference src/osd/osd_types.h TYPE_REPLICATED /
+    # TYPE_ERASURE): erasure pools carry a profile + k/m, replicated
+    # pools carry size/min_size
+    profile_name: str = ""
+    k: int = 0
+    m: int = 0
+    pool_type: str = "erasure"
+    size: int = 0
+    min_size: int = 0
     pg_num: int = 128
     # crush failure-domain spec: None -> flat over osds
     hosts: Optional[List[List[int]]] = None
@@ -49,6 +55,9 @@ class OSDMap:
                     "profile_name": p.profile_name,
                     "k": p.k,
                     "m": p.m,
+                    "pool_type": p.pool_type,
+                    "size": p.size,
+                    "min_size": p.min_size,
                     "pg_num": p.pg_num,
                     "hosts": p.hosts,
                 }

@@ -38,6 +38,7 @@ class ECCluster:
         objectstore: str = "memstore",
         data_path: str = "",
         pool: str = "ecpool",
+        pool_type: str = "erasure",
     ):
         self.messenger = Messenger(fault)
         self.osds: List[OSDShard] = [
@@ -45,25 +46,31 @@ class ECCluster:
                      objectstore=objectstore, data_path=data_path)
             for i in range(n_osds)
         ]
-        plugin = plugin or profile.pop("plugin", "jerasure")
-        registry = registry_mod.instance()
-        self.ec = registry.factory(plugin, profile)
+        self.pool_type = pool_type
+        if pool_type == "replicated":
+            # TYPE_REPLICATED pool: profile carries {"size": N}
+            # (reference build_pg_backend, src/osd/PGBackend.cc:533-570)
+            self.ec = None
+            km = int(profile.get("size", 3))
+        else:
+            plugin = plugin or profile.pop("plugin", "jerasure")
+            registry = registry_mod.instance()
+            self.ec = registry.factory(plugin, profile)
+            km = self.ec.get_chunk_count()
         placement = None
         if use_crush:
             from ceph_tpu.osd.placement import CrushPlacement
 
-            placement = CrushPlacement(
-                n_osds, self.ec.get_chunk_count(), hosts=hosts
-            )
+            placement = CrushPlacement(n_osds, km, hosts=hosts)
         self.placement = placement
         self.pool = pool
         # one primary engine per OSD; in-process they share the codec and
         # the placement object (weight updates propagate to everyone)
         for osd in self.osds:
-            osd.host_pool(pool, self.ec, n_osds, placement)
+            osd.host_pool(pool, self.ec, n_osds, placement,
+                          pool_type=pool_type, size=km)
         self.backend = Objecter(
-            self.messenger, self.ec.get_chunk_count(), n_osds,
-            placement=placement, pool=pool,
+            self.messenger, km, n_osds, placement=placement, pool=pool,
         )
 
     def primary_backend(self, oid: str) -> ECBackend:
@@ -75,11 +82,47 @@ class ECCluster:
                 return self.osds[acting[s]].pools[self.pool]
         raise IOError(f"no up primary for {oid}")
 
+    def add_pool(self, name: str, profile: Optional[Dict[str, str]] = None,
+                 pool_type: str = "erasure", size: int = 3,
+                 hosts=None) -> Objecter:
+        """Host an ADDITIONAL pool on the same OSD daemons and return an
+        Objecter bound to it -- the reference's normal shape (every OSD
+        serves PGs of many pools; metadata pools replicated, data pools
+        EC).  Object pool-membership tags (ceph_tpu/osd/pg.py POOL_KEY)
+        keep the co-hosted pools' scrub/peering disjoint."""
+        if name in (self.pool,) or any(
+            name in osd.pools for osd in self.osds
+        ):
+            raise ValueError(f"pool {name} exists")
+        if pool_type == "replicated":
+            ec = None
+            km = int((profile or {}).get("size", size))
+        else:
+            prof = dict(profile or {})
+            plugin = prof.pop("plugin", "jerasure")
+            ec = registry_mod.instance().factory(plugin, prof)
+            km = ec.get_chunk_count()
+        placement = None
+        if self.placement is not None:
+            from ceph_tpu.osd.placement import CrushPlacement
+
+            placement = CrushPlacement(len(self.osds), km, hosts=hosts)
+        for osd in self.osds:
+            osd.host_pool(name, ec, len(self.osds), placement,
+                          pool_type=pool_type, size=km)
+        return Objecter(
+            self.messenger, km, len(self.osds), placement=placement,
+            pool=name, name=f"client.{name}",
+            # distinct stored-object namespace per additional pool: the
+            # flat per-OSD stores would otherwise collide on "oid@shard"
+            oid_prefix=f"{name}/",
+        )
+
     def new_client(self, name: str) -> Objecter:
         """A second client handle on the same cluster (librados: another
         Rados instance)."""
         return Objecter(
-            self.messenger, self.ec.get_chunk_count(), len(self.osds),
+            self.messenger, self.backend.km, len(self.osds),
             placement=self.placement, name=name, pool=self.pool,
         )
 
@@ -112,9 +155,10 @@ class ECCluster:
         the qa helpers' wait_for_clean polls.  Mirrors the peering
         authority rules so 'clean' here == 'no actions' there."""
         from ceph_tpu.osd.ecbackend import VERSION_KEY, shard_oid, vt
+        from ceph_tpu.osd.pg import POOL_KEY
 
         km = self.backend.km
-        k = self.ec.get_data_chunk_count()
+        k = 1 if self.ec is None else self.ec.get_data_chunk_count()
         degraded = []
         oids = set()
         metas = set()
@@ -124,6 +168,11 @@ class ECCluster:
             for stored in osd.store.list_objects():
                 base, _, tag = stored.rpartition("@")
                 if not base:
+                    continue
+                # report on THIS (default) pool only; co-hosted pools'
+                # objects (meta twins included) carry their POOL_KEY tag
+                ptag = osd.store.getattr(stored, POOL_KEY)
+                if ptag is not None and ptag != self.pool:
                     continue
                 (metas if tag == "meta" else oids).add(base)
         for oid in sorted(oids):

@@ -89,6 +89,7 @@ class Objecter:
         name: str = "client",
         pool: str = "",
         op_timeout: float = 30.0,
+        oid_prefix: str = "",
     ):
         self.messenger = messenger
         self.km = km
@@ -97,6 +98,13 @@ class Objecter:
         self.name = name
         self.pool = pool
         self.op_timeout = op_timeout
+        #: per-pool object namespace: co-hosted pools share each OSD's
+        #: flat store, so without a distinct prefix two pools' shard
+        #: objects for the same client oid would collide ("obj@1" from
+        #: both) -- the reference scopes names by PG collection (spg_t
+        #: embeds the pool id, src/osd/osd_types.h).  Empty for the
+        #: first/only pool (legacy names).
+        self.oid_prefix = oid_prefix
         self.perf = PerfCounters(name)
         self._tid = 0
         self._pending: Dict[int, asyncio.Future] = {}
@@ -109,28 +117,35 @@ class Objecter:
 
     # -- placement (the _calc_target role) ---------------------------------
 
-    def acting_set(self, oid: str) -> List[Optional[int]]:
-        oid = oid.split("~", 1)[0]  # clones place with their head
+    def _acting_abs(self, oid_abs: str) -> List[Optional[int]]:
+        """Placement of an already-namespaced oid."""
+        oid_abs = oid_abs.split("~", 1)[0]  # clones place with their head
         if self.placement is not None:
-            return self.placement.acting(oid)
+            return self.placement.acting(oid_abs)
         from ceph_tpu.osd.placement import fallback_acting
 
-        return fallback_acting(oid, self.n_osds, self.km)
+        return fallback_acting(oid_abs, self.n_osds, self.km)
+
+    def acting_set(self, oid: str) -> List[Optional[int]]:
+        return self._acting_abs(self.oid_prefix + oid)
 
     def _shard_up(self, acting, s: int) -> bool:
         return acting[s] is not None and not self.messenger.is_down(
             f"osd.{acting[s]}"
         )
 
+    def _primary_abs(self, oid_abs: str) -> str:
+        acting = self._acting_abs(oid_abs)
+        for s in range(self.km):
+            if self._shard_up(acting, s):
+                return f"osd.{acting[s]}"
+        raise IOError(f"no up OSD to serve {oid_abs}")
+
     def primary_of(self, oid: str) -> str:
         """The object's current primary: the first up shard of the acting
         set (the reference's primary is acting[0]; on its death a map
         change promotes the next shard)."""
-        acting = self.acting_set(oid)
-        for s in range(self.km):
-            if self._shard_up(acting, s):
-                return f"osd.{acting[s]}"
-        raise IOError(f"no up OSD to serve {oid}")
+        return self._primary_abs(self.oid_prefix + oid)
 
     # -- dispatch ----------------------------------------------------------
 
@@ -166,6 +181,7 @@ class Objecter:
                       **fields):
         """Send one op to the primary; fail over to the next up shard if
         the primary becomes unreachable before replying."""
+        oid = self.oid_prefix + oid  # enter the pool's namespace
         deadline = asyncio.get_event_loop().time() + (
             timeout if timeout is not None else self.op_timeout
         )
@@ -178,7 +194,7 @@ class Objecter:
             msg = dict(fields, op="client_op", tid=tid, kind=kind, oid=oid,
                        pool=self.pool)
             try:
-                primary = self.primary_of(oid)
+                primary = self._primary_abs(oid)
                 await self.messenger.send_message(self.name, primary, msg)
                 reply = await self._await_reply(fut, primary, deadline)
             finally:
@@ -306,15 +322,24 @@ class Objecter:
         return ret, out
 
     async def watch(self, oid: str, callback) -> None:
-        self._watch_callbacks[oid] = callback
+        # callbacks key on the namespaced oid (notify events carry the
+        # engine's name) but are INVOKED with the oid the caller
+        # registered -- the namespace is this Objecter's private affair
+        if self.oid_prefix and callback is not None:
+            orig, prefix = callback, self.oid_prefix
+
+            def callback(o, payload, _cb=orig, _p=prefix):
+                return _cb(o[len(_p):] if o.startswith(_p) else o, payload)
+
+        self._watch_callbacks[self.oid_prefix + oid] = callback
         try:
             await self._submit("watch", oid, watcher=self.name)
         except Exception:
-            self._watch_callbacks.pop(oid, None)
+            self._watch_callbacks.pop(self.oid_prefix + oid, None)
             raise
 
     async def unwatch(self, oid: str) -> None:
-        self._watch_callbacks.pop(oid, None)
+        self._watch_callbacks.pop(self.oid_prefix + oid, None)
         await self._submit("unwatch", oid, watcher=self.name)
 
     async def notify(self, oid: str, payload=None, timeout: float = 5.0):

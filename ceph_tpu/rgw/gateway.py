@@ -105,8 +105,14 @@ class S3Error(Exception):
 
 
 class RGWGateway:
-    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0):
-        self.backend = backend  # an Objecter (data + metadata pool)
+    def __init__(self, backend, host: str = "127.0.0.1", port: int = 0,
+                 index_backend=None):
+        self.backend = backend  # an Objecter (object-data pool, often EC)
+        #: metadata plane (users / bucket list / bucket indexes / upload
+        #: state): a SEPARATE pool handle when provided -- the reference
+        #: keeps rgw metadata on replicated pools while data rides EC
+        #: (rgw_rados.cc pool layout: .rgw.buckets.index et al.)
+        self.index = index_backend if index_backend is not None else backend
         self.host = host
         self.port = port
         self._server: Optional[asyncio.AbstractServer] = None
@@ -121,12 +127,12 @@ class RGWGateway:
 
     async def create_user(self, access: str, secret: str,
                           display: str = "") -> None:
-        await self.backend.omap_set(USERS_OID, {
+        await self.index.omap_set(USERS_OID, {
             access: f"{secret}\x00{display}".encode(),
         })
 
     async def _secret_for(self, access: str) -> Optional[str]:
-        got = await self.backend.omap_get(USERS_OID, [access])
+        got = await self.index.omap_get(USERS_OID, [access])
         if access not in got:
             return None
         return got[access].decode().split("\x00", 1)[0]
@@ -266,7 +272,7 @@ class RGWGateway:
     async def _check_owner(self, bucket: str, owner: str) -> None:
         """Bucket-owner authorization (the rgw ACL subset: private
         buckets, owner-full-control)."""
-        got = await self.backend.omap_get(BUCKETS_OID, [bucket])
+        got = await self.index.omap_get(BUCKETS_OID, [bucket])
         if bucket not in got:
             raise S3Error("NoSuchBucket", bucket)
         bucket_owner = got[bucket].decode().split("\x00", 1)[0]
@@ -380,7 +386,7 @@ class RGWGateway:
         obj = parts[4] if len(parts) > 4 else ""
         if not container:
             if method == "GET":  # account listing: containers, plain text
-                buckets = await self.backend.omap_get(BUCKETS_OID)
+                buckets = await self.index.omap_get(BUCKETS_OID)
                 mine = sorted(
                     n for n, raw in buckets.items()
                     if raw.decode().split("\x00", 1)[0] == owner)
@@ -403,7 +409,7 @@ class RGWGateway:
                 await self._delete_bucket(container)
                 return "204 No Content", "text/plain", b"", {}
             if method == "GET":  # object listing, plain text
-                index = await self.backend.omap_get(
+                index = await self.index.omap_get(
                     bucket_index_oid(container))
                 names = sorted(index)
                 return "200 OK", "text/plain", \
@@ -425,11 +431,11 @@ class RGWGateway:
     # -- bucket ops (rgw_bucket.cc) ----------------------------------------
 
     async def _bucket_exists(self, bucket: str) -> bool:
-        got = await self.backend.omap_get(BUCKETS_OID, [bucket])
+        got = await self.index.omap_get(BUCKETS_OID, [bucket])
         return bucket in got
 
     async def _list_buckets(self, owner: str):
-        buckets = await self.backend.omap_get(BUCKETS_OID)
+        buckets = await self.index.omap_get(BUCKETS_OID)
         mine = [
             n for n, raw in buckets.items()
             if raw.decode().split("\x00", 1)[0] == owner
@@ -449,7 +455,7 @@ class RGWGateway:
     async def _create_bucket(self, bucket: str, owner: str):
         if await self._bucket_exists(bucket):
             raise S3Error("BucketAlreadyExists", bucket)
-        await self.backend.omap_set(BUCKETS_OID, {
+        await self.index.omap_set(BUCKETS_OID, {
             bucket: f"{owner}\x00{int(time.time())}".encode(),
         })
         return "200 OK", "application/xml", b"", {}
@@ -457,31 +463,31 @@ class RGWGateway:
     async def _delete_bucket(self, bucket: str):
         if not await self._bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
-        index = await self.backend.omap_get(bucket_index_oid(bucket))
+        index = await self.index.omap_get(bucket_index_oid(bucket))
         if index:
             raise S3Error("BucketNotEmpty", bucket)
         # abort any in-progress multipart uploads: leaving their parts
         # behind would let a future same-name bucket's owner complete
         # the previous tenant's upload and read its data
         try:
-            ups = await self.backend.omap_get(uploads_oid(bucket))
+            ups = await self.index.omap_get(uploads_oid(bucket))
         except (FileNotFoundError, IOError):
             ups = {}
         for upload_id, raw_key in ups.items():
             key = raw_key.decode()
             try:
-                meta = await self.backend.omap_get(
+                meta = await self.index.omap_get(
                     self._mp_meta_oid(bucket, key, upload_id))
                 await self._drop_upload(bucket, key, upload_id, meta)
             except (FileNotFoundError, IOError):
                 pass
-        await self.backend.omap_rm(BUCKETS_OID, [bucket])
+        await self.index.omap_rm(BUCKETS_OID, [bucket])
         return "204 No Content", "application/xml", b"", {}
 
     async def _list_objects(self, bucket: str, prefix: str):
         if not await self._bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
-        index = await self.backend.omap_get(bucket_index_oid(bucket))
+        index = await self.index.omap_get(bucket_index_oid(bucket))
         items = []
         for k in sorted(index):
             if not k.startswith(prefix):
@@ -508,7 +514,7 @@ class RGWGateway:
         # data first, then the index entry (the reference's bucket-index
         # prepare/complete keeps the index authoritative)
         await self.backend.write(obj_oid(bucket, key), body)
-        await self.backend.omap_set(bucket_index_oid(bucket), {
+        await self.index.omap_set(bucket_index_oid(bucket), {
             key: f"{len(body)}\x00{etag}\x00{int(time.time())}".encode(),
         })
         return "200 OK", "application/xml", b"", {"ETag": f'"{etag}"'}
@@ -516,7 +522,7 @@ class RGWGateway:
     async def _index_entry(self, bucket: str, key: str):
         if not await self._bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
-        got = await self.backend.omap_get(bucket_index_oid(bucket), [key])
+        got = await self.index.omap_get(bucket_index_oid(bucket), [key])
         if key not in got:
             raise S3Error("NoSuchKey", key)
         size, etag, mtime = got[key].decode().split("\x00")
@@ -556,11 +562,11 @@ class RGWGateway:
         upload_id = hashlib.md5(
             f"{bucket}/{key}/{time.time()}/{self._upload_counter}".encode()
         ).hexdigest()
-        await self.backend.omap_set(
+        await self.index.omap_set(
             self._mp_meta_oid(bucket, key, upload_id),
             {"_meta": f"{int(time.time())}".encode()})
         # track in-progress uploads on the bucket (list-uploads surface)
-        await self.backend.omap_set(uploads_oid(bucket), {
+        await self.index.omap_set(uploads_oid(bucket), {
             upload_id: key.encode()})
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
@@ -572,7 +578,7 @@ class RGWGateway:
         return "200 OK", "application/xml", xml.encode(), {}
 
     async def _upload_meta(self, bucket: str, key: str, upload_id: str):
-        meta = await self.backend.omap_get(
+        meta = await self.index.omap_get(
             self._mp_meta_oid(bucket, key, upload_id))
         if "_meta" not in meta:
             raise S3Error("NoSuchUpload", upload_id)
@@ -586,7 +592,7 @@ class RGWGateway:
         etag = hashlib.md5(body).hexdigest()
         await self.backend.write(
             self._mp_part_oid(bucket, key, upload_id, part), body)
-        await self.backend.omap_set(
+        await self.index.omap_set(
             self._mp_meta_oid(bucket, key, upload_id),
             {f"part.{part:05d}": f"{len(body)}\x00{etag}".encode()})
         return "200 OK", "application/xml", b"", {"ETag": f'"{etag}"'}
@@ -620,7 +626,7 @@ class RGWGateway:
             md5s += bytes.fromhex(etag)
         final_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
         await self.backend.write(obj_oid(bucket, key), bytes(blob))
-        await self.backend.omap_set(bucket_index_oid(bucket), {
+        await self.index.omap_set(bucket_index_oid(bucket), {
             key: f"{len(blob)}\x00{final_etag}\x00"
                  f"{int(time.time())}".encode(),
         })
@@ -649,14 +655,14 @@ class RGWGateway:
                         bucket, key, upload_id, int(k.split(".")[1])))
                 except IOError:
                     pass
-        await self.backend.omap_rm(
+        await self.index.omap_rm(
             self._mp_meta_oid(bucket, key, upload_id), list(meta))
-        await self.backend.omap_rm(
+        await self.index.omap_rm(
             uploads_oid(bucket), [upload_id])
 
     async def _list_uploads(self, bucket: str):
         try:
-            ups = await self.backend.omap_get(
+            ups = await self.index.omap_get(
                 uploads_oid(bucket))
         except (FileNotFoundError, IOError):
             ups = {}
@@ -674,7 +680,7 @@ class RGWGateway:
 
     async def _delete_object(self, bucket: str, key: str):
         await self._index_entry(bucket, key)  # NoSuchKey check
-        await self.backend.omap_rm(bucket_index_oid(bucket), [key])
+        await self.index.omap_rm(bucket_index_oid(bucket), [key])
         try:
             await self.backend.remove_object(obj_oid(bucket, key))
         except IOError:

@@ -153,3 +153,38 @@ def test_tombstone_outranks_higher_versioned_stale_replica():
         await c.shutdown()
 
     asyncio.run(main())
+
+
+def test_replicated_pool_lifecycle(rados):
+    """`pool_create(..., pool_type="replicated")` -- the TYPE_REPLICATED
+    arm of the librados pool surface (reference `ceph osd pool create
+    <name> replicated`, src/mon/OSDMonitor.cc:5529)."""
+    io = rados.pool_create("rpool", pool_type="replicated", size=3)
+    assert rados.list_pools() == ["rpool"]
+    data = os.urandom(54321)
+    io.write_full("obj", data)
+    assert io.read("obj") == data
+    assert io.stat("obj") == 54321
+    assert io.scrub("obj")["ok"]
+    io.omap_set("obj", {"key": b"val"})
+    assert io.omap_get("obj") == {"key": b"val"}
+    io.remove("obj")
+    assert io.list_objects() == []
+    with pytest.raises(ValueError):
+        rados.pool_create("toobig", pool_type="replicated", size=99)
+    rados.pool_delete("rpool")
+
+
+def test_mixed_pool_types_coexist(rados):
+    """An EC pool and a replicated pool side by side in one cluster
+    handle -- the reference's normal deployment shape (metadata pools
+    replicated, data pools EC)."""
+    ec_io = rados.pool_create(
+        "data", {"plugin": "jerasure", "k": "4", "m": "2",
+                 "technique": "reed_sol_van"}
+    )
+    r_io = rados.pool_create("meta", pool_type="replicated", size=3)
+    ec_io.write_full("obj", b"ec bytes")
+    r_io.write_full("obj", b"replicated bytes")
+    assert ec_io.read("obj") == b"ec bytes"
+    assert r_io.read("obj") == b"replicated bytes"

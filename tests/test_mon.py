@@ -241,3 +241,59 @@ def test_cluster_mons_leader_death_lease_failover():
         await c.shutdown()
 
     run(main())
+
+
+def test_replicated_pool_create_via_mon():
+    """The TYPE_REPLICATED arm of `osd pool create` (reference
+    OSDMonitor::prepare_new_pool, src/mon/OSDMonitor.cc:5529): size and
+    min_size land in the committed map; bad size is -EINVAL."""
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl = MonClient(ms, 3, "client0")
+
+        async def dispatch(src, msg):
+            if isinstance(msg, dict):
+                await cl.handle_reply(msg)
+
+        ms.register("client0", dispatch)
+        rc, _ = await cl.command({"prefix": "osd create", "n": 6})
+        assert rc == 0
+        rc, pool = await cl.command({
+            "prefix": "osd pool create", "name": "rpool",
+            "pool_type": "replicated", "size": 3,
+        })
+        assert rc == 0
+        assert pool["pool_type"] == "replicated"
+        assert pool["size"] == 3 and pool["min_size"] == 2
+        rc, _ = await cl.command({
+            "prefix": "osd pool create", "name": "bad",
+            "pool_type": "replicated", "size": 0,
+        })
+        assert rc == -22
+        # min_size outside [1, size] is -EINVAL (review r5 finding)
+        rc, _ = await cl.command({
+            "prefix": "osd pool create", "name": "bad2",
+            "pool_type": "replicated", "size": 3, "min_size": 99,
+        })
+        assert rc == -22
+        rc, _ = await cl.command({
+            "prefix": "osd pool create", "name": "bad3",
+            "pool_type": "replicated", "size": 3, "min_size": 0,
+        })
+        assert rc == -22
+        # the committed map carries the pool with its type
+        leader = next(m for m in mc.mons if m.is_leader())
+        info = leader.osdmap.pools["rpool"]
+        assert info.pool_type == "replicated" and info.size == 3
+        # round-trips through the wire form
+        from ceph_tpu.mon.osdmap import OSDMap
+
+        m2 = OSDMap.from_dict(leader.osdmap.to_dict())
+        assert m2.pools["rpool"].pool_type == "replicated"
+        assert m2.pools["rpool"].min_size == 2
+        await ms.shutdown()
+
+    run(main())

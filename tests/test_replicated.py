@@ -1,0 +1,339 @@
+"""Replicated-pool (TYPE_REPLICATED) tests: the same cluster scenarios the
+EC suite runs, through the ReplicatedBackend strategy (reference:
+src/osd/ReplicatedBackend.cc, build_pg_backend src/osd/PGBackend.cc:533-570;
+qa test shapes from qa/standalone/osd/).
+"""
+
+import asyncio
+
+import numpy as np
+import pytest
+
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osd.pg import VERSION_KEY, WHITEOUT_KEY, shard_oid, vt
+from ceph_tpu.osd.replicated import REMOVED, ReplicatedBackend
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def make_cluster(n_osds=5, size=3, **kw):
+    return ECCluster(n_osds, {"size": str(size)},
+                     pool_type="replicated", **kw)
+
+
+# -- basic I/O --------------------------------------------------------------
+
+
+def test_write_read_roundtrip():
+    async def main():
+        c = make_cluster()
+        payload = np.random.RandomState(0).randint(
+            0, 256, size=100_000, dtype=np.uint8).tobytes()
+        await c.write("obj", payload)
+        assert await c.read("obj") == payload
+        # overwrite shrinks
+        await c.write("obj", b"short")
+        assert await c.read("obj") == b"short"
+        await c.shutdown()
+
+    run(main())
+
+
+def test_every_replica_holds_full_copy():
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"replicant" * 100)
+        acting = c.backend.acting_set("obj")
+        copies = 0
+        for s in range(c.backend.km):
+            if acting[s] is None:
+                continue
+            data = c.osds[acting[s]].store.read(shard_oid("obj", s))
+            assert data == b"replicant" * 100
+            copies += 1
+        assert copies == 3
+        await c.shutdown()
+
+    run(main())
+
+
+def test_write_range_read_range():
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"A" * 10_000)
+        await c.write_range("obj", 5_000, b"B" * 2_000)
+        got = await c.read("obj")
+        assert got == b"A" * 5_000 + b"B" * 2_000 + b"A" * 3_000
+        assert await c.read_range("obj", 4_999, 3) == b"ABB"
+        # append via write_range extends
+        await c.write_range("obj", 10_000, b"C" * 100)
+        assert (await c.backend.stat("obj"))[0] == 10_100
+        assert await c.read_range("obj", 10_090, 20) == b"C" * 10
+        await c.shutdown()
+
+    run(main())
+
+
+def test_remove_then_read_raises():
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"doomed")
+        await c.backend.remove_object("obj")
+        with pytest.raises(IOError):
+            await c.read("obj")
+        size, hinfo = await c.backend.stat("obj")
+        assert size == 0 and hinfo is None
+        await c.shutdown()
+
+    run(main())
+
+
+# -- degraded operation + recovery ------------------------------------------
+
+
+def test_degraded_write_read_with_one_replica_down():
+    """size=3 min_size=2: one dead replica must not block I/O."""
+
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"x" * 50_000)
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[0])  # kill the primary holder
+        await c.write("obj", b"y" * 50_000)  # degraded write, new primary
+        assert await c.read("obj") == b"y" * 50_000
+        await c.shutdown()
+
+    run(main())
+
+
+def test_stale_replica_never_serves_old_bytes():
+    """A replica that missed a write while down must lose the version
+    election on read (the pg-log consistency guarantee, read-time cut)."""
+
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"v1" * 1000)
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[0])
+        await c.write("obj", b"v2" * 1000)
+        c.revive_osd(acting[0])
+        # the revived replica holds v1; reads route to it as primary but
+        # the gather must fall forward to the v2 holders
+        assert await c.read("obj") == b"v2" * 1000
+        await c.shutdown()
+
+    run(main())
+
+
+def test_peering_recovers_stale_replica():
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"p1" * 4096)
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[1])
+        await c.write("obj", b"p2" * 4096)
+        c.revive_osd(acting[1])
+        # drive peering from the object's primary engine
+        await c.primary_backend("obj").peering_pass(backfill=True)
+        stale = c.osds[acting[1]].store.read(shard_oid("obj", 1))
+        assert stale == b"p2" * 4096
+        assert await c.degraded_report() == []
+        await c.shutdown()
+
+    run(main())
+
+
+def test_removal_tombstone_beats_revived_copy():
+    """Resurrection guard: a replica down through the removal must not
+    bring the object back when it revives (the tombstone wins the
+    newest-version election and recovery propagates it)."""
+
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"ghost" * 1000)
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[2])
+        await c.backend.remove_object("obj")
+        c.revive_osd(acting[2])
+        with pytest.raises(IOError):
+            await c.read("obj")
+        await c.primary_backend("obj").peering_pass(backfill=True)
+        # the revived replica converged to the tombstone
+        soid = shard_oid("obj", 2)
+        store = c.osds[acting[2]].store
+        assert store.getattr(soid, WHITEOUT_KEY) == REMOVED
+        assert store.read(soid) == b""
+        with pytest.raises(IOError):
+            await c.read("obj")
+        await c.shutdown()
+
+    run(main())
+
+
+# -- scrub ------------------------------------------------------------------
+
+
+def test_scrub_detects_and_repairs_divergent_copy():
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"S" * 8192)
+        acting = c.backend.acting_set("obj")
+        # corrupt one replica's bytes directly (bit rot)
+        victim = acting[1]
+        soid = shard_oid("obj", 1)
+        store = c.osds[victim].store
+        from ceph_tpu.osd.types import Transaction
+
+        store.queue_transaction(Transaction().write(soid, 0, b"ROT!"))
+        report = await c.deep_scrub("obj")
+        assert not report["ok"]
+        # crc check flags it server-side (EIO) or the copy-compare does
+        assert 1 in (report["crc_errors"] + report["parity_mismatch"])
+        repaired = await c.primary_backend("obj").scrub_repair("obj", report)
+        assert repaired >= 1
+        assert (await c.deep_scrub("obj"))["ok"]
+        assert store.read(soid) == b"S" * 8192
+        await c.shutdown()
+
+    run(main())
+
+
+# -- snapshots --------------------------------------------------------------
+
+
+def test_snapshots_clone_and_read():
+    async def main():
+        c = make_cluster()
+        await c.write("obj", b"gen0")
+        snapc = {"seq": 1, "snaps": [1]}
+        # clones gen0 at snap 1 (librados SnapContext on the write)
+        await c.backend.write("obj", b"gen1", snapc=snapc)
+        assert await c.read("obj") == b"gen1"
+        assert await c.backend.read("obj", snap=1) == b"gen0"
+        ss = await c.backend.list_snaps("obj")
+        assert [cl["id"] for cl in ss["clones"]] == [1]
+        # rollback restores gen0 as the head
+        await c.backend.snap_rollback("obj", 1)
+        assert await c.read("obj") == b"gen0"
+        await c.shutdown()
+
+    run(main())
+
+
+def test_min_size_blocks_writes():
+    """size=3 on 3 OSDs: two dead replicas (< min_size up) must refuse
+    writes (pool min_size semantics, reference pg_pool_t)."""
+
+    async def main():
+        c = make_cluster(n_osds=3, size=3)
+        await c.write("obj", b"ok")
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[1])
+        c.kill_osd(acting[2])
+        with pytest.raises(IOError):
+            await c.write("obj", b"blocked")
+        await c.shutdown()
+
+    run(main())
+
+
+def test_cohosted_pools_stay_disjoint():
+    """An EC pool and a replicated pool on the SAME OSD daemons: same
+    object name in both pools, scrub + peering scoped by the POOL_KEY
+    membership tag (the reference scopes by PG collection / spg_t pool
+    id, src/osd/osd_types.h)."""
+
+    async def main():
+        ec_c = ECCluster(
+            6, {"k": "4", "m": "2", "technique": "reed_sol_van"}
+        )
+        rio = ec_c.add_pool("meta", pool_type="replicated", size=3)
+        await ec_c.write("obj", b"EC" * 5000)
+        await rio.write("obj", b"RP" * 700)
+        assert await ec_c.read("obj") == b"EC" * 5000
+        assert await rio.read("obj") == b"RP" * 700
+        # scrub through both primaries stays clean (no cross-pool claims)
+        assert (await ec_c.deep_scrub("obj"))["ok"]
+        # a full scrub pass over every OSD must not corrupt either pool
+        from ceph_tpu.utils.config import get_config
+
+        get_config().set_val("osd_scrub_objects_per_tick", "16")
+        try:
+            for osd in ec_c.osds:
+                await osd.scrub_tick()
+        finally:
+            get_config().set_val("osd_scrub_objects_per_tick", "2")
+        assert await ec_c.read("obj") == b"EC" * 5000
+        assert await rio.read("obj") == b"RP" * 700
+        # peering from every primary engine leaves both pools intact
+        for osd in ec_c.osds:
+            for backend in osd.pools.values():
+                await backend.peering_pass(backfill=True)
+        assert await ec_c.read("obj") == b"EC" * 5000
+        assert await rio.read("obj") == b"RP" * 700
+        await ec_c.shutdown()
+
+    run(main())
+
+
+def test_cohosted_meta_not_cross_claimed():
+    """Review r5 finding: meta twins must carry the pool tag, or the
+    co-hosted default pool's peering re-replicates another pool's
+    metadata onto its own (wider) acting set."""
+
+    async def main():
+        c = ECCluster(6, {"k": "4", "m": "2", "technique": "reed_sol_van"})
+        rio = c.add_pool("rgw.index", pool_type="replicated", size=3)
+        await rio.omap_set("users", {"alice": b"secret"})
+        index_meta = "rgw.index/users@meta"
+        holders_before = {
+            osd.osd_id for osd in c.osds
+            if osd.store.exists(index_meta)
+        }
+        assert len(holders_before) == 3
+        # peering from EVERY engine of EVERY pool must not spread it
+        for osd in c.osds:
+            for backend in osd.pools.values():
+                await backend.peering_pass(backfill=True)
+        holders_after = {
+            osd.osd_id for osd in c.osds
+            if osd.store.exists(index_meta)
+        }
+        assert holders_after == holders_before
+        assert await rio.omap_get("users") == {"alice": b"secret"}
+        await c.shutdown()
+
+    run(main())
+
+
+def test_stat_raises_after_replicated_remove():
+    """Review r5 finding: the removal tombstone must stat as absent
+    (FileNotFoundError), matching the EC pool's physical delete."""
+    from ceph_tpu.client import Rados
+
+    r = Rados(n_osds=5)
+    try:
+        io = r.pool_create("rp", pool_type="replicated", size=3)
+        io.write_full("obj", b"hello")
+        assert io.stat("obj") == 5
+        io.remove("obj")
+        with pytest.raises(FileNotFoundError):
+            io.stat("obj")
+    finally:
+        r.shutdown()
+
+
+def test_list_objects_includes_omap_only():
+    """Review r5 finding: an omap-only object (no data write) must still
+    appear in rados ls (rgw-style catalogs)."""
+    from ceph_tpu.client import Rados
+
+    r = Rados(n_osds=5)
+    try:
+        io = r.pool_create("rp", pool_type="replicated", size=3)
+        io.omap_set("cfg", {"a": b"1"})
+        assert io.list_objects() == ["cfg"]
+    finally:
+        r.shutdown()

@@ -52,7 +52,11 @@ async def _request(port, method, target, body=b"", secret=SECRET,
 async def _gateway():
     PerfCounters.reset_all()
     c = ECCluster(6, dict(PROFILE))
-    gw = RGWGateway(c.backend)
+    # metadata (users/bucket list/indexes/uploads) rides a REPLICATED
+    # pool co-hosted on the same OSDs; object data stays on the EC pool
+    # (the reference's rgw pool layout, src/rgw/rgw_rados.cc)
+    index = c.add_pool("rgw.index", pool_type="replicated", size=3)
+    gw = RGWGateway(c.backend, index_backend=index)
     await gw.create_user(ACCESS, SECRET, "Test User")
     port = await gw.start()
     return c, gw, port

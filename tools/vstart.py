@@ -207,13 +207,23 @@ def main(argv=None):
     ap.add_argument("--k", type=int, default=4)
     ap.add_argument("--m", type=int, default=2)
     ap.add_argument("--plugin", default="jerasure")
+    ap.add_argument("--pool-type", default="erasure",
+                    choices=["erasure", "replicated"],
+                    help="pool strategy (reference `ceph osd pool create "
+                         "... replicated|erasure`)")
+    ap.add_argument("--size", type=int, default=3,
+                    help="replica count for --pool-type replicated")
     ap.add_argument("--objectstore", default="memstore")
     ap.add_argument("--auth", action="store_true",
                     help="enable cephx-style auth (keyring + signing)")
     args = ap.parse_args(argv)
 
     if args.cmd == "start":
-        profile = {"plugin": args.plugin, "k": str(args.k), "m": str(args.m)}
+        if args.pool_type == "replicated":
+            profile = {"pool_type": "replicated", "size": str(args.size)}
+        else:
+            profile = {"plugin": args.plugin, "k": str(args.k),
+                       "m": str(args.m)}
         start_cluster(args.dir, args.osds, profile,
                       objectstore=args.objectstore, auth=args.auth)
         print(f"cluster up: {args.osds} osds, profile {profile}"
