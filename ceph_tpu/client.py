@@ -156,27 +156,34 @@ class IoCtx:
         )
 
     def stat(self, oid: str) -> int:
-        """Logical object size (from the first reachable shard's xattr).
-        A replicated-pool removal tombstone (whiteout "removed",
-        ceph_tpu/osd/replicated.py) stats as absent, matching the EC
-        pool's physical delete."""
-        from ceph_tpu.osd.pg import WHITEOUT_KEY
+        """Logical object size from the HIGHEST-VERSIONED reachable
+        shard's xattrs (a first-reachable answer could be a stale
+        removal tombstone, or a stale copy, on a replica that was down
+        through the newest writes).  A replicated-pool removal tombstone
+        (whiteout "removed", ceph_tpu/osd/replicated.py) stats as
+        absent, matching the EC pool's physical delete."""
+        from ceph_tpu.osd.pg import VERSION_KEY, WHITEOUT_KEY, vt
 
         backend = self._cluster.backend
         acting = backend.acting_set(oid)
+        best = None  # (version, size, whiteout)
         for s in range(backend.km):
             if acting[s] is None:
                 continue
             store = self._cluster.osds[acting[s]].store
+            soid = shard_oid(oid, s)
             try:
-                size = store.getattr(shard_oid(oid, s), SIZE_KEY)
+                size = store.getattr(soid, SIZE_KEY)
             except FileNotFoundError:
                 continue
-            if store.getattr(shard_oid(oid, s), WHITEOUT_KEY) == "removed":
-                raise FileNotFoundError(oid)
-            if size is not None:
-                return size
-        raise FileNotFoundError(oid)
+            if size is None:
+                continue
+            ver = vt(store.getattr(soid, VERSION_KEY))
+            if best is None or ver > best[0]:
+                best = (ver, size, store.getattr(soid, WHITEOUT_KEY))
+        if best is None or best[2] == "removed":
+            raise FileNotFoundError(oid)
+        return best[1]
 
     def list_objects(self) -> List[str]:
         from ceph_tpu.osd.pg import POOL_KEY, VERSION_KEY, WHITEOUT_KEY, vt

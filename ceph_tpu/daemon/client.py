@@ -64,7 +64,29 @@ class RemoteClient:
             messenger, km, n_osds, placement=placement, name=name,
             pool=pool, op_timeout=op_timeout,
         )
-        return cls(backend, messenger, n_osds)
+        client = cls(backend, messenger, n_osds)
+        n_mons = sum(1 for k in addr_map if k.startswith("mon."))
+        if n_mons:
+            # map-driven routing (reference Objecter::_maybe_request_map):
+            # subscribe to osdmap epochs; up/down marks and CRUSH weights
+            # come from the mon, not just from client-side probing
+            from ceph_tpu.mon.monitor import MonClient
+            from ceph_tpu.mon.osdmap import apply_map_view
+
+            monc = MonClient(messenger, n_mons, name)
+            state = {"epoch": 0}
+
+            async def mon_hook(msg):
+                if await monc.handle_reply(msg):
+                    return
+                if msg.get("type") == "osdmap":
+                    apply_map_view(msg["map"], state, messenger,
+                                   placements=[placement])
+
+            backend.mon_hook = mon_hook
+            client.monc = monc
+            await monc.subscribe()
+        return client
 
     async def probe_osds(self) -> Dict[str, bool]:
         """Heartbeat round: refresh the liveness view of every OSD."""

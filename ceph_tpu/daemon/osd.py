@@ -21,6 +21,144 @@ import signal
 import sys
 
 
+async def _mon_integrate(args, shard, messenger, addr_map,
+                         n_mons: int) -> None:
+    """Boot this OSD into the monitor cluster.
+
+    Reference flow (src/osd/OSD.cc:5386-5513 start_boot/_send_boot +
+    :4612 handle_osd_ping):
+
+    * ``osd boot`` registers the daemon; the mon marks it up and bumps
+      the osdmap epoch;
+    * a subscription streams every committed osdmap; the daemon applies
+      up/down marks to its messenger, pushes CRUSH weights into hosted
+      placements, and HOSTS POOLS it learns from the map (pool create
+      flows mon -> daemons, not from a static file);
+    * a heartbeat loop probes every peer OSD; a peer silent past
+      ``osd_heartbeat_grace`` is reported via ``osd failure``, and the
+      mon marks it down once ``mon_osd_min_down_reporters`` distinct
+      daemons agree.
+    """
+    import asyncio
+
+    from ceph_tpu.mon.monitor import MonClient
+    from ceph_tpu.utils.config import get_config
+
+    from ceph_tpu.mon.osdmap import apply_map_view
+
+    name = shard.name
+    monc = MonClient(messenger, n_mons, name)
+    n_osds = sum(1 for k in addr_map if k.startswith("osd."))
+    state = {"epoch": 0, "up": {}}
+    flags = {"booting": False}
+    loop = asyncio.get_event_loop()
+
+    def apply_osdmap(m: dict) -> None:
+        if not apply_map_view(
+            m, state, messenger,
+            placements=[b.placement for b in shard.pools.values()],
+            skip_entity=name,
+        ):
+            return
+        if not state["up"].get(shard.osd_id, True) and \
+                not flags["booting"]:
+            # the map says WE are down but this process is alive (a
+            # spurious mark-down): re-boot into the mon (reference
+            # OSD::_committed_osd_maps -> start_boot)
+            flags["booting"] = True
+            messenger.adopt_task(f"{name}.reboot", loop.create_task(boot()))
+        # pools flow mon -> daemon: host engines for map pools we lack
+        from ceph_tpu.osd.placement import CrushPlacement
+
+        for pname, p in m.get("pools", {}).items():
+            if pname in shard.pools:
+                continue
+            if p.get("pool_type") == "replicated":
+                ec, km = None, int(p["size"])
+            else:
+                profile = dict(
+                    m.get("ec_profiles", {}).get(p["profile_name"], {})
+                )
+                if not profile:
+                    continue  # profile missing from the map: skip
+                plugin = profile.pop("plugin", "jerasure")
+                from ceph_tpu.plugins import registry as registry_mod
+
+                ec = registry_mod.instance().factory(plugin, profile)
+                km = ec.get_chunk_count()
+            placement = CrushPlacement(n_osds, km, hosts=p.get("hosts"))
+            for osd_s, w in m["weights"].items():
+                placement.weights[int(osd_s)] = w
+            shard.host_pool(pname, ec, n_osds, placement,
+                            pool_type=p.get("pool_type", "erasure"),
+                            size=km, min_size=p.get("min_size") or None)
+        shard.request_peering()  # re-peer on every map epoch
+
+    async def mon_hook(src, msg):
+        if await monc.handle_reply(msg):
+            return
+        if msg.get("type") == "osdmap":
+            apply_osdmap(msg["map"])
+
+    shard.mon_hook = mon_hook
+
+    async def boot():
+        flags["booting"] = True
+        try:
+            while True:
+                rc, _out = await monc.command(
+                    {"prefix": "osd boot", "osd": shard.osd_id}, timeout=2.0
+                )
+                if rc == 0:
+                    break
+                await asyncio.sleep(0.5)  # mons still electing
+            await monc.subscribe()
+        finally:
+            flags["booting"] = False
+
+    async def heartbeat_loop():
+        # peer heartbeats + failure reports (OSD.cc:4612 handle_osd_ping
+        # -> send_failures); first-miss timestamps gate on the grace.
+        # Probes run CONCURRENTLY so a pile of dead peers cannot stretch
+        # the round past ~one probe timeout.
+        first_miss: dict = {}
+
+        async def probe_one(j):
+            try:
+                return j, await messenger.probe(f"osd.{j}", timeout=1.0)
+            except (OSError, asyncio.TimeoutError):
+                return j, False
+
+        while True:
+            cfg = get_config()
+            await asyncio.sleep(float(cfg.get_val("osd_heartbeat_interval")))
+            grace = float(cfg.get_val("osd_heartbeat_grace"))
+            results = await asyncio.gather(*(
+                probe_one(j) for j in range(n_osds)
+                if f"osd.{j}" != name
+            ))
+            now = asyncio.get_event_loop().time()
+            for j, ok in results:
+                if ok:
+                    first_miss.pop(j, None)
+                    continue
+                first = first_miss.setdefault(j, now)
+                if now - first >= grace and state["up"].get(j, True):
+                    # report once per grace window; the mon dedups
+                    # reporters and the map broadcast stops the loop
+                    first_miss[j] = now
+                    await monc.command(
+                        {"prefix": "osd failure", "osd": j, "from": name},
+                        timeout=1.0,
+                    )
+
+    messenger.adopt_task(f"{name}.boot", loop.create_task(boot()))
+    messenger.adopt_task(
+        f"{name}.heartbeat", loop.create_task(heartbeat_loop())
+    )
+    shard.start_tick()
+
+
 async def serve(args) -> None:
     from ceph_tpu.msg.tcp import TCPMessenger
     from ceph_tpu.osd.ecbackend import OSDShard
@@ -39,10 +177,21 @@ async def serve(args) -> None:
         args.id, messenger, op_queue=args.op_queue,
         objectstore=args.objectstore, data_path=args.data_path,
     )
-    if args.cluster_conf:
-        # host a primary engine for the cluster's pool: THIS daemon (not
-        # the client) owns placement, version authority and sub-op fan-out
-        # for objects whose acting set it leads (the PrimaryLogPG role)
+    mon_ranks = sorted(
+        int(k.split(".", 1)[1]) for k in addr_map if k.startswith("mon.")
+    )
+    if mon_ranks:
+        # monitor-integrated boot (reference src/ceph_osd.cc:650 ->
+        # OSD::start_boot, src/osd/OSD.cc:5386): register with the mon,
+        # subscribe to osdmap epochs, learn pools FROM the map, run peer
+        # heartbeats and report failures -- no static pool conf needed
+        await _mon_integrate(args, shard, messenger, addr_map,
+                             len(mon_ranks))
+    if args.cluster_conf and not mon_ranks:
+        # legacy static bring-up: host a primary engine for the cluster's
+        # pool from a JSON conf: THIS daemon (not the client) owns
+        # placement, version authority and sub-op fan-out for objects
+        # whose acting set it leads (the PrimaryLogPG role)
         with open(args.cluster_conf) as f:
             conf = json.load(f)
         profile = dict(conf["profile"])

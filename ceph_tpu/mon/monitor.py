@@ -58,6 +58,12 @@ class Monitor:
         self._subscribers: set = set()
         self._cmd_lock = asyncio.Lock()
         self._last_lease = 0.0
+        #: pending OSD failure reports: osd id -> {reporter: stamp}
+        #: (leader-local, like the reference's failure_info_t pending
+        #: map).  Entries EXPIRE (see "osd failure" handling) so a
+        #: reporter's one transient probe stall can never combine with an
+        #: unrelated stall hours later to mark a healthy OSD down.
+        self._failure_reports: Dict[int, Dict[str, float]] = {}
         messenger.register(self.name, self.dispatch)
 
     def close_store(self) -> None:
@@ -328,10 +334,66 @@ class Monitor:
                 "osdmap_epoch": self.osdmap.epoch,
                 "pools": sorted(self.osdmap.pools),
                 "num_osds": self.osdmap.max_osd,
+                "up_osds": sorted(
+                    i for i, up in self.osdmap.up.items() if up
+                ),
             }
         if prefix == "osd create":
             ok = await self._propose({"op": "create_osds", "n": cmd["n"]})
             return (0, f"created {cmd['n']} osds") if ok else (-11, "no quorum")
+        if prefix == "osd boot":
+            # an OSD daemon reporting for duty (reference OSD::_send_boot
+            # -> OSDMonitor::prepare_boot, src/osd/OSD.cc:5386): mark it
+            # up, clear pending failure reports against it, bump the
+            # epoch so subscribers re-peer onto it
+            osd = int(cmd["osd"])
+            if osd >= self.osdmap.max_osd:
+                ok = await self._propose({"op": "create_osds", "n": osd + 1})
+                if not ok:
+                    return -11, "no quorum"
+            self._failure_reports.pop(osd, None)
+            if self.osdmap.up.get(osd):
+                return 0, {"epoch": self.osdmap.epoch, "already_up": True}
+            ok = await self._propose({"op": "osd_up", "osd": osd})
+            return (0, {"epoch": self.osdmap.epoch}) if ok \
+                else (-11, "no quorum")
+        if prefix == "osd failure":
+            # peer-reported failure (reference MOSDFailure ->
+            # OSDMonitor::check_failure, src/mon/OSDMonitor.cc): collect
+            # DISTINCT reporters; at mon_osd_min_down_reporters the
+            # target is marked down and the epoch bump broadcasts.
+            # Report state is leader-local, like the reference's pending
+            # failure_info_t (not paxos state).
+            from ceph_tpu.utils.config import get_config
+
+            osd = int(cmd["osd"])
+            if not self.osdmap.up.get(osd):
+                return 0, {"already_down": True}
+            now = asyncio.get_event_loop().time()
+            reporters = self._failure_reports.setdefault(osd, {})
+            reporters[cmd.get("from", "?")] = now
+            # expire reports older than ~4 heartbeat-grace windows: a
+            # genuinely-down OSD is re-reported every grace interval, so
+            # live reports refresh; stale ones age out (reference
+            # OSDMonitor expires failure_info_t / handles cancellations)
+            expiry = 4 * float(get_config().get_val("osd_heartbeat_grace"))
+            for rep, stamp in list(reporters.items()):
+                if now - stamp > expiry:
+                    del reporters[rep]
+            need = int(get_config().get_val("mon_osd_min_down_reporters"))
+            if len(reporters) < need:
+                return 0, {"reports": len(reporters), "need": need}
+            self._failure_reports.pop(osd, None)
+            ok = await self._propose({"op": "osd_down", "osd": osd})
+            if ok:
+                self.clog.apply({
+                    "op": "clog_append", "who": self.name,
+                    "level": "warn",
+                    "message": f"osd.{osd} failed "
+                               f"({len(reporters)} reporters)",
+                    "stamp": 0.0,
+                })
+            return (0, {"marked_down": True}) if ok else (-11, "no quorum")
         if prefix == "osd erasure-code-profile set":
             name, profile = cmd["name"], dict(cmd["profile"])
             # validate by instantiating the codec (OSDMonitor.cc:5353)

@@ -31,6 +31,37 @@ class PoolInfo:
     hosts: Optional[List[List[int]]] = None
 
 
+def apply_map_view(m: dict, state: dict, messenger=None, placements=(),
+                   skip_entity: Optional[str] = None) -> bool:
+    """Apply one broadcast osdmap dict to a subscriber-side view -- the
+    epoch gate, up/down marks on the messenger, and CRUSH weight pushes
+    every daemon/client subscriber needs (shared so the three consumers
+    cannot drift; round-5 review finding).  ``state`` accumulates
+    {"epoch", "up"}; ``placements`` get weights + an epoch bump; pass
+    ``messenger=None`` to skip up/down marks (in-process harnesses own
+    their liveness view).  Returns False when the epoch is stale."""
+    if m["epoch"] <= state.get("epoch", 0):
+        return False
+    state["epoch"] = m["epoch"]
+    state["up"] = {int(k): v for k, v in m["up"].items()}
+    if messenger is not None:
+        for osd_id, up in state["up"].items():
+            entity = f"osd.{osd_id}"
+            if entity == skip_entity:
+                continue
+            if up and messenger.is_down(entity):
+                messenger.mark_up(entity)
+            elif not up and not messenger.is_down(entity):
+                messenger.mark_down(entity)
+    for placement in placements:
+        if placement is None:
+            continue
+        for osd_s, w in m["weights"].items():
+            placement.weights[int(osd_s)] = w
+        placement.epoch += 1  # invalidate pg cache
+    return True
+
+
 @dataclass
 class OSDMap:
     epoch: int = 0

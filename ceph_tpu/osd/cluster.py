@@ -292,19 +292,19 @@ class ECCluster:
         # route mon replies and map broadcasts through the client dispatcher
         backend = self.backend
 
+        map_state: Dict = {}
+
         async def mon_hook(msg: dict) -> None:
             if await self.monc.handle_reply(msg):
                 return
             if msg.get("type") == "osdmap" and backend.placement is not None:
-                m = msg["map"]
-                if m["epoch"] > self._osdmap_epoch:
-                    self._osdmap_epoch = m["epoch"]
-                    for osd_s, w in m["weights"].items():
-                        backend.placement.weights[int(osd_s)] = w
-                    backend.placement.epoch += 1  # invalidate pg cache
-                    self._notify_peering()  # re-peer on every map epoch
+                from ceph_tpu.mon.osdmap import apply_map_view
 
-        self._osdmap_epoch = 0
+                # messenger=None: the in-process harness owns its own
+                # liveness view (kill_osd/revive_osd mark it directly)
+                if apply_map_view(msg["map"], map_state, None,
+                                  placements=[backend.placement]):
+                    self._notify_peering()  # re-peer on every map epoch
         backend.mon_hook = mon_hook
         full_profile = dict(profile)
         full_profile["plugin"] = plugin

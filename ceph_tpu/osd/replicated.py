@@ -37,6 +37,7 @@ from ceph_tpu.osd.pg import (
     SNAPSET_KEY,
     VERSION_KEY,
     WHITEOUT_KEY,
+    ObjectIncomplete,
     shard_oid,
     snap_oid,
 )
@@ -143,6 +144,22 @@ class ReplicatedBackend(PG):
 
     # -- read path ---------------------------------------------------------
 
+    def _read_quorum_check(self, oid: str, acting, up) -> None:
+        """Read-after-ack guard for k=1 (the review r5 finding): every
+        acked write reached >= min_size placed replicas, so a NEWER acked
+        version can hide entirely among the unreachable holders only if
+        >= min_size of them are unreachable.  In that regime the newest
+        visible copy may be stale -- refuse, like the reference's PG
+        going inactive below min_size, instead of serving silently."""
+        placed = sum(1 for s in range(self.km) if acting[s] is not None)
+        unseen = placed - len(up)
+        if unseen >= self.min_size:
+            raise ObjectIncomplete(
+                f"{oid}: {unseen} of {placed} replicas unreachable "
+                f"(>= min_size {self.min_size}); the newest acked write "
+                "may be invisible -- refusing possibly-stale read"
+            )
+
     async def read(self, oid: str) -> bytes:
         """Serve from one replica; the shared gather falls back to newer
         holders if the chosen copy is stale (the primary-read role,
@@ -153,6 +170,7 @@ class ReplicatedBackend(PG):
             up = await self._reconfirm_up(acting, up)
         if not up:
             raise IOError(f"cannot read {oid}: no replicas up")
+        self._read_quorum_check(oid, acting, up)
         chunks, logical_size, attrs, _ = await self._gather_consistent(
             oid, up[:1], acting, up_shards=up
         )
@@ -181,6 +199,7 @@ class ReplicatedBackend(PG):
         up = [s for s in range(self.km) if self._shard_up(acting, s)]
         if not up:
             raise IOError(f"cannot range-read {oid}: no replicas up")
+        self._read_quorum_check(oid, acting, up)
         chunks, _, _, _ = await self._gather_consistent(
             oid, up[:1], acting, extents=[(offset, length)], up_shards=up,
         )

@@ -139,7 +139,8 @@ class OSDShard:
         return self._host_tid
 
     def host_pool(self, pool: str, ec, n_osds: int, placement=None,
-                  pool_type: str = "erasure", size: int = 3):
+                  pool_type: str = "erasure", size: int = 3,
+                  min_size=None):
         """Attach a primary engine for ``pool`` to this OSD.  Every OSD in
         the cluster hosts one; clients route each op to the object's
         current primary (first up shard of the acting set).
@@ -156,6 +157,7 @@ class OSDShard:
                 size, list(range(n_osds)), self.messenger, name=self.name,
                 placement=placement, register=False,
                 tid_alloc=self._next_host_tid, perf=self.perf,
+                min_size=min_size,
             )
         else:
             from ceph_tpu.osd.ecbackend import ECBackend
@@ -374,6 +376,13 @@ class OSDShard:
                     await backend.dispatch(src, msg)
                 return
             await self._handle_meta_op(src, msg)
+            return
+        if isinstance(msg, dict):
+            # monitor traffic (command replies, osdmap broadcasts): a
+            # mon-integrated daemon wires its MonClient handler here
+            hook = getattr(self, "mon_hook", None)
+            if hook is not None:
+                await hook(src, msg)
             return
         if isinstance(msg, (ECSubWrite, ECSubRead)):
             klass = getattr(msg, "op_class", "client")

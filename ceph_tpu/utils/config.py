@@ -78,6 +78,18 @@ OPTIONS: Dict[str, Option] = {
              LEVEL_ADVANCED,
              "max bytes of in-flight inbound messages a daemon holds "
              "before back-pressuring senders (dispatch throttle)"),
+        _opt("osd_heartbeat_interval", float, 1.0, LEVEL_ADVANCED,
+             "seconds between OSD peer heartbeat rounds (reference "
+             "osd_heartbeat_interval, src/osd/OSD.cc heartbeat())"),
+        _opt("osd_heartbeat_grace", float, 4.0, LEVEL_ADVANCED,
+             "seconds of heartbeat silence before an OSD reports a peer "
+             "failed to the mon (reference osd_heartbeat_grace; shrunk "
+             "here to match the mini-cluster's time scale)"),
+        _opt("mon_osd_min_down_reporters", int, 2, LEVEL_ADVANCED,
+             "distinct OSD failure reporters required before the mon "
+             "marks the target down (reference "
+             "mon_osd_min_down_reporters, src/mon/OSDMonitor.cc "
+             "check_failure)"),
         _opt("ms_inject_socket_failures", int, 0, LEVEL_DEV,
              "inject a message drop roughly every N messages"),
         _opt("ms_inject_internal_delays", float, 0.0, LEVEL_DEV,

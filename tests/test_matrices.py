@@ -133,3 +133,87 @@ def test_isa_matrices(k, m):
     R = isa.gen_rs_matrix(k, m)
     assert np.all(R[k] == 1)  # first coding row: g=1 -> all ones
     assert _is_mds(R[k:], k, m, 8)
+
+
+# -- known-answer vectors (VERDICT r4 item 7) -------------------------------
+#
+# Golden constants derived INDEPENDENTLY of ceph_tpu (a from-scratch GF
+# shift/reduce multiplier + the published constructions), pinning the
+# matrix constructions so any drift in gf tables, the Vandermonde
+# elimination, the Cauchy formula, or the bitmatrix expansion fails
+# loudly.  Provenance:
+#   * primitive polynomials: jerasure's galois.c defaults — w=8: 0x11D
+#     (x^8+x^4+x^3+x^2+1), w=4: 0x13 (x^4+x+1), w=16: 0x1100B;
+#   * reed_sol_van: Plank & Ding, "Note: Correction to the 1997 Tutorial
+#     on Reed-Solomon Coding" (2003) — extended Vandermonde, elementary
+#     column ops to systematic form, first parity row normalized to ones
+#     (jerasure 2.0 reed_sol.c; reference ErasureCodeJerasure.cc:196-199);
+#   * cauchy_orig: M[i][j] = 1/(i ⊕ (m+j)) (Plank & Xu NCA-06; jerasure
+#     cauchy.c cauchy_original_coding_matrix);
+#   * bitmatrix: column x of an element block is the bit-decomposition
+#     of e·2^x (jerasure_matrix_to_bitmatrix).
+# Reference KAT harness role: ceph_erasure_code_non_regression.cc:254-268.
+
+
+def test_kat_gf_products():
+    """Pin the primitive polynomials via hand-derived products."""
+    from ceph_tpu.ops.gf import gf
+
+    F8 = gf(8)
+    for a, b, want in [(2, 128, 29), (15, 8, 120), (166, 123, 151),
+                       (255, 255, 226)]:
+        assert F8.mul(a, b) == want, (a, b)
+    F4 = gf(4)
+    for a, b, want in [(2, 8, 3), (9, 14, 7), (15, 15, 10)]:
+        assert F4.mul(a, b) == want, (a, b)
+    F16 = gf(16)
+    for a, b, want in [(2, 0x8000, 4107), (0x1234, 0x5678, 25380)]:
+        assert F16.mul(a, b) == want, (a, b)
+
+
+def test_kat_reed_sol_van_coding_rows():
+    """Golden reed_sol_van coding matrices (independent derivation)."""
+    from ceph_tpu.matrices import reed_sol
+
+    assert reed_sol.vandermonde_coding_matrix(3, 2, 8).tolist() == [
+        [1, 1, 1], [15, 8, 6]]
+    assert reed_sol.vandermonde_coding_matrix(4, 2, 8).tolist() == [
+        [1, 1, 1, 1], [166, 70, 187, 123]]
+    assert reed_sol.vandermonde_coding_matrix(3, 2, 16).tolist() == [
+        [1, 1, 1], [15, 8, 6]]
+
+
+def test_kat_cauchy_orig_bitmatrix():
+    """Golden cauchy_orig k=2 m=2 w=4 elements + full bitmatrix."""
+    from ceph_tpu.matrices import cauchy
+    from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix
+
+    M = cauchy.original_coding_matrix(2, 2, 4)
+    assert M.tolist() == [[9, 14], [14, 9]]
+    assert matrix_to_bitmatrix(M, 4).tolist() == [
+        [1, 1, 0, 0, 0, 1, 1, 1],
+        [0, 0, 1, 0, 1, 1, 0, 0],
+        [0, 0, 0, 1, 1, 1, 1, 0],
+        [1, 0, 0, 0, 1, 1, 1, 1],
+        [0, 1, 1, 1, 1, 1, 0, 0],
+        [1, 1, 0, 0, 0, 0, 1, 0],
+        [1, 1, 1, 0, 0, 0, 0, 1],
+        [1, 1, 1, 1, 1, 0, 0, 0],
+    ]
+
+
+def test_kat_end_to_end_encode_bytes():
+    """Byte-level encode KAT through the jerasure plugin: one stripe of
+    data [0x0b, 0xad, 0xc0] (k=3 m=2 w=8, 1-byte chunks) must produce
+    parity [0x66, 0xd2] (hand-computed: p0 = XOR row-of-ones, p1 =
+    15·0x0b ⊕ 8·0xad ⊕ 6·0xc0 over GF(256)/0x11D)."""
+    from ceph_tpu.plugins import registry as registry_mod
+
+    reg = registry_mod.ErasureCodePluginRegistry()
+    ec = reg.factory("jerasure", {
+        "k": "3", "m": "2", "technique": "reed_sol_van", "w": "8"})
+    chunk = ec.get_chunk_size(3)
+    data = bytes([0x0B] * chunk + [0xAD] * chunk + [0xC0] * chunk)
+    out = ec.encode(set(range(5)), data)
+    assert bytes(out[3]) == bytes([0x66]) * chunk
+    assert bytes(out[4]) == bytes([0xD2]) * chunk

@@ -337,3 +337,29 @@ def test_list_objects_includes_omap_only():
         assert io.list_objects() == ["cfg"]
     finally:
         r.shutdown()
+
+
+def test_read_refuses_when_acked_write_may_be_hidden():
+    """Review r5 finding: with >= min_size placed replicas unreachable,
+    the newest acked write may be entirely invisible -- the read must
+    refuse (ObjectIncomplete), never silently serve the older bytes."""
+    from ceph_tpu.osd.pg import ObjectIncomplete
+
+    async def main():
+        c = make_cluster(n_osds=3, size=3)
+        await c.write("obj", b"v1" * 100)
+        acting = c.backend.acting_set("obj")
+        c.kill_osd(acting[2])
+        await c.write("obj", b"v2" * 100)  # acked by replicas 0,1 only
+        # now the two ackers die and the stale replica revives
+        c.kill_osd(acting[0])
+        c.kill_osd(acting[1])
+        c.revive_osd(acting[2])
+        with pytest.raises((ObjectIncomplete, IOError)):
+            await c.read("obj")
+        # heal: revive an acker -> quorum intersects, v2 served again
+        c.revive_osd(acting[0])
+        assert await c.read("obj") == b"v2" * 100
+        await c.shutdown()
+
+    run(main())

@@ -49,16 +49,23 @@ def _daemon_env():
 
 
 def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
-                  op_queue="wpq", wait=10.0, auth=False):
+                  op_queue="wpq", wait=10.0, auth=False, n_mons=0):
     """Boot n_osds daemon processes; returns the addr map path.
     Library entry point used by the CLI and the standalone tests.
     With auth=True a keyring is generated and every connection runs the
     cephx-style handshake + message signing (vstart.sh enables cephx by
-    default too)."""
+    default too).
+
+    With ``n_mons`` > 0 the cluster is MONITOR-INTEGRATED (the reference
+    vstart.sh shape: mons boot first, pools are created through the mon,
+    OSDs boot INTO the mon and learn pools from osdmap broadcasts,
+    peer heartbeats drive mon mark-down)."""
     os.makedirs(run_dir, exist_ok=True)
-    ports = _free_ports(n_osds + 1)
+    ports = _free_ports(n_osds + n_mons + 1)
     addr_map = {f"osd.{i}": ("127.0.0.1", ports[i]) for i in range(n_osds)}
-    addr_map["client"] = ("127.0.0.1", ports[n_osds])
+    for r in range(n_mons):
+        addr_map[f"mon.{r}"] = ("127.0.0.1", ports[n_osds + r])
+    addr_map["client"] = ("127.0.0.1", ports[n_osds + n_mons])
     map_path = os.path.join(run_dir, "addr_map.json")
     with open(map_path, "w") as f:
         json.dump(addr_map, f)
@@ -71,8 +78,24 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
         ring.save(os.path.join(run_dir, "keyring"))
     with open(os.path.join(run_dir, "cluster.json"), "w") as f:
         json.dump({"profile": profile, "n_osds": n_osds,
-                   "objectstore": objectstore, "auth": auth}, f)
+                   "objectstore": objectstore, "auth": auth,
+                   "n_mons": n_mons}, f)
     data_path = os.path.join(run_dir, "data")
+    deadline = time.time() + wait
+    if n_mons:
+        mon_pids = {r: spawn_mon(run_dir, r, n_mons)
+                    for r in range(n_mons)}
+        with open(os.path.join(run_dir, "mon_pids"), "w") as f:
+            json.dump({str(r): p for r, p in mon_pids.items()}, f)
+        for r in range(n_mons):
+            _wait_port(addr_map[f"mon.{r}"], deadline, f"mon.{r}")
+        # pools flow mon -> daemons: create them BEFORE the osds boot so
+        # the subscription's first map already carries them
+        import asyncio as _asyncio
+
+        _asyncio.new_event_loop().run_until_complete(
+            _bootstrap_pools(run_dir, n_osds, profile)
+        )
     pids = {}
     for i in range(n_osds):
         pids[i] = spawn_osd(run_dir, i, objectstore=objectstore,
@@ -80,18 +103,89 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
                             auth=auth)
     _save_pids(run_dir, pids)
     # readiness: every daemon's port accepts connections
-    deadline = time.time() + wait
     for i in range(n_osds):
-        host, port = addr_map[f"osd.{i}"]
-        while True:
-            try:
-                socket.create_connection((host, port), timeout=0.25).close()
-                break
-            except OSError:
-                if time.time() > deadline:
-                    raise TimeoutError(f"osd.{i} did not come up")
-                time.sleep(0.05)
+        _wait_port(addr_map[f"osd.{i}"], deadline, f"osd.{i}")
     return map_path
+
+
+def _wait_port(addr, deadline, who):
+    host, port = addr
+    while True:
+        try:
+            socket.create_connection((host, port), timeout=0.25).close()
+            return
+        except OSError:
+            if time.time() > deadline:
+                raise TimeoutError(f"{who} did not come up")
+            time.sleep(0.05)
+
+
+def spawn_mon(run_dir, rank, n_mons):
+    """Start one monitor daemon process; returns its pid."""
+    log = open(os.path.join(run_dir, f"mon.{rank}.log"), "ab")
+    store = os.path.join(run_dir, "mon", str(rank))
+    os.makedirs(store, exist_ok=True)
+    cmd = [sys.executable, "-m", "ceph_tpu.daemon.mon",
+           "--rank", str(rank), "--mons", str(n_mons),
+           "--addr-map", os.path.join(run_dir, "addr_map.json"),
+           "--store-path", store]
+    proc = subprocess.Popen(
+        cmd, stdout=log, stderr=log, env=_daemon_env(), cwd=REPO,
+    )
+    return proc.pid
+
+
+async def _bootstrap_pools(run_dir, n_osds, profile, pool="ecpool"):
+    """Create osds + the pool through the mon quorum (the `ceph osd ...`
+    command flow, reference src/mon/OSDMonitor.cc)."""
+    import asyncio
+
+    from ceph_tpu.mon.monitor import MonClient
+    from ceph_tpu.msg.tcp import TCPMessenger
+
+    with open(os.path.join(run_dir, "addr_map.json")) as f:
+        addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+    n_mons = sum(1 for k in addr_map if k.startswith("mon."))
+    ms = TCPMessenger("client", addr_map)
+    await ms.start()
+    monc = MonClient(ms, n_mons, "client")
+
+    async def dispatch(src, msg):
+        if isinstance(msg, dict):
+            await monc.handle_reply(msg)
+
+    ms.register("client", dispatch)
+    try:
+        deadline = time.time() + 15
+        while True:  # quorum may still be forming
+            rc, out = await monc.command(
+                {"prefix": "osd create", "n": n_osds}, timeout=2.0
+            )
+            if rc == 0:
+                break
+            if time.time() > deadline:
+                raise TimeoutError(f"mon bootstrap failed: {out}")
+            await asyncio.sleep(0.4)
+        if profile.get("pool_type") == "replicated":
+            rc, out = await monc.command({
+                "prefix": "osd pool create", "name": pool,
+                "pool_type": "replicated", "size": int(profile["size"]),
+            })
+        else:
+            rc, out = await monc.command({
+                "prefix": "osd erasure-code-profile set",
+                "name": f"{pool}-profile", "profile": profile,
+            })
+            if rc != 0:
+                raise RuntimeError(f"profile set: {out}")
+            rc, out = await monc.command({
+                "prefix": "osd pool create", "name": pool,
+                "profile": f"{pool}-profile",
+            })
+        if rc != 0:
+            raise RuntimeError(f"pool create: {out}")
+    finally:
+        await ms.shutdown()
 
 
 def spawn_osd(run_dir, osd_id, objectstore="memstore", op_queue="wpq",
@@ -165,7 +259,12 @@ def revive_osd(run_dir, osd_id):
 
 
 def stop_cluster(run_dir):
-    pids = _load_pids(run_dir)
+    pids = dict(_load_pids(run_dir))
+    try:
+        with open(os.path.join(run_dir, "mon_pids")) as f:
+            pids.update({f"mon.{k}": v for k, v in json.load(f).items()})
+    except FileNotFoundError:
+        pass
     for pid in pids.values():
         try:
             os.kill(pid, signal.SIGTERM)
@@ -177,6 +276,10 @@ def stop_cluster(run_dir):
         except (ChildProcessError, ProcessLookupError):
             pass
     _save_pids(run_dir, {})
+    try:
+        os.remove(os.path.join(run_dir, "mon_pids"))
+    except FileNotFoundError:
+        pass
 
 
 async def _client(run_dir):
@@ -216,6 +319,10 @@ def main(argv=None):
     ap.add_argument("--objectstore", default="memstore")
     ap.add_argument("--auth", action="store_true",
                     help="enable cephx-style auth (keyring + signing)")
+    ap.add_argument("--mons", type=int, default=0,
+                    help="monitor count; >0 boots a mon quorum, creates "
+                         "the pool through it, and OSDs boot into the mon "
+                         "(heartbeat mark-down, map-driven pools)")
     args = ap.parse_args(argv)
 
     if args.cmd == "start":
@@ -225,8 +332,11 @@ def main(argv=None):
             profile = {"plugin": args.plugin, "k": str(args.k),
                        "m": str(args.m)}
         start_cluster(args.dir, args.osds, profile,
-                      objectstore=args.objectstore, auth=args.auth)
-        print(f"cluster up: {args.osds} osds, profile {profile}"
+                      objectstore=args.objectstore, auth=args.auth,
+                      n_mons=args.mons)
+        print(f"cluster up: {args.osds} osds"
+              + (f", {args.mons} mons" if args.mons else "")
+              + f", profile {profile}"
               + (" [cephx auth]" if args.auth else ""))
     elif args.cmd == "stop":
         stop_cluster(args.dir)
