@@ -118,39 +118,54 @@ async def _mon_integrate(args, shard, messenger, addr_map,
 
     async def heartbeat_loop():
         # peer heartbeats + failure reports (OSD.cc:4612 handle_osd_ping
-        # -> send_failures); first-miss timestamps gate on the grace.
-        # Probes run CONCURRENTLY so a pile of dead peers cannot stretch
-        # the round past ~one probe timeout.
-        first_miss: dict = {}
+        # -> send_failures).  Steady state is a cheap ping/pong over the
+        # CACHED connection (the review found per-round probe() cycling
+        # every peer's TCP connection); the expensive probe runs only to
+        # CONFIRM a peer whose pongs went silent past the grace.
+        peers = [j for j in range(n_osds) if f"osd.{j}" != name]
+        start = loop.time()
+        for j in peers:  # never-ponged peers age from loop start
+            shard.hb_pongs.setdefault(f"osd.{j}", start)
 
-        async def probe_one(j):
+        async def ping_one(j):
             try:
-                return j, await messenger.probe(f"osd.{j}", timeout=1.0)
+                await messenger.send_message(name, f"osd.{j}", "ping")
             except (OSError, asyncio.TimeoutError):
-                return j, False
+                pass  # dead peer: its pong stays stale, the grace fires
+
+        async def confirm_down(j):
+            try:
+                return not await messenger.probe(f"osd.{j}", timeout=1.0)
+            except (OSError, asyncio.TimeoutError):
+                return True
 
         while True:
             cfg = get_config()
             await asyncio.sleep(float(cfg.get_val("osd_heartbeat_interval")))
             grace = float(cfg.get_val("osd_heartbeat_grace"))
-            results = await asyncio.gather(*(
-                probe_one(j) for j in range(n_osds)
-                if f"osd.{j}" != name
+            await asyncio.gather(*(ping_one(j) for j in peers))
+            now = loop.time()
+            suspects = [
+                j for j in peers
+                if now - shard.hb_pongs.get(f"osd.{j}", start) >= grace
+                and state["up"].get(j, True)
+            ]
+            if not suspects:
+                continue
+            confirmed = await asyncio.gather(*(
+                confirm_down(j) for j in suspects
             ))
-            now = asyncio.get_event_loop().time()
-            for j, ok in results:
-                if ok:
-                    first_miss.pop(j, None)
+            for j, down in zip(suspects, confirmed):
+                if not down:
+                    shard.hb_pongs[f"osd.{j}"] = now  # probe answered
                     continue
-                first = first_miss.setdefault(j, now)
-                if now - first >= grace and state["up"].get(j, True):
-                    # report once per grace window; the mon dedups
-                    # reporters and the map broadcast stops the loop
-                    first_miss[j] = now
-                    await monc.command(
-                        {"prefix": "osd failure", "osd": j, "from": name},
-                        timeout=1.0,
-                    )
+                # report once per grace window; the mon dedups reporters
+                # and the map broadcast stops the loop
+                shard.hb_pongs[f"osd.{j}"] = now
+                await monc.command(
+                    {"prefix": "osd failure", "osd": j, "from": name},
+                    timeout=1.0,
+                )
 
     messenger.adopt_task(f"{name}.boot", loop.create_task(boot()))
     messenger.adopt_task(

@@ -87,6 +87,8 @@ class OSDShard:
         self._meta_tid = 0
         self._meta_pending: Dict[int, tuple] = {}
         self.optracker = OpTracker()
+        #: peer name -> last heartbeat pong time (handle_osd_ping role)
+        self.hb_pongs: Dict[str, float] = {}
         #: entity -> OSDCap; entities absent here run with the open
         #: default (client.admin allow *).  Populated via
         #: set_client_caps from keyring "caps osd" strings.
@@ -339,6 +341,11 @@ class OSDShard:
         if msg == "ping":
             # fast dispatch: heartbeats never sit behind the op queue
             await self.messenger.send_message(self.name, src, ("pong", self.name))
+            return
+        if isinstance(msg, tuple) and msg and msg[0] == "pong":
+            # peer heartbeat answer (the mon-integrated daemon's
+            # heartbeat loop reads these timestamps)
+            self.hb_pongs[msg[1]] = asyncio.get_event_loop().time()
             return
         if isinstance(msg, (ECSubWriteReply, ECSubReadReply)):
             # this OSD is acting as a primary: forward sub-op replies to

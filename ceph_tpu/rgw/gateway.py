@@ -42,6 +42,95 @@ def uploads_oid(bucket: str) -> str:
     return f"rgw.uploads.{bucket}"
 
 
+def acl_oid(bucket: str) -> str:
+    """Per-bucket ACL store: omap key "@bucket" holds the bucket ACL,
+    key "<obj>" an object ACL (reference: ACLs ride the bucket/object
+    attrs, src/rgw/rgw_acl.h:1; stored form here is JSON)."""
+    return f"rgw.aclstore.{bucket}"
+
+
+#: canned ACLs -> grant lists (reference rgw_acl_s3.cc canned-ACL table)
+CANNED_ACLS = {
+    "private": [],
+    "public-read": [{"grantee": "*", "perm": "READ"}],
+    "public-read-write": [{"grantee": "*", "perm": "READ"},
+                          {"grantee": "*", "perm": "WRITE"}],
+    "authenticated-read": [{"grantee": "authenticated", "perm": "READ"}],
+}
+
+
+def acl_from_headers(headers: Dict[str, str], owner: str):
+    """Build an ACL dict from x-amz-acl / x-amz-grant-* headers
+    (rgw_acl_s3.cc create_canned + grant-header parsing); None when the
+    request carries no ACL headers (keep default private)."""
+    canned = headers.get("x-amz-acl", "")
+    if canned and canned not in CANNED_ACLS:
+        raise S3Error("InvalidRequest", f"bad canned acl {canned!r}")
+    grants = list(CANNED_ACLS.get(canned, []))
+    had_grant_hdr = bool(canned)
+    for hdr, perm in (("x-amz-grant-read", "READ"),
+                      ("x-amz-grant-write", "WRITE"),
+                      ("x-amz-grant-full-control", "FULL_CONTROL")):
+        for part in headers.get(hdr, "").split(","):
+            part = part.strip()
+            if not part:
+                continue
+            had_grant_hdr = True
+            if part.startswith("id="):
+                grants.append({"grantee": part[3:].strip('"'),
+                               "perm": perm})
+            elif part.endswith("AllUsers"):
+                grants.append({"grantee": "*", "perm": perm})
+            elif part.endswith("AuthenticatedUsers"):
+                grants.append({"grantee": "authenticated", "perm": perm})
+            else:
+                raise S3Error("InvalidRequest", f"bad grantee {part!r}")
+    if not had_grant_hdr:
+        return None
+    return {"owner": owner, "canned": canned or "custom", "grants": grants}
+
+
+def acl_allows(acl: Optional[dict], requester: Optional[str],
+               perm: str) -> bool:
+    """Does ``acl`` grant ``perm`` to ``requester`` (None = anonymous)?
+    The acl's own owner always has FULL_CONTROL."""
+    if not acl:
+        return False
+    if requester is not None and acl.get("owner") == requester:
+        return True
+    for g in acl.get("grants", []):
+        if g["perm"] not in (perm, "FULL_CONTROL"):
+            continue
+        gr = g["grantee"]
+        if gr == "*" or gr == requester or (
+            gr == "authenticated" and requester is not None
+        ):
+            return True
+    return False
+
+
+def acl_to_xml(acl: Optional[dict], owner: str) -> str:
+    """AccessControlPolicy XML (GET ?acl; rgw_acl_s3.cc to_xml)."""
+    grants = (acl or {}).get("grants", [])
+    body = "".join(
+        "<Grant><Grantee>"
+        + (f"<URI>http://acs.amazonaws.com/groups/global/"
+           f"{'AllUsers' if g['grantee'] == '*' else 'AuthenticatedUsers'}"
+           "</URI>"
+           if g["grantee"] in ("*", "authenticated")
+           else f"<ID>{escape(g['grantee'])}</ID>")
+        + f"</Grantee><Permission>{g['perm']}</Permission></Grant>"
+        for g in grants
+    )
+    return (
+        '<?xml version="1.0" encoding="UTF-8"?>'
+        "<AccessControlPolicy>"
+        f"<Owner><ID>{escape((acl or {}).get('owner') or owner)}</ID></Owner>"
+        f"<AccessControlList>{body}</AccessControlList>"
+        "</AccessControlPolicy>"
+    )
+
+
 def sign_v2(secret: str, method: str, resource: str, date: str,
             content_type: str = "", content_md5: str = "") -> str:
     """AWS signature v2 (the rgw_auth_s3.cc canonical string)."""
@@ -204,6 +293,10 @@ class RGWGateway:
         if auth.startswith("AWS4-HMAC-SHA256 "):
             return await self._auth_v4(auth, method, path, params or {},
                                        headers, body)
+        if not auth:
+            # anonymous request (reference: rgw's anonymous user): only
+            # resources with a public-read/-write grant will authorize
+            return None
         if not auth.startswith("AWS "):
             raise S3Error("AccessDenied", "missing AWS authorization")
         try:
@@ -269,17 +362,64 @@ class RGWGateway:
         bucket, _, key = path.partition("/")
         return bucket, key, params
 
-    async def _check_owner(self, bucket: str, owner: str) -> None:
-        """Bucket-owner authorization (the rgw ACL subset: private
-        buckets, owner-full-control)."""
+    async def _bucket_owner(self, bucket: str) -> str:
         got = await self.index.omap_get(BUCKETS_OID, [bucket])
         if bucket not in got:
             raise S3Error("NoSuchBucket", bucket)
-        bucket_owner = got[bucket].decode().split("\x00", 1)[0]
-        if bucket_owner != owner:
+        return got[bucket].decode().split("\x00", 1)[0]
+
+    async def _check_owner(self, bucket: str, owner) -> None:
+        """Bucket-owner-only authorization (bucket delete, ACL writes)."""
+        if owner is None or await self._bucket_owner(bucket) != owner:
             raise S3Error(
                 "AccessDenied", f"bucket {bucket!r} is not yours"
             )
+
+    async def _check_access(self, bucket: str, owner, perm: str,
+                            key: str = None) -> None:
+        """ACL authorization (reference src/rgw/rgw_acl.h:1 +
+        rgw_op.cc verify_permission): the bucket owner has full
+        control; otherwise the object ACL (if any), then the bucket
+        ACL, must grant ``perm`` to ``owner`` (None = anonymous)."""
+        import json as _json
+
+        if owner is not None and await self._bucket_owner(bucket) == owner:
+            return
+        # keyed fetch: only the two relevant ACLs, never the whole store
+        want = ["@bucket"] + ([key] if key is not None else [])
+        acls = await self.index.omap_get(acl_oid(bucket), want)
+
+        def load(k):
+            raw = acls.get(k)
+            return _json.loads(raw) if raw else None
+
+        if key is not None and acl_allows(load(key), owner, perm):
+            return
+        if acl_allows(load("@bucket"), owner, perm):
+            return
+        raise S3Error(
+            "AccessDenied",
+            f"{owner or 'anonymous'} has no {perm} on "
+            f"{bucket + ('/' + key if key else '')!r}"
+        )
+
+    async def _store_acl(self, bucket: str, key: str,
+                         acl: Optional[dict]) -> None:
+        import json as _json
+
+        if acl is not None:
+            await self.index.omap_set(
+                acl_oid(bucket),
+                {key or "@bucket": _json.dumps(acl).encode()},
+            )
+
+    async def _load_acl(self, bucket: str, key: str):
+        import json as _json
+
+        got = await self.index.omap_get(
+            acl_oid(bucket), [key or "@bucket"])
+        raw = got.get(key or "@bucket")
+        return _json.loads(raw) if raw else None
 
     async def _handle(self, method, target, headers, body):
         # Swift routing needs more than the path prefix: an S3 bucket
@@ -295,23 +435,66 @@ class RGWGateway:
         owner = await self._auth(method, resource, headers,
                                  path=path, params=params, body=body)
         if not bucket:
-            if method == "GET":
+            if method == "GET" and owner is not None:
                 return await self._list_buckets(owner)
-            raise S3Error("InvalidRequest", f"{method} on service root")
+            raise S3Error("AccessDenied" if owner is None else
+                          "InvalidRequest", f"{method} on service root")
         if not key:
+            if method == "PUT" and "acl" in params:
+                # PUT /bucket?acl: replace the bucket ACL (owner only)
+                await self._check_owner(bucket, owner)
+                acl = acl_from_headers(headers, owner)
+                await self._store_acl(
+                    bucket, "",
+                    acl or {"owner": owner, "canned": "private",
+                            "grants": []})
+                return "200 OK", "application/xml", b"", {}
+            if method == "GET" and "acl" in params:
+                await self._check_access(bucket, owner, "FULL_CONTROL")
+                xml = acl_to_xml(await self._load_acl(bucket, ""),
+                                 await self._bucket_owner(bucket))
+                return "200 OK", "application/xml", xml.encode(), {}
             if method == "PUT":
-                return await self._create_bucket(bucket, owner)
-            await self._check_owner(bucket, owner)
+                if owner is None:
+                    raise S3Error("AccessDenied", "anonymous create")
+                out = await self._create_bucket(bucket, owner)
+                await self._store_acl(
+                    bucket, "", acl_from_headers(headers, owner))
+                return out
             if method == "DELETE":
+                await self._check_owner(bucket, owner)
                 return await self._delete_bucket(bucket)
             if method == "GET":
+                # listing needs a READ grant (canned public-read /
+                # authenticated-read / explicit x-amz-grant-read)
+                await self._check_access(bucket, owner, "READ")
                 if "uploads" in params:
                     return await self._list_uploads(bucket)
                 return await self._list_objects(
                     bucket, params.get("prefix", "")
                 )
             raise S3Error("InvalidRequest", f"{method} on bucket")
-        await self._check_owner(bucket, owner)
+        if "acl" in params:
+            # object ACL subresource: owner or FULL_CONTROL grantee
+            if owner is None or await self._bucket_owner(bucket) != owner:
+                await self._check_access(bucket, owner, "FULL_CONTROL", key)
+            if method == "PUT":
+                acl = acl_from_headers(headers, owner)
+                await self._store_acl(
+                    bucket, key,
+                    acl or {"owner": owner, "canned": "private",
+                            "grants": []})
+                return "200 OK", "application/xml", b"", {}
+            if method == "GET":
+                xml = acl_to_xml(await self._load_acl(bucket, key),
+                                 await self._bucket_owner(bucket))
+                return "200 OK", "application/xml", xml.encode(), {}
+            raise S3Error("InvalidRequest", f"{method} on ?acl")
+        if method in ("GET", "HEAD"):
+            await self._check_access(bucket, owner, "READ", key)
+        else:
+            # PUT/POST/DELETE on objects need a WRITE grant on the bucket
+            await self._check_access(bucket, owner, "WRITE")
         # multipart upload surface (rgw_multipart: initiate/part/
         # complete/abort)
         if method == "POST" and "uploads" in params:
@@ -331,7 +514,16 @@ class RGWGateway:
             return await self._abort_multipart(
                 bucket, key, params["uploadId"])
         if method == "PUT":
-            return await self._put_object(bucket, key, body)
+            out = await self._put_object(bucket, key, body)
+            acl = acl_from_headers(headers, owner)
+            if acl is not None:
+                await self._store_acl(bucket, key, acl)
+            else:
+                # S3 semantics: an overwrite without ACL headers resets
+                # the object to default-private -- the previous object's
+                # grants must not apply to the new content
+                await self.index.omap_rm(acl_oid(bucket), [key])
+            return out
         if method == "GET":
             return await self._get_object(bucket, key)
         if method == "HEAD":
@@ -380,10 +572,24 @@ class RGWGateway:
             raise S3Error("AccessDenied", "missing or expired auth token")
         owner = ent[0]
         parts = path.split("/", 4)  # ['', 'v1', 'AUTH_x', container, obj]
-        if len(parts) < 3 or parts[2] != f"AUTH_{owner}":
-            raise S3Error("AccessDenied", "token does not match account")
+        if len(parts) < 3 or not parts[2].startswith("AUTH_"):
+            raise S3Error("AccessDenied", "bad storage path")
         container = parts[3] if len(parts) > 3 else ""
         obj = parts[4] if len(parts) > 4 else ""
+        if parts[2] != f"AUTH_{owner}":
+            # another account's path: readable iff its container/object
+            # ACL grants READ (the X-Container-Read role,
+            # rgw_rest_swift.cc + rgw_acl_swift.cc)
+            if method not in ("GET", "HEAD") or not container:
+                raise S3Error("AccessDenied",
+                              "token does not match account")
+            await self._check_access(container, owner, "READ",
+                                     obj or None)
+            if not obj:
+                return await self._swift_list_container(container)
+            if method == "GET":
+                return await self._get_object(container, obj)
+            return await self._head_object(container, obj)
         if not container:
             if method == "GET":  # account listing: containers, plain text
                 buckets = await self.index.omap_get(BUCKETS_OID)
@@ -394,26 +600,43 @@ class RGWGateway:
                     ("\n".join(mine) + "\n" if mine else "").encode(), {}
             raise S3Error("InvalidRequest", f"{method} on account")
         if not obj:
-            if method == "PUT":
-                try:
-                    await self._create_bucket(container, owner)
-                except S3Error as e:
-                    if e.code != "BucketAlreadyExists":
-                        raise
-                    # idempotent ONLY for the owner: 201 on someone
-                    # else's container would be a silent false success
+            if method in ("PUT", "POST"):
+                if method == "PUT":
+                    try:
+                        await self._create_bucket(container, owner)
+                    except S3Error as e:
+                        if e.code != "BucketAlreadyExists":
+                            raise
+                        # idempotent ONLY for the owner: 201 on someone
+                        # else's container would be a silent false success
+                        await self._check_owner(container, owner)
+                else:
                     await self._check_owner(container, owner)
-                return "201 Created", "text/plain", b"", {}
+                # X-Container-Read (rgw_acl_swift.cc): ".r:*" = public
+                # read, otherwise a comma list of granted accounts
+                read_acl = headers.get("x-container-read", "")
+                if read_acl:
+                    grants = []
+                    for part in read_acl.split(","):
+                        part = part.strip()
+                        if part in (".r:*", ".rlistings"):
+                            grants.append(
+                                {"grantee": "*", "perm": "READ"})
+                        elif part:
+                            grants.append(
+                                {"grantee": part.split(":")[-1],
+                                 "perm": "READ"})
+                    await self._store_acl(container, "", {
+                        "owner": owner, "canned": "swift",
+                        "grants": grants})
+                return ("201 Created" if method == "PUT"
+                        else "204 No Content"), "text/plain", b"", {}
             await self._check_owner(container, owner)
             if method == "DELETE":
                 await self._delete_bucket(container)
                 return "204 No Content", "text/plain", b"", {}
             if method == "GET":  # object listing, plain text
-                index = await self.index.omap_get(
-                    bucket_index_oid(container))
-                names = sorted(index)
-                return "200 OK", "text/plain", \
-                    ("\n".join(names) + "\n" if names else "").encode(), {}
+                return await self._swift_list_container(container)
             raise S3Error("InvalidRequest", f"{method} on container")
         await self._check_owner(container, owner)
         if method == "PUT":
@@ -427,6 +650,14 @@ class RGWGateway:
         if method == "DELETE":
             return await self._delete_object(container, obj)
         raise S3Error("InvalidRequest", f"{method} on object")
+
+    async def _swift_list_container(self, container: str):
+        """Plain-text Swift object listing (shared by the own-account and
+        cross-account read paths so the format cannot diverge)."""
+        index = await self.index.omap_get(bucket_index_oid(container))
+        names = sorted(index)
+        return "200 OK", "text/plain", \
+            ("\n".join(names) + "\n" if names else "").encode(), {}
 
     # -- bucket ops (rgw_bucket.cc) ----------------------------------------
 
@@ -482,6 +713,9 @@ class RGWGateway:
             except (FileNotFoundError, IOError):
                 pass
         await self.index.omap_rm(BUCKETS_OID, [bucket])
+        # drop the ACL store with the bucket: a future same-name bucket
+        # must not inherit the previous tenant's grants
+        await self.index.omap_clear(acl_oid(bucket))
         return "204 No Content", "application/xml", b"", {}
 
     async def _list_objects(self, bucket: str, prefix: str):
@@ -630,6 +864,9 @@ class RGWGateway:
             key: f"{len(blob)}\x00{final_etag}\x00"
                  f"{int(time.time())}".encode(),
         })
+        # a completed upload REPLACES the object: default-private, the
+        # previous object's grants must not carry over
+        await self.index.omap_rm(acl_oid(bucket), [key])
         await self._drop_upload(bucket, key, upload_id, meta)
         xml = (
             '<?xml version="1.0" encoding="UTF-8"?>'
@@ -681,6 +918,7 @@ class RGWGateway:
     async def _delete_object(self, bucket: str, key: str):
         await self._index_entry(bucket, key)  # NoSuchKey check
         await self.index.omap_rm(bucket_index_oid(bucket), [key])
+        await self.index.omap_rm(acl_oid(bucket), [key])  # its object ACL
         try:
             await self.backend.remove_object(obj_oid(bucket, key))
         except IOError:

@@ -81,14 +81,14 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
                    "objectstore": objectstore, "auth": auth,
                    "n_mons": n_mons}, f)
     data_path = os.path.join(run_dir, "data")
-    deadline = time.time() + wait
     if n_mons:
+        mon_deadline = time.time() + wait
         mon_pids = {r: spawn_mon(run_dir, r, n_mons)
                     for r in range(n_mons)}
         with open(os.path.join(run_dir, "mon_pids"), "w") as f:
             json.dump({str(r): p for r, p in mon_pids.items()}, f)
         for r in range(n_mons):
-            _wait_port(addr_map[f"mon.{r}"], deadline, f"mon.{r}")
+            _wait_port(addr_map[f"mon.{r}"], mon_deadline, f"mon.{r}")
         # pools flow mon -> daemons: create them BEFORE the osds boot so
         # the subscription's first map already carries them
         import asyncio as _asyncio
@@ -102,7 +102,9 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
                             op_queue=op_queue, data_path=data_path,
                             auth=auth)
     _save_pids(run_dir, pids)
-    # readiness: every daemon's port accepts connections
+    # readiness: every daemon's port accepts connections.  Fresh budget:
+    # slow mon quorum formation above must not eat the OSDs' allowance.
+    deadline = time.time() + wait
     for i in range(n_osds):
         _wait_port(addr_map[f"osd.{i}"], deadline, f"osd.{i}")
     return map_path
