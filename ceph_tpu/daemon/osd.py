@@ -129,7 +129,12 @@ async def _mon_integrate(args, shard, messenger, addr_map,
 
         async def ping_one(j):
             try:
-                await messenger.send_message(name, f"osd.{j}", "ping")
+                # bound the send: a blackholed peer's TCP connect would
+                # otherwise stall the whole gathered round for the OS
+                # SYN timeout (review r5 finding)
+                await asyncio.wait_for(
+                    messenger.send_message(name, f"osd.{j}", "ping"),
+                    timeout=1.0)
             except (OSError, asyncio.TimeoutError):
                 pass  # dead peer: its pong stays stale, the grace fires
 

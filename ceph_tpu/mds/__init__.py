@@ -17,12 +17,15 @@ src/client (libcephfs, 24.1k LoC), reduced to the architecture:
   (src/osdc/Striper.cc, file_layout_t): data object "<ino>.<objno>",
   I/O through the same EC/replicated pool machinery as everything else.
 
-``MDS`` is the rank-0 daemon; ``CephFS`` is the libcephfs-role client
+``MDS`` is one rank; ``MultiMDS`` runs several active ranks with the
+namespace partitioned by subtree and an MDBalancer-style rebalancer
+(src/mds/MDBalancer.cc); ``CephFS`` is the libcephfs-role client
 (metadata calls to the MDS, data I/O straight to RADOS -- the
 reference's split between MDS requests and OSD file I/O).
 """
 
 from ceph_tpu.mds.mds import MDS
 from ceph_tpu.mds.cephfs import CephFS
+from ceph_tpu.mds.multimds import MultiMDS
 
-__all__ = ["MDS", "CephFS"]
+__all__ = ["MDS", "CephFS", "MultiMDS"]

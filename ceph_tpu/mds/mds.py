@@ -27,6 +27,8 @@ from typing import Dict, List, Optional, Tuple
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 ROOT_INO = 1
+#: rank-0 names kept for compatibility; instances use their
+#: own self.journal_oid / self.inotable_oid (per-rank MDLog)
 INOTABLE = "mds0_inotable"
 JOURNAL = "mds0_journal"
 COMMITTED_KEY = "_committed"
@@ -54,20 +56,31 @@ class FSError(OSError):
 
 
 class MDS:
-    """Rank-0 metadata server over a RADOS backend (an Objecter)."""
+    """One metadata-server rank over a RADOS backend (an Objecter).
 
-    def __init__(self, backend):
+    Rank 0 is the historical single-MDS shape; a multi-active cluster
+    (``ceph_tpu.mds.multimds.MultiMDS``) runs several ranks, each with
+    its own journal and ino table (``mds<rank>_journal`` /
+    ``mds<rank>_inotable`` -- the reference's per-rank MDLog and
+    InoTable, src/mds/MDLog.cc, src/mds/InoTable.cc), serialized
+    independently, with the namespace partitioned by subtree."""
+
+    def __init__(self, backend, rank: int = 0):
         self.backend = backend
+        self.rank = rank
+        self.journal_oid = f"mds{rank}_journal"
+        self.inotable_oid = f"mds{rank}_inotable"
         self._mutate_lock = asyncio.Lock()
         self._journal_seq = 0
         self.replayed = 0  # events replayed at the last start()
+        self.op_count = 0  # balancer load metric (MDBalancer mds_load)
 
     # -- boot / journal replay (up:replay -> up:active) --------------------
 
     async def start(self) -> None:
         """Create the root on a fresh filesystem; replay the journal
         tail left by a crashed predecessor; trim it."""
-        omap = await self.backend.omap_get(JOURNAL)
+        omap = await self.backend.omap_get(self.journal_oid)
         committed = int(
             _dec(omap[COMMITTED_KEY]) if COMMITTED_KEY in omap else 0
         )
@@ -97,10 +110,10 @@ class MDS:
 
     async def _alloc_ino(self) -> int:
         while True:
-            cur = await self.backend.omap_get(INOTABLE, ["next"])
+            cur = await self.backend.omap_get(self.inotable_oid, ["next"])
             have = int(_dec(cur["next"])) if "next" in cur else ROOT_INO + 1
             ok, _ = await self.backend.omap_cas(
-                INOTABLE, "next",
+                self.inotable_oid, "next",
                 cur.get("next"), _enc(have + 1),
             )
             if ok:
@@ -113,7 +126,8 @@ class MDS:
         directory objects change; apply is idempotent for replay."""
         self._journal_seq += 1
         seq = self._journal_seq
-        await self.backend.omap_set(JOURNAL, {f"{seq:016d}": _enc(ev)})
+        await self.backend.omap_set(self.journal_oid,
+                                    {f"{seq:016d}": _enc(ev)})
         await self._apply(ev)
         await self._trim(seq, keys=[f"{seq:016d}"])
 
@@ -122,12 +136,13 @@ class MDS:
         trim/expire).  The hot path passes the exact keys it just
         journaled; replay passes None and pays one full scan."""
         if keys is None:
-            omap = await self.backend.omap_get(JOURNAL)
+            omap = await self.backend.omap_get(self.journal_oid)
             keys = [k for k in omap
                     if k != COMMITTED_KEY and int(k) <= upto]
-        await self.backend.omap_set(JOURNAL, {COMMITTED_KEY: _enc(upto)})
+        await self.backend.omap_set(self.journal_oid,
+                                    {COMMITTED_KEY: _enc(upto)})
         if keys:
-            await self.backend.omap_rm(JOURNAL, keys)
+            await self.backend.omap_rm(self.journal_oid, keys)
 
     async def _apply(self, ev: dict) -> None:
         op = ev["op"]

@@ -17,7 +17,8 @@ from ceph_tpu.mon.osdmap import OSDMap
 from ceph_tpu.mon.paxos import Paxos
 import copy
 
-from ceph_tpu.mon.services import ClusterLog, ConfigKeyStore, ConfigStore
+from ceph_tpu.mon.services import (AuthDB, ClusterLog, ConfigKeyStore,
+                                   ConfigStore, FSMap, MgrMap)
 from ceph_tpu.osd.messenger import Messenger
 from ceph_tpu.utils.log import dout
 
@@ -36,6 +37,12 @@ class Monitor:
         self.kvstore = ConfigKeyStore()
         self.configdb = ConfigStore()
         self.clog = ClusterLog()
+        self.authdb = AuthDB()
+        self.mgrmap = MgrMap()
+        self.fsmap = FSMap()
+        #: leader-local beacon liveness (the reference keeps pending
+        #: beacon state outside paxos too): daemon name -> last stamp
+        self._beacons: Dict[str, float] = {}
         self._store_db = None
         if store_path is not None:
             # MonitorDBStore role: paxos state on an LSM KeyValueDB; a
@@ -241,6 +248,15 @@ class Monitor:
         if op == "clog_append":
             self.clog.apply(inc)
             return "clog"
+        if op.startswith("auth_"):
+            self.authdb.apply(inc)
+            return "auth"
+        if op.startswith("mgr_"):
+            self.mgrmap.apply(inc)
+            return "mgrmap"
+        if op.startswith(("fs_", "mds_")):
+            self.fsmap.apply(inc)
+            return "fsmap"
         self.osdmap.apply(inc)
         return "osdmap"
 
@@ -262,6 +278,14 @@ class Monitor:
             # mid-commit during elections
             self._push_to_subscribers(
                 {"type": "osdmap", "map": self.osdmap.to_dict()}
+            )
+        elif kind == "mgrmap":
+            self._push_to_subscribers(
+                {"type": "mgrmap", "map": self.mgrmap.to_dict()}
+            )
+        elif kind == "fsmap":
+            self._push_to_subscribers(
+                {"type": "fsmap", "map": self.fsmap.to_dict()}
             )
 
     def _push_to_subscribers(self, msg: dict) -> None:
@@ -519,6 +543,133 @@ class Monitor:
             if level is not None and level not in ClusterLog.LEVELS:
                 return -22, f"bad level {level!r}"
             return 0, self.clog.last(cmd.get("num", 20), level)
+        # -- AuthMonitor (src/mon/AuthMonitor.cc subset) -------------------
+        if prefix == "auth get-or-create":
+            ent = cmd["entity"]
+            have = self.authdb.entities.get(ent)
+            if have is not None:
+                return 0, {"entity": ent, "key": have["key"],
+                           "caps": dict(have["caps"])}
+            import secrets as _secrets
+
+            key = _secrets.token_hex(16)
+            ok = await self._propose({
+                "op": "auth_add", "entity": ent, "key": key,
+                "caps": cmd.get("caps") or {},
+            })
+            return (0, {"entity": ent, "key": key,
+                        "caps": dict(cmd.get("caps") or {})}) if ok \
+                else (-11, "no quorum")
+        if prefix == "auth get":
+            have = self.authdb.entities.get(cmd["entity"])
+            if have is None:
+                return -2, "not found"
+            return 0, {"entity": cmd["entity"], "key": have["key"],
+                       "caps": dict(have["caps"])}
+        if prefix == "auth caps":
+            if cmd["entity"] not in self.authdb.entities:
+                return -2, "not found"
+            ok = await self._propose({
+                "op": "auth_caps", "entity": cmd["entity"],
+                "caps": cmd.get("caps") or {},
+            })
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "auth rotate":
+            # key rotation (the reference's rotating secrets role): a
+            # fresh secret replaces the old; clients re-key on their
+            # next handshake
+            if cmd["entity"] not in self.authdb.entities:
+                return -2, "not found"
+            import secrets as _secrets
+
+            key = _secrets.token_hex(16)
+            ok = await self._propose({
+                "op": "auth_rotate", "entity": cmd["entity"], "key": key})
+            return (0, {"key": key}) if ok else (-11, "no quorum")
+        if prefix == "auth rm":
+            ok = await self._propose(
+                {"op": "auth_rm", "entity": cmd["entity"]})
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "auth list":
+            return 0, {
+                e: {"caps": dict(v["caps"])}  # keys never leave via list
+                for e, v in sorted(self.authdb.entities.items())
+            }
+        # -- MgrMonitor (src/mon/MgrMonitor.cc subset) ---------------------
+        if prefix == "mgr beacon":
+            name = cmd["name"]
+            now = asyncio.get_event_loop().time()
+            self._beacons[f"mgr.{name}"] = now
+            known = (name == self.mgrmap.active
+                     or name in self.mgrmap.standbys)
+            if not known:
+                ok = await self._propose({"op": "mgr_register",
+                                          "name": name})
+                if not ok:
+                    return -11, "no quorum"
+            # a standby's beacon checks the active's liveness (lazy
+            # failover; the reference's beacon grace)
+            active = self.mgrmap.active
+            if active is not None and active != name:
+                last = self._beacons.get(f"mgr.{active}")
+                from ceph_tpu.utils.config import get_config as _gc
+
+                grace = float(_gc().get_val("mon_mgr_beacon_grace"))
+                if last is not None and now - last > grace:
+                    await self._propose({"op": "mgr_failover",
+                                         "failed": active})
+            return 0, self.mgrmap.to_dict()
+        if prefix == "mgr fail":
+            who = cmd.get("who", self.mgrmap.active)
+            if who is None:
+                return -2, "no active mgr"
+            ok = await self._propose({"op": "mgr_failover", "failed": who})
+            return (0, self.mgrmap.to_dict()) if ok else (-11, "no quorum")
+        if prefix == "mgr stat":
+            return 0, self.mgrmap.to_dict()
+        # -- MDSMonitor (src/mon/MDSMonitor.cc subset) ---------------------
+        if prefix == "fs new":
+            if cmd["name"] in self.fsmap.filesystems:
+                return -17, "fs exists"
+            ok = await self._propose({
+                "op": "fs_new", "name": cmd["name"],
+                "max_mds": cmd.get("max_mds", 1),
+            })
+            return (0, self.fsmap.to_dict()) if ok else (-11, "no quorum")
+        if prefix == "fs rm":
+            if cmd["name"] not in self.fsmap.filesystems:
+                return -2, "no such fs"
+            ok = await self._propose({"op": "fs_rm", "name": cmd["name"]})
+            return (0, "") if ok else (-11, "no quorum")
+        if prefix == "fs set max_mds":
+            if cmd["name"] not in self.fsmap.filesystems:
+                return -2, "no such fs"
+            ok = await self._propose({
+                "op": "fs_set_max_mds", "name": cmd["name"],
+                "max_mds": int(cmd["max_mds"]),
+            })
+            return (0, self.fsmap.to_dict()) if ok else (-11, "no quorum")
+        if prefix == "fs ls":
+            return 0, sorted(self.fsmap.filesystems)
+        if prefix == "mds beacon":
+            name = cmd["name"]
+            self._beacons[f"mds.{name}"] = asyncio.get_event_loop().time()
+            known = (name in self.fsmap.standbys or any(
+                name in fs["ranks"].values()
+                for fs in self.fsmap.filesystems.values()
+            ))
+            if not known:
+                ok = await self._propose({"op": "mds_register",
+                                          "name": name})
+                if not ok:
+                    return -11, "no quorum"
+            return 0, self.fsmap.to_dict()
+        if prefix == "mds fail":
+            ok = await self._propose({"op": "mds_failover",
+                                      "name": cmd["name"]})
+            return (0, self.fsmap.to_dict()) if ok else (-11, "no quorum")
+        if prefix == "fs dump":
+            return 0, self.fsmap.to_dict()
         if prefix in ("osd out", "osd in", "osd down", "osd up"):
             inc = {"op": f"osd_{prefix.split()[1]}", "osd": cmd["osd"]}
             if prefix == "osd in" and "weight" in cmd:

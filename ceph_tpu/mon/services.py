@@ -140,3 +140,147 @@ class LogClient:
 
     async def error(self, message: str):
         return await self._log("error", message)
+
+
+class AuthDB:
+    """Replicated entity/key/caps store (src/mon/AuthMonitor.cc role).
+
+    Entities (``client.admin``, ``osd.3``, ...) each hold a secret and a
+    caps map; ``auth get-or-create`` mints a key exactly once, ``auth
+    rotate`` replaces it (the reference's rotating service keys reduced
+    to explicit per-entity rotation -- ticket renewal then picks up the
+    new secret on the next handshake)."""
+
+    def __init__(self):
+        self.entities: Dict[str, dict] = {}
+        self.version = 0
+
+    def apply(self, inc: dict) -> None:
+        self.version += 1
+        op = inc["op"]
+        if op == "auth_add":
+            self.entities[inc["entity"]] = {
+                "key": inc["key"], "caps": dict(inc.get("caps") or {}),
+            }
+        elif op == "auth_caps":
+            ent = self.entities.get(inc["entity"])
+            if ent is not None:
+                ent["caps"] = dict(inc.get("caps") or {})
+        elif op == "auth_rotate":
+            ent = self.entities.get(inc["entity"])
+            if ent is not None:
+                ent["key"] = inc["key"]
+        elif op == "auth_rm":
+            self.entities.pop(inc["entity"], None)
+
+
+class MgrMap:
+    """Active/standby manager map (src/mon/MgrMonitor.cc role).
+
+    Daemons send ``mgr beacon``; the first becomes active, later ones
+    queue as standbys; ``mgr fail`` (or a beacon arriving while the
+    active's beacons are stale past the grace) promotes a standby."""
+
+    def __init__(self):
+        self.epoch = 0
+        self.active: Optional[str] = None
+        self.standbys: List[str] = []
+
+    def apply(self, inc: dict) -> None:
+        self.epoch += 1
+        op = inc["op"]
+        if op == "mgr_register":
+            name = inc["name"]
+            if self.active is None:
+                self.active = name
+            elif name != self.active and name not in self.standbys:
+                self.standbys.append(name)
+        elif op == "mgr_failover":
+            failed = inc.get("failed")
+            if failed == self.active:
+                self.active = self.standbys.pop(0) if self.standbys \
+                    else None
+            elif failed in self.standbys:
+                self.standbys.remove(failed)
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch, "active": self.active,
+                "standbys": list(self.standbys)}
+
+
+class FSMap:
+    """Filesystem / MDS rank map (src/mon/MDSMonitor.cc FSMap role).
+
+    ``fs new`` creates a filesystem with ``max_mds`` ranks; ``mds
+    beacon`` registers daemons (filling vacant ranks first, then the
+    standby pool); ``mds_failover`` vacates a rank and promotes a
+    standby -- the standby-takeover flow the MDS cluster tests drive."""
+
+    def __init__(self):
+        self.epoch = 0
+        self.filesystems: Dict[str, dict] = {}
+        self.standbys: List[str] = []
+
+    def apply(self, inc: dict) -> None:
+        self.epoch += 1
+        op = inc["op"]
+        if op == "fs_new":
+            self.filesystems[inc["name"]] = {
+                "name": inc["name"],
+                "max_mds": int(inc.get("max_mds", 1)),
+                "ranks": {},
+            }
+            self._fill_ranks()
+        elif op == "fs_rm":
+            fs = self.filesystems.pop(inc["name"], None)
+            if fs:
+                for mds in fs["ranks"].values():
+                    if mds not in self.standbys:
+                        self.standbys.append(mds)
+        elif op == "fs_set_max_mds":
+            fs = self.filesystems.get(inc["name"])
+            if fs:
+                fs["max_mds"] = int(inc["max_mds"])
+                if fs["max_mds"] < len(fs["ranks"]):
+                    # shrink: highest ranks stop and return to standby
+                    for r in sorted(fs["ranks"], reverse=True):
+                        if len(fs["ranks"]) <= fs["max_mds"]:
+                            break
+                        self.standbys.append(fs["ranks"].pop(r))
+                self._fill_ranks()
+        elif op == "mds_register":
+            name = inc["name"]
+            if name not in self.standbys and not any(
+                name in fs["ranks"].values()
+                for fs in self.filesystems.values()
+            ):
+                self.standbys.append(name)
+            self._fill_ranks()
+        elif op == "mds_failover":
+            failed = inc["name"]
+            if failed in self.standbys:
+                self.standbys.remove(failed)
+            for fs in self.filesystems.values():
+                for r, mds in list(fs["ranks"].items()):
+                    if mds == failed:
+                        del fs["ranks"][r]
+            self._fill_ranks()
+
+    def _fill_ranks(self) -> None:
+        """Vacant ranks claim standbys (rank order, fs name order)."""
+        for fs in sorted(self.filesystems.values(),
+                         key=lambda f: f["name"]):
+            for r in range(fs["max_mds"]):
+                if r not in fs["ranks"] and self.standbys:
+                    fs["ranks"][r] = self.standbys.pop(0)
+
+    def to_dict(self) -> dict:
+        return {
+            "epoch": self.epoch,
+            "filesystems": {
+                n: {"name": f["name"], "max_mds": f["max_mds"],
+                    "ranks": {str(r): m for r, m in f["ranks"].items()}}
+                for n, f in self.filesystems.items()
+            },
+            "standbys": list(self.standbys),
+        }

@@ -52,6 +52,12 @@ struct ec_plugin *ec_registry_get(const char *name);
 // (-EXDEV version mismatch, -ENOENT missing entry point/file,
 //  -EBADF loaded but did not register)
 int ec_registry_load(const char *name, const char *dir);
+
+// watchdog load: -ETIMEDOUT when the plugin hangs in dlopen/init
+// (the ErasureCodePluginHangs failure mode; the stuck worker thread is
+// detached -- it cannot be cancelled safely)
+int ec_registry_load_timeout(const char *name, const char *dir,
+                             int timeout_ms);
 struct ec_codec *ec_registry_factory(const char *name, const char *dir,
                                      const char *const *profile);
 const char *ec_registry_last_error(void);

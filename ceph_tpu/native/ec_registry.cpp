@@ -9,11 +9,15 @@
 
 #include <dlfcn.h>
 #include <cerrno>
+#include <chrono>
+#include <condition_variable>
 #include <cstdio>
 #include <cstring>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <string>
+#include <thread>
 
 namespace {
 
@@ -97,6 +101,42 @@ int ec_registry_load(const char *name, const char *dir) {
   // handle intentionally kept open (disable_dlclose semantics: plugins
   // stay mapped for the process lifetime, reference ErasureCodePlugin.h:49)
   return 0;
+}
+
+int ec_registry_load_timeout(const char *name, const char *dir,
+                             int timeout_ms) {
+  // The reference's "plugin hangs in dlopen" failure mode
+  // (src/test/erasure-code/ErasureCodePluginHangs.cc): a load that
+  // never returns must not wedge the daemon.  Run the load on a worker
+  // thread and give up at the deadline; the worker stays detached (a
+  // thread stuck inside dlopen/init cannot be cancelled safely), the
+  // caller treats the plugin as failed and carries on.
+  struct State {
+    std::mutex m;
+    std::condition_variable cv;
+    bool done = false;
+    int rc = 0;
+    std::string error;  // g_last_error is thread_local: the worker's
+                        // message must travel back explicitly
+  };
+  auto st = std::make_shared<State>();
+  std::string n = name, d = dir;
+  std::thread([st, n, d]() {
+    int r = ec_registry_load(n.c_str(), d.c_str());
+    std::lock_guard<std::mutex> l(st->m);
+    st->rc = r;
+    st->error = g_last_error;
+    st->done = true;
+    st->cv.notify_all();
+  }).detach();
+  std::unique_lock<std::mutex> l(st->m);
+  if (!st->cv.wait_for(l, std::chrono::milliseconds(timeout_ms),
+                       [&] { return st->done; })) {
+    set_error(std::string(name) + " load timed out (hung in dlopen/init)");
+    return -ETIMEDOUT;
+  }
+  if (st->rc < 0) set_error(st->error);
+  return st->rc;
 }
 
 struct ec_codec *ec_registry_factory(const char *name, const char *dir,

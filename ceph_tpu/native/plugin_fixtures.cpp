@@ -23,4 +23,15 @@ extern "C" int __erasure_code_init(const char *, const char *) { return -3; }
 #elif defined(FIXTURE_FAIL_TO_REGISTER)
 extern "C" const char *__erasure_code_version() { return CEPH_TPU_EC_VERSION; }
 extern "C" int __erasure_code_init(const char *, const char *) { return 0; }
+
+#elif defined(FIXTURE_HANGS)
+// hangs inside the load path forever (the ErasureCodePluginHangs role:
+// the reference's fixture sleeps in dlopen; hanging in init exercises
+// the same watchdog contract)
+#include <unistd.h>
+extern "C" const char *__erasure_code_version() { return CEPH_TPU_EC_VERSION; }
+extern "C" int __erasure_code_init(const char *, const char *) {
+  for (;;) sleep(3600);
+  return 0;
+}
 #endif

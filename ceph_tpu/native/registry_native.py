@@ -57,6 +57,9 @@ def _load() -> ctypes.CDLL:
     lib = ctypes.CDLL(_SO, mode=ctypes.RTLD_GLOBAL)
     lib.ec_registry_load.argtypes = [ctypes.c_char_p, ctypes.c_char_p]
     lib.ec_registry_load.restype = ctypes.c_int
+    lib.ec_registry_load_timeout.argtypes = [
+        ctypes.c_char_p, ctypes.c_char_p, ctypes.c_int]
+    lib.ec_registry_load_timeout.restype = ctypes.c_int
     lib.ec_registry_factory.argtypes = [
         ctypes.c_char_p,
         ctypes.c_char_p,
@@ -80,6 +83,14 @@ def lib() -> ctypes.CDLL:
 def load(name: str, directory: str = _DIR) -> int:
     """Returns 0 or -errno (mirrors ErasureCodePluginRegistry::load)."""
     return lib().ec_registry_load(name.encode(), directory.encode())
+
+
+def load_with_timeout(name: str, timeout_ms: int = 5000,
+                      directory: str = _DIR) -> int:
+    """Watchdog load: -ETIMEDOUT when the plugin hangs in dlopen/init
+    (the ErasureCodePluginHangs failure mode)."""
+    return lib().ec_registry_load_timeout(
+        name.encode(), directory.encode(), timeout_ms)
 
 
 def last_error() -> str:

@@ -19,16 +19,23 @@ Reference: src/librbd (58.7k LoC) reduced to the core image model:
 * rbd-mirror: journal replay into a peer cluster with a registered
   journal client pinning trim (src/tools/rbd_mirror).
 
-Reductions vs the reference (documented, not hidden): no object-map
-feature, no promotion/demotion tags in mirroring (source is always
-primary).
+Round 5 adds the object-map + fast-diff features (src/librbd/
+ObjectMap.cc): per-object state maps maintained by the write path,
+frozen per snapshot, powering stat-free existence checks and
+map-only diffs.
 """
 
 from ceph_tpu.rbd.image import RBD, Image
 from ceph_tpu.rbd.journal import FEATURE_JOURNALING, ImageJournal
 from ceph_tpu.rbd.mirror import (ImageReplayer, MirrorDaemon,
-                                 mirror_disable, mirror_enable, mirror_list)
+                                 mirror_demote, mirror_disable,
+                                 mirror_enable, mirror_is_primary,
+                                 mirror_list, mirror_promote)
+from ceph_tpu.rbd.objectmap import (FEATURE_FAST_DIFF, FEATURE_OBJECT_MAP,
+                                    ObjectMap)
 
-__all__ = ["RBD", "Image", "FEATURE_JOURNALING", "ImageJournal",
-           "ImageReplayer", "MirrorDaemon", "mirror_disable",
-           "mirror_enable", "mirror_list"]
+__all__ = ["RBD", "Image", "FEATURE_JOURNALING", "FEATURE_OBJECT_MAP",
+           "FEATURE_FAST_DIFF", "ImageJournal", "ImageReplayer",
+           "MirrorDaemon", "ObjectMap", "mirror_demote",
+           "mirror_disable", "mirror_enable", "mirror_is_primary",
+           "mirror_list", "mirror_promote"]

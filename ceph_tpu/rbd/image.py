@@ -15,6 +15,9 @@ from __future__ import annotations
 from typing import Dict, List, Optional
 
 from ceph_tpu.osdc.striper import FileLayout, Striper
+from ceph_tpu.rbd.objectmap import (FEATURE_FAST_DIFF, FEATURE_OBJECT_MAP,
+                                    OBJECT_EXISTS, ObjectMap, fast_diff,
+                                    map_oid, rebuild)
 from ceph_tpu.rbd.journal import (FEATURE_JOURNALING, MIRROR_DIR_OID,
                                   ImageJournal, apply_event,
                                   destroy_journal)
@@ -165,6 +168,16 @@ class Image:
         self.striper = Striper(FileLayout(
             object_size=1 << order, stripe_unit=1 << order, stripe_count=1,
         ))
+        #: head object map when FEATURE_OBJECT_MAP is on (reference
+        #: src/librbd/ObjectMap.cc); attached by open()/refresh()
+        self._omap: Optional[ObjectMap] = None
+        #: mirror write-role: False = this copy is demoted (non-primary)
+        #: and client mutation refuses (the reference's journal-tag
+        #: ownership check at exclusive-lock acquisition,
+        #: src/librbd/Journal.cc is_tag_owner); None/True = writable.
+        #: A replayer sets _mirror_bypass to apply peer events.
+        self._primary: Optional[bool] = None
+        self._mirror_bypass = False
 
     @classmethod
     async def open(cls, backend, name: str,
@@ -178,11 +191,37 @@ class Image:
                   snap_seq=md.get("snap_seq", 0),
                   parent=md.get("parent"), read_snap=snap,
                   features=md.get("features", []))
+        if FEATURE_OBJECT_MAP in img.features and snap is None:
+            img._omap = ObjectMap(backend, name)
+            await img._omap.load(img.striper.object_count(img.size))
+        if snap is None:
+            # the mirror write-role applies to journaled AND bootstrapped
+            # (journal-less destination) copies alike
+            await img._load_primary()
         if FEATURE_JOURNALING in img.features and snap is None:
             img._journal = ImageJournal(backend, name)
             await img._journal.open()
             await img._crash_replay()
         return img
+
+    async def _load_primary(self) -> None:
+        """Learn the mirror write-role from the pool's mirroring
+        directory (inline rather than via ceph_tpu.rbd.mirror to avoid
+        the import cycle; the value format is mirror.py's)."""
+        try:
+            got = await self.backend.omap_get(
+                MIRROR_DIR_OID, [f"image_{self.name}"])
+        except FileNotFoundError:
+            got = {}
+        val = got.get(f"image_{self.name}")
+        self._primary = val is None or b"non-primary" not in val
+
+    def _check_writable(self) -> None:
+        if (self._primary is False and not self._replay_mode
+                and not self._mirror_bypass):
+            raise PermissionError(
+                f"image {self.name} is non-primary (demoted); promote "
+                "it or write on the primary peer")
 
     async def _crash_replay(self) -> None:
         """Re-apply journal events past the commit position (a writer
@@ -215,6 +254,11 @@ class Image:
     async def update_features(self, enable: Optional[List[str]] = None,
                               disable: Optional[List[str]] = None) -> None:
         """Dynamic feature toggle (librbd::Image::update_features)."""
+        # fast-diff rides the object map (reference feature dependency,
+        # src/librbd/Operations.cc update_features checks)
+        after = (set(self.features) | set(enable or [])) - set(disable or [])
+        if FEATURE_FAST_DIFF in after and FEATURE_OBJECT_MAP not in after:
+            raise ValueError("fast-diff requires object-map")
         dropping_journal = (FEATURE_JOURNALING in (disable or [])
                             and FEATURE_JOURNALING in self.features)
         if dropping_journal:
@@ -245,7 +289,22 @@ class Image:
         if dropping_journal:
             await destroy_journal(self.backend, self.name)
             self._journal = None
+        if FEATURE_OBJECT_MAP in (disable or []):
+            # drop the head map and every snapshot's frozen map
+            await ObjectMap(self.backend, self.name).remove()
+            for ent in self.snaps.values():
+                await ObjectMap(self.backend, self.name,
+                                ent["id"]).remove()
+            self._omap = None
         await self.refresh()  # attaches/detaches the journal as needed
+        if FEATURE_OBJECT_MAP in (enable or []) and self._omap is not None:
+            # a just-enabled map knows nothing about existing objects:
+            # build it from the store (RebuildRequest role)
+            self._omap = await rebuild(
+                self.backend, self.name,
+                self.striper.object_count(self.size),
+                lambda o: _data_oid(self.name, o),
+            )
 
     async def refresh(self) -> None:
         md = _dec((await self.backend.exec(
@@ -267,6 +326,15 @@ class Image:
             await self._journal.open()
         elif not journaled and self._journal is not None:
             self._journal = None
+        if self.read_snap_id is None:
+            await self._load_primary()  # promote/demote by another handle
+        mapped = (FEATURE_OBJECT_MAP in self.features
+                  and self.read_snap_id is None)
+        if mapped and self._omap is None:
+            self._omap = ObjectMap(self.backend, self.name)
+            await self._omap.load(self.striper.object_count(self.size))
+        elif not mapped and self._omap is not None:
+            self._omap = None
 
     # -- snap context (the librados self-managed SnapContext) --------------
 
@@ -279,8 +347,18 @@ class Image:
     # -- layering helpers (librbd io layer) --------------------------------
 
     async def _object_absent(self, oid: str) -> bool:
+        if self._omap is not None:
+            # object map answers without a stat round trip (the whole
+            # point of the feature, reference ObjectMap::object_may_exist)
+            object_no = int(oid.rsplit(".", 1)[1], 16)
+            return not self._omap.exists(object_no)
         size, hinfo = await self.backend.stat(oid)
         return size == 0 and hinfo is None
+
+    async def _omap_mark(self, object_no: int) -> None:
+        """Pre-write map update (ObjectMap::aio_update EXISTS)."""
+        if self._omap is not None:
+            await self._omap.update(object_no, OBJECT_EXISTS)
 
     async def _object_absent_at(self, oid: str,
                                 snap: Optional[int]) -> bool:
@@ -329,6 +407,7 @@ class Image:
         if span <= 0:
             return
         block = await self._read_parent(base, span)
+        await self._omap_mark(object_no)
         await self.backend.write_range(
             _data_oid(self.name, object_no), 0, block,
             snapc=self._snapc(),
@@ -339,6 +418,7 @@ class Image:
     async def write(self, offset: int, data: bytes) -> None:
         if self.read_snap_id is not None:
             raise IOError("image opened read-only at a snapshot")
+        self._check_writable()
         if offset + len(data) > self.size:
             raise IOError("write past end of image")
         if self._journal is not None and not self._replay_mode:
@@ -363,6 +443,7 @@ class Image:
                 and await self._object_absent(oid)
             ):
                 await self._copy_up(object_no)
+            await self._omap_mark(object_no)  # pre-write map update
             await self.backend.write_range(
                 oid, obj_off, data[pos : pos + length],
                 snapc=self._snapc(),
@@ -402,6 +483,7 @@ class Image:
         only when the object has no snap/parent dependency."""
         if self.read_snap_id is not None:
             raise IOError("image opened read-only at a snapshot")
+        self._check_writable()
         length = max(0, min(length, self.size - offset))
         if length == 0:
             return
@@ -431,6 +513,7 @@ class Image:
         self.parent = None
 
     async def resize(self, new_size: int) -> None:
+        self._check_writable()
         if await self._journaled({"op": "resize", "size": new_size}):
             return
         old_size = self.size
@@ -480,6 +563,10 @@ class Image:
                         oid, boundary, b"\0" * (obj_size - boundary),
                         snapc=self._snapc(),
                     )
+        if self._omap is not None:
+            # truncate/extend the map with the image (shrink drops the
+            # trimmed objects' entries; grow pads NONEXISTENT)
+            await self._omap.resize(self.striper.object_count(new_size))
         # header watchers (other clients with the image open) refresh
         await self.backend.notify(
             _header_oid(self.name), {"event": "resize", "size": new_size},
@@ -489,6 +576,7 @@ class Image:
     # -- snapshots (REAL data snapshots via the RADOS snap layer) ----------
 
     async def snap_create(self, snap: str) -> int:
+        self._check_writable()
         if self._journal is not None and not self._replay_mode:
             # validate BEFORE journaling: apply_event tolerates -EEXIST
             # for crash-replay idempotency, so the live path must raise
@@ -502,6 +590,10 @@ class Image:
             _header_oid(self.name), "rbd", "snap_add", _enc({"name": snap}))
         if ret != 0:
             raise IOError(f"snap_create rc={ret}")
+        if self._omap is not None:
+            # freeze the snapshot's map, sweep the head dirty->clean
+            # (fast-diff interval bookkeeping; ObjectMap snap create)
+            await self._omap.snapshot_to(_dec(out))
         await self.refresh()
         return _dec(out)
 
@@ -534,6 +626,9 @@ class Image:
                 )
             except IOError:
                 pass
+        if self._omap is not None and ent is not None:
+            # drop the snapshot's frozen map with the snapshot
+            await ObjectMap(self.backend, self.name, ent["id"]).remove()
 
     async def snap_rollback(self, snap: str) -> None:
         """Restore the image data+size to the snapshot
@@ -560,6 +655,14 @@ class Image:
             _enc({"size": ent["size"]}),
         )
         self.size = ent["size"]
+        if self._omap is not None:
+            # object existence changed wholesale: rebuild from the store
+            # (the reference invalidates + rebuilds the map on rollback)
+            self._omap = await rebuild(
+                self.backend, self.name,
+                self.striper.object_count(self.size),
+                lambda o: _data_oid(self.name, o),
+            )
 
     async def snap_protect(self, snap: str) -> None:
         if self._journal is not None and not self._replay_mode:
@@ -602,6 +705,37 @@ class Image:
 
     def snap_list(self) -> List[str]:
         return sorted(self.snaps)
+
+    # -- object map / fast-diff public surface -----------------------------
+
+    async def diff(self, from_snap: Optional[str] = None):
+        """Changed extents since ``from_snap`` (None = since creation)
+        computed from the OBJECT MAPS ALONE -- no per-object stats or
+        data reads (librbd diff_iterate whole_object fast-diff path).
+        Returns [(offset, length, exists), ...]."""
+        if self._omap is None:
+            raise ValueError("fast-diff needs the object-map feature")
+        return await fast_diff(
+            self.backend, self.name, self.snaps, self._omap,
+            1 << self.order, self.size, from_snap=from_snap,
+        )
+
+    async def object_map_rebuild(self) -> None:
+        """Re-derive the head map from the store (rbd object-map rebuild
+        CLI role: repair after out-of-band writes or invalidation)."""
+        if self._omap is None:
+            raise ValueError("object-map feature is off")
+        self._omap = await rebuild(
+            self.backend, self.name,
+            self.striper.object_count(self.size),
+            lambda o: _data_oid(self.name, o),
+        )
+
+    def object_map_states(self) -> bytes:
+        """Raw head-map states (introspection/test hook)."""
+        if self._omap is None:
+            raise ValueError("object-map feature is off")
+        return bytes(self._omap.states)
 
     # -- exclusive lock (cls_lock-backed, ExclusiveLock role) --------------
 

@@ -29,13 +29,62 @@ from ceph_tpu.rbd.journal import (FEATURE_JOURNALING, MIRROR_DIR_OID,
 # -- pool-level mirroring directory (cls_rbd mirror_image_* analogue) -------
 
 
-async def mirror_enable(backend, image: str) -> None:
+async def mirror_enable(backend, image: str, primary: bool = True) -> None:
     """Mark an image for mirroring.  Requires the journaling feature
-    (the reference refuses too: no journal, nothing to replay)."""
+    (the reference refuses too: no journal, nothing to replay).  The
+    enabling side starts PRIMARY (it owns the write role -- the
+    reference's journal tag holds the owning mirror_uuid,
+    src/librbd/Journal.cc allocate_tag); a replayer's destination copy
+    is enabled non-primary."""
     img = await Image.open(backend, image)
     if FEATURE_JOURNALING not in img.features:
         raise IOError(f"image {image} does not have journaling enabled")
-    await backend.omap_set(MIRROR_DIR_OID, {f"image_{image}": b"enabled"})
+    state = b"enabled:primary" if primary else b"enabled:non-primary"
+    await backend.omap_set(MIRROR_DIR_OID, {f"image_{image}": state})
+
+
+async def mirror_is_primary(backend, image: str) -> bool:
+    """Does the local copy own the write role?  Unmirrored images are
+    always writable (the gate only exists for mirrored pairs)."""
+    try:
+        got = await backend.omap_get(MIRROR_DIR_OID, [f"image_{image}"])
+    except FileNotFoundError:
+        return True
+    val = got.get(f"image_{image}")
+    return val is None or b"non-primary" not in val
+
+
+async def mirror_promote(backend, image: str, force: bool = False) -> None:
+    """Take the write role for the local copy (`rbd mirror image
+    promote`, reference src/tools/rbd_mirror + librbd Journal tag
+    ownership): the normal failover is demote-old-primary first; with
+    the old primary unreachable ``force=True`` promotes anyway
+    (split-brain is then the operator's to resolve, as in the
+    reference)."""
+    key = f"image_{image}"
+    try:
+        got = await backend.omap_get(MIRROR_DIR_OID, [key])
+    except FileNotFoundError:
+        got = {}
+    if key not in got:
+        raise IOError(f"image {image} is not mirror-enabled")
+    if b"non-primary" not in got[key] and not force:
+        raise IOError(f"image {image} is already primary")
+    await backend.omap_set(MIRROR_DIR_OID, {key: b"enabled:primary"})
+
+
+async def mirror_demote(backend, image: str) -> None:
+    """Release the write role (`rbd mirror image demote`): client
+    writes refuse until a later promote, while a peer replayer keeps
+    applying events."""
+    key = f"image_{image}"
+    try:
+        got = await backend.omap_get(MIRROR_DIR_OID, [key])
+    except FileNotFoundError:
+        got = {}
+    if key not in got:
+        raise IOError(f"image {image} is not mirror-enabled")
+    await backend.omap_set(MIRROR_DIR_OID, {key: b"enabled:non-primary"})
 
 
 async def mirror_disable(backend, image: str,
@@ -104,6 +153,16 @@ class ImageReplayer:
             # must be rewritten, including zeros over stale bytes
             fresh = False
         dst_img = await Image.open(self.dst, self.image)
+        dst_dir = await self._dst_mirror_dir()
+        ent = dst_dir.get(f"image_{self.image}")
+        if ent is not None and b"non-primary" not in ent:
+            # the destination copy owns the write role (it was promoted):
+            # replaying onto it would silently destroy its writes --
+            # the reference's split-brain detection refuses the same way
+            raise IOError(
+                f"destination image {self.image} is primary; refusing "
+                "to replay onto it (demote it or force-resync)")
+        dst_img._mirror_bypass = True
         for name, ent in sorted(src_img.snaps.items(),
                                 key=lambda kv: kv[1]["id"]):
             view = await Image.open(self.src, self.image, snap=name)
@@ -117,7 +176,17 @@ class ImageReplayer:
                 await dst_img.snap_protect(name)
         await self._copy_content(src_img, dst_img, fresh)
         await jr.register_peer(self.peer_id, start_pos)
+        # the destination copy is mirror-tracked NON-PRIMARY: client
+        # writes there refuse until an operator promotes it (failover)
+        await self.dst.omap_set(
+            MIRROR_DIR_OID, {f"image_{self.image}": b"enabled:non-primary"})
         self._bootstrapped = True
+
+    async def _dst_mirror_dir(self) -> dict:
+        try:
+            return await self.dst.omap_get(MIRROR_DIR_OID)
+        except FileNotFoundError:
+            return {}
 
     async def _copy_content(self, view: Image, dst_img: Image,
                             fresh: bool) -> None:
@@ -162,6 +231,13 @@ class ImageReplayer:
         entries = await jr.peer_entries(self.peer_id)
         if entries:
             dst_img = await Image.open(self.dst, self.image)
+            if dst_img._primary is not False:
+                # split-brain guard (see bootstrap): never replay onto a
+                # copy that owns the write role
+                raise IOError(
+                    f"destination image {self.image} is primary; "
+                    "refusing to replay onto it")
+            dst_img._mirror_bypass = True
             for _start, end, ev in entries:
                 await apply_event(dst_img, ev)
                 await jr.peer_committed(self.peer_id, end)
@@ -197,6 +273,11 @@ class MirrorDaemon:
         per image."""
         applied: Dict[str, int] = {}
         for image in await mirror_list(self.src):
+            if not await mirror_is_primary(self.src, image):
+                # this side's copy is demoted: the replication direction
+                # reversed (failover) -- stop pulling from it
+                applied[image] = 0
+                continue
             rep = self.replayers.get(image)
             if rep is None:
                 rep = self.replayers[image] = ImageReplayer(
@@ -214,6 +295,9 @@ class MirrorDaemon:
     async def status(self) -> Dict[str, dict]:
         out: Dict[str, dict] = {}
         for image in await mirror_list(self.src):
+            if not await mirror_is_primary(self.src, image):
+                out[image] = {"state": "stopped", "reason": "non-primary"}
+                continue
             rep = self.replayers.get(image)
             if rep is not None and rep.last_error:
                 out[image] = {"state": "error", "error": rep.last_error}

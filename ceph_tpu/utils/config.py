@@ -85,6 +85,9 @@ OPTIONS: Dict[str, Option] = {
              "seconds of heartbeat silence before an OSD reports a peer "
              "failed to the mon (reference osd_heartbeat_grace; shrunk "
              "here to match the mini-cluster's time scale)"),
+        _opt("mon_mgr_beacon_grace", float, 30.0, LEVEL_ADVANCED,
+             "seconds of mgr-beacon silence before a standby's beacon "
+             "triggers failover (reference mon_mgr_beacon_grace)"),
         _opt("mon_osd_min_down_reporters", int, 2, LEVEL_ADVANCED,
              "distinct OSD failure reporters required before the mon "
              "marks the target down (reference "

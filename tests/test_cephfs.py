@@ -369,3 +369,104 @@ def test_rmdir_on_symlink_is_enotdir():
         await c.shutdown()
 
     run(main())
+
+
+# -- multi-active MDS (reference src/mds/MDBalancer.cc, Migrator) -----------
+
+
+def test_multimds_subtree_partitioning():
+    """Two active ranks: subtrees route to their authority rank, each
+    rank journals in ITS OWN journal, a per-rank standby replays only
+    that rank's journal."""
+    from ceph_tpu.mds.multimds import MultiMDS
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(6, dict(PROFILE))
+        fs = MultiMDS(c.backend, n_ranks=2)
+        await fs.start()
+        await fs.mkdir("/hot")
+        await fs.mkdir("/cold")
+        await fs.export_subtree("/hot", 1)
+        assert fs.rank_of("/hot/x") == 1 and fs.rank_of("/cold/x") == 0
+        await fs.create("/hot/a")
+        await fs.create("/cold/b")
+        assert sorted(await fs.readdir("/hot")) == ["a"]
+        # a fresh coordinator reloads the persisted subtree map
+        fs2 = MultiMDS(c.backend, n_ranks=2)
+        await fs2.start()
+        assert fs2.rank_of("/hot/x") == 1
+        # cross-subtree rename: journals split across both ranks
+        await fs.rename("/hot/a", "/cold/a2")
+        assert "a2" in await fs.readdir("/cold")
+        assert "a" not in await fs.readdir("/hot")
+        st = await fs.stat("/cold/a2")
+        assert st["type"] == "f"
+        await c.shutdown()
+
+    asyncio.run(main())
+
+
+def test_multimds_balancer_exports_hot_subtree():
+    """MDBalancer decision rule: the busiest rank's hottest subtree
+    moves to the idlest rank once the imbalance passes the factor."""
+    from ceph_tpu.mds.multimds import MultiMDS
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(6, dict(PROFILE))
+        fs = MultiMDS(c.backend, n_ranks=2, rebalance_factor=2.0)
+        await fs.start()
+        await fs.mkdir("/busy")
+        await fs.mkdir("/quiet")
+        # hammer /busy (rank 0 owns everything initially)
+        for i in range(20):
+            await fs.create(f"/busy/f{i}")
+        assert await fs.balance() == "busy"
+        assert fs.rank_of("/busy/x") == 1
+        # ops keep working after the export, on the new authority
+        await fs.create("/busy/after")
+        assert "after" in await fs.readdir("/busy")
+        # balanced now: no further export
+        assert await fs.balance() is None
+        await c.shutdown()
+
+    asyncio.run(main())
+
+
+def test_multimds_per_rank_journal_replay():
+    """A crashed rank's events replay from ITS journal only (standby
+    takeover per rank; reference up:replay per-rank MDLog)."""
+    from ceph_tpu.mds.mds import MDS
+    from ceph_tpu.mds.multimds import MultiMDS
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(6, dict(PROFILE))
+        fs = MultiMDS(c.backend, n_ranks=2)
+        await fs.start()
+        await fs.mkdir("/t")
+        await fs.export_subtree("/t", 1)
+        # simulate a crash mid-mutation on rank 1: journal an event
+        # without applying it (append directly, as a dying MDS would)
+        mds1 = fs.ranks[1]
+        ino = await mds1._alloc_ino()
+        mds1._journal_seq += 1
+        seq = mds1._journal_seq
+        tdir = await mds1._resolve_dir("/t")
+        await c.backend.omap_set(mds1.journal_oid, {
+            f"{seq:016d}": __import__("ceph_tpu.mds.mds", fromlist=["x"])
+            ._enc({"op": "link", "dir": tdir, "name": "ghost",
+                   "dentry": mds1._mkdentry(ino, "f")}),
+        })
+        # a standby MDS for RANK 1 replays it; rank 0's journal is empty
+        standby = MDS(c.backend, rank=1)
+        await standby.start()
+        assert standby.replayed == 1
+        assert "ghost" in await standby.readdir("/t")
+        standby0 = MDS(c.backend, rank=0)
+        await standby0.start()
+        assert standby0.replayed == 0
+        await c.shutdown()
+
+    asyncio.run(main())

@@ -153,3 +153,125 @@ def test_cluster_log_sequenced_filtered_and_bounded(monkeypatch):
         await ms.shutdown()
 
     run(main())
+
+
+# -- AuthMonitor / MgrMonitor / MDSMonitor (round-5 PaxosService trio) ------
+
+
+def test_auth_monitor_key_lifecycle():
+    """auth get-or-create / get / caps / rotate / rm / list (reference
+    src/mon/AuthMonitor.cc subset): keys mint once, rotate to a fresh
+    secret, and replicate through paxos to every mon."""
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl, _ = _client(ms, "client0")
+        rc, out = await cl.command({
+            "prefix": "auth get-or-create", "entity": "client.rgw",
+            "caps": {"osd": "allow rwx pool=rgw"}})
+        assert rc == 0
+        key1 = out["key"]
+        # idempotent: a second call returns the SAME key
+        rc, out = await cl.command({
+            "prefix": "auth get-or-create", "entity": "client.rgw"})
+        assert rc == 0 and out["key"] == key1
+        rc, out = await cl.command({
+            "prefix": "auth get", "entity": "client.rgw"})
+        assert rc == 0 and out["caps"] == {"osd": "allow rwx pool=rgw"}
+        # caps update + rotation
+        rc, _o = await cl.command({
+            "prefix": "auth caps", "entity": "client.rgw",
+            "caps": {"osd": "allow r"}})
+        assert rc == 0
+        rc, out = await cl.command({
+            "prefix": "auth rotate", "entity": "client.rgw"})
+        assert rc == 0 and out["key"] != key1
+        key2 = out["key"]
+        # the rotated key replicated: every mon answers the same
+        for m in mc.mons:
+            assert m.authdb.entities["client.rgw"]["key"] == key2
+        # list never exposes keys
+        rc, out = await cl.command({"prefix": "auth list"})
+        assert rc == 0 and "key" not in out["client.rgw"]
+        rc, _o = await cl.command({
+            "prefix": "auth rm", "entity": "client.rgw"})
+        assert rc == 0
+        rc, _o = await cl.command({
+            "prefix": "auth get", "entity": "client.rgw"})
+        assert rc == -2
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_mgr_monitor_active_standby_failover():
+    """mgr beacons elect an active; `mgr fail` (and beacon-grace
+    expiry) promote a standby (reference src/mon/MgrMonitor.cc)."""
+    from ceph_tpu.utils.config import get_config
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl, _ = _client(ms, "client0")
+        rc, mm = await cl.command({"prefix": "mgr beacon", "name": "x"})
+        assert rc == 0 and mm["active"] == "x"
+        rc, mm = await cl.command({"prefix": "mgr beacon", "name": "y"})
+        assert rc == 0 and mm["active"] == "x" and mm["standbys"] == ["y"]
+        rc, mm = await cl.command({"prefix": "mgr fail"})
+        assert rc == 0 and mm["active"] == "y" and mm["standbys"] == []
+        # grace-based failover: y goes silent, x's next beacon promotes
+        rc, _m = await cl.command({"prefix": "mgr beacon", "name": "x"})
+        get_config().set_val("mon_mgr_beacon_grace", "0.05")
+        try:
+            await asyncio.sleep(0.1)
+            rc, mm = await cl.command({"prefix": "mgr beacon", "name": "x"})
+            assert rc == 0 and mm["active"] == "x"
+        finally:
+            get_config().set_val("mon_mgr_beacon_grace", "30.0")
+        await ms.shutdown()
+
+    run(main())
+
+
+def test_mds_monitor_fsmap_ranks_and_failover():
+    """fs new / mds beacons fill ranks / mds fail promotes a standby /
+    max_mds grows and shrinks the rank set (reference
+    src/mon/MDSMonitor.cc FSMap)."""
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        cl, _ = _client(ms, "client0")
+        for name in ("a", "b", "c"):
+            rc, _o = await cl.command({"prefix": "mds beacon",
+                                       "name": name})
+            assert rc == 0
+        rc, fm = await cl.command({"prefix": "fs new", "name": "cephfs",
+                                   "max_mds": 2})
+        assert rc == 0
+        fs = fm["filesystems"]["cephfs"]
+        assert fs["ranks"] == {"0": "a", "1": "b"}
+        assert fm["standbys"] == ["c"]
+        # rank-0 death: the standby takes the rank
+        rc, fm = await cl.command({"prefix": "mds fail", "name": "a"})
+        assert rc == 0
+        assert fm["filesystems"]["cephfs"]["ranks"] == {"0": "c", "1": "b"}
+        assert fm["standbys"] == []
+        # a revived daemon re-registers as standby
+        rc, fm = await cl.command({"prefix": "mds beacon", "name": "a"})
+        assert fm["standbys"] == ["a"]
+        # shrink to one rank: rank 1 returns to the pool
+        rc, fm = await cl.command({"prefix": "fs set max_mds",
+                                   "name": "cephfs", "max_mds": 1})
+        assert rc == 0
+        assert fm["filesystems"]["cephfs"]["ranks"] == {"0": "c"}
+        assert sorted(fm["standbys"]) == ["a", "b"]
+        rc, names = await cl.command({"prefix": "fs ls"})
+        assert names == ["cephfs"]
+        await ms.shutdown()
+
+    run(main())

@@ -37,3 +37,20 @@ def test_load_and_factory_xor():
 def test_load_failures(name, errno_expected):
     rc = reg.load(name)
     assert rc == errno_expected, (name, rc, reg.last_error())
+
+
+def test_hanging_plugin_watchdog():
+    """The ErasureCodePluginHangs contract (reference
+    src/test/erasure-code/ErasureCodePluginHangs.cc): a plugin that
+    never returns from its load path must not wedge the caller -- the
+    watchdog load reports -ETIMEDOUT within its deadline."""
+    import time
+
+    t0 = time.monotonic()
+    rc = reg.load_with_timeout("hangs_native", timeout_ms=300)
+    took = time.monotonic() - t0
+    assert rc == -110, (rc, reg.last_error())  # -ETIMEDOUT
+    assert took < 5.0
+    assert "timed out" in reg.last_error()
+    # a healthy plugin through the same watchdog path still loads
+    assert reg.load_with_timeout("xor_native", timeout_ms=5000) == 0

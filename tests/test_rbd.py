@@ -407,3 +407,103 @@ def test_rbd_replay_records_and_reproduces_image_state(tmp_path):
         await c2.shutdown()
 
     asyncio.run(run())
+
+
+# -- object map + fast-diff (reference src/librbd/ObjectMap.cc) -------------
+
+
+def test_object_map_maintained_by_writes():
+    async def run():
+        from ceph_tpu.rbd.objectmap import (OBJECT_EXISTS,
+                                            OBJECT_EXISTS_CLEAN,
+                                            OBJECT_NONEXISTENT)
+
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("om", 8 << 20, order=20,
+                         features=["object-map", "fast-diff"])
+        img = await Image.open(c.backend, "om")
+        assert img.object_map_states() == bytes(8)
+        await img.write(0, b"a" * 100)              # object 0
+        await img.write(3 << 20, b"b" * (1 << 20))  # object 3
+        st = img.object_map_states()
+        assert st[0] == OBJECT_EXISTS and st[3] == OBJECT_EXISTS
+        assert st[1] == OBJECT_NONEXISTENT
+        # a reopened handle loads the persisted map
+        img2 = await Image.open(c.backend, "om")
+        assert img2.object_map_states() == st
+        # snap_create freezes the map and sweeps dirty -> clean
+        await img.snap_create("s1")
+        st = img.object_map_states()
+        assert st[0] == OBJECT_EXISTS_CLEAN and st[3] == OBJECT_EXISTS_CLEAN
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_fast_diff_extents():
+    async def run():
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("fd", 8 << 20, order=20,
+                         features=["object-map", "fast-diff"])
+        img = await Image.open(c.backend, "fd")
+        await img.write(0, b"x" * 10)
+        await img.write(5 << 20, b"y" * 10)
+        await img.snap_create("s1")
+        await img.write(2 << 20, b"z" * 10)         # new since s1
+        await img.write(5 << 20, b"Y" * 10)         # modified since s1
+        # diff since s1: exactly objects 2 and 5
+        d = await img.diff("s1")
+        assert [(off >> 20, ex) for off, _ln, ex in d] == [(2, True),
+                                                          (5, True)]
+        # diff since creation: every existing object
+        d0 = await img.diff()
+        assert sorted(off >> 20 for off, _ln, _ex in d0) == [0, 2, 5]
+        # a second snapshot interval composes (union across snap maps)
+        await img.snap_create("s2")
+        await img.write(7 << 20, b"w" * 10)
+        d = await img.diff("s1")
+        assert sorted(off >> 20 for off, _ln, _ex in d) == [2, 5, 7]
+        assert [off >> 20 for off, _ln, _ex in await img.diff("s2")] == [7]
+        await c.shutdown()
+
+    asyncio.run(run())
+
+
+def test_object_map_enable_rebuilds_and_serves_absence():
+    async def run():
+        from ceph_tpu.rbd.objectmap import OBJECT_EXISTS
+
+        c = _mk()
+        rbd = RBD(c.backend)
+        await rbd.create("re", 4 << 20, order=20)  # feature OFF
+        img = await Image.open(c.backend, "re")
+        await img.write(1 << 20, b"pre-existing")
+        # enabling the feature on a live image rebuilds from the store
+        await img.update_features(enable=["object-map"])
+        st = img.object_map_states()
+        assert st[1] == OBJECT_EXISTS and st[0] == 0
+        # absence checks now come from the map (no stat round trip)
+        calls = {"n": 0}
+        orig = img.backend.stat
+
+        async def counting_stat(oid):
+            calls["n"] += 1
+            return await orig(oid)
+
+        img.backend.stat = counting_stat
+        assert await img._object_absent("rbd_data.re.%016x" % 0)
+        assert not await img._object_absent("rbd_data.re.%016x" % 1)
+        assert calls["n"] == 0
+        img.backend.stat = orig
+        # fast-diff without object-map is refused; disable cleans up
+        with pytest.raises(ValueError):
+            await img.update_features(enable=["fast-diff"],
+                                      disable=["object-map"])
+        await img.update_features(disable=["object-map"])
+        with pytest.raises(ValueError):
+            img.object_map_states()
+        await c.shutdown()
+
+    asyncio.run(run())

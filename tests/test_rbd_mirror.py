@@ -385,3 +385,75 @@ def test_mirror_peer_pins_journal_trim():
         await src.shutdown()
 
     asyncio.run(run())
+
+
+# -- promotion / demotion (reference: journal tag ownership,
+#    src/tools/rbd_mirror promote/demote flow) ------------------------------
+
+
+def test_mirror_promote_demote_failover():
+    """Full failover: demote the primary, promote the secondary; write
+    roles flip, the old replication direction stops, and the reverse
+    direction replicates the new primary's writes back."""
+    from ceph_tpu.rbd import (mirror_demote, mirror_is_primary,
+                              mirror_promote)
+
+    async def run():
+        a = _mk()
+        b = ECCluster(4, {"plugin": "jerasure", "k": "2", "m": "1"})
+        rbd = RBD(a.backend)
+        await rbd.create("img", 1 << 20, order=16,
+                         features=[FEATURE_JOURNALING])
+        img_a = await Image.open(a.backend, "img")
+        await img_a.write(0, b"from A")
+        await mirror_enable(a.backend, "img")
+        assert await mirror_is_primary(a.backend, "img")
+        daemon_ab = MirrorDaemon(a.backend, b.backend)
+        await daemon_ab.run_once()
+
+        # the bootstrapped copy on B is non-primary: writes refuse
+        img_b = await Image.open(b.backend, "img")
+        assert img_b._primary is False
+        with pytest.raises(PermissionError):
+            await img_b.write(0, b"illegal")
+        # promoting without demoting A first is refused (split-brain
+        # guard) unless forced
+        with pytest.raises(IOError):
+            await mirror_promote(a.backend, "img")  # already primary
+
+        # orderly failover: demote A, promote B
+        await mirror_demote(a.backend, "img")
+        img_a = await Image.open(a.backend, "img")
+        with pytest.raises(PermissionError):
+            await img_a.write(0, b"demoted")
+        await mirror_promote(b.backend, "img")
+        img_b = await Image.open(b.backend, "img")
+        await img_b.write(0, b"from B")  # B owns the write role now
+
+        # the old direction stops: A is non-primary
+        st = await daemon_ab.status()
+        assert st["img"]["state"] == "stopped"
+        assert (await daemon_ab.run_once())["img"] == 0
+
+        # replaying onto a promoted copy is refused outright
+        rep = daemon_ab.replayers["img"]
+        await img_a2_write_guard(rep)
+
+        # reverse direction: B needs journaling to feed a replayer
+        await img_b.update_features(enable=[FEATURE_JOURNALING])
+        img_b = await Image.open(b.backend, "img")
+        await img_b.write(6, b" again")
+        daemon_ba = MirrorDaemon(b.backend, a.backend)
+        await daemon_ba.run_once()
+        img_a = await Image.open(a.backend, "img")
+        assert await img_a.read(0, 12) == b"from B again"
+        # A remains non-primary after the failback sync
+        assert not await mirror_is_primary(a.backend, "img")
+        await a.shutdown()
+        await b.shutdown()
+
+    async def img_a2_write_guard(rep):
+        with pytest.raises(IOError):
+            await rep.bootstrap()
+
+    asyncio.run(run())

@@ -227,7 +227,7 @@ def main() -> int:
             gibps = timed(fn, d32, args.iters, data_np.nbytes)
             print(f"{name:16s} {'bit-exact' if ok else 'MISMATCH '}"
                   f" {gibps:8.2f} GiB/s", flush=True)
-            if not ok and name != "pipelined":
+            if not ok:
                 rc = 1  # a gated variant drifted from the oracle
 
     if "precision" in stages:
