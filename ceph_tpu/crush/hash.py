@@ -80,6 +80,22 @@ def crush_hash32_2(a: ArrayOrInt, b: ArrayOrInt) -> ArrayOrInt:
     return h
 
 
+def crush_hash32_4(a: ArrayOrInt, b: ArrayOrInt, c: ArrayOrInt,
+                   d: ArrayOrInt) -> ArrayOrInt:
+    """4-argument schedule (hash.c crush_hash32_rjenkins1_4); used by
+    the tree bucket's per-node draws."""
+    a, b, c, d = _u32(a), _u32(b), _u32(c), _u32(d)
+    h = int(_SEED) ^ a ^ b ^ c ^ d
+    x, y = 231232, 1232
+    a, b, h = _mix(a, b, h)
+    c, d, h = _mix(c, d, h)
+    a, x, h = _mix(a, x, h)
+    y, b, h = _mix(y, b, h)
+    c, x, h = _mix(c, x, h)
+    y, d, h = _mix(y, d, h)
+    return h
+
+
 def crush_hash32_3(a: ArrayOrInt, b: ArrayOrInt, c: ArrayOrInt) -> ArrayOrInt:
     a, b, c = _u32(a), _u32(b), _u32(c)
     h = int(_SEED) ^ a ^ b ^ c

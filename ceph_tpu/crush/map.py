@@ -16,6 +16,8 @@ import numpy as np
 # bucket algorithms (reference: crush.h:140-190)
 BUCKET_UNIFORM = 1
 BUCKET_LIST = 2
+BUCKET_TREE = 3
+BUCKET_STRAW = 4  # legacy straw1 (hammer straw_calc_version=1 straws)
 BUCKET_STRAW2 = 5
 
 # rule step ops (reference: crush.h CRUSH_RULE_*)
@@ -75,12 +77,97 @@ class Bucket:
     def add_item(self, item: int, weight: int) -> None:
         self.items.append(item)
         self.weights.append(weight)
+        # derived-array caches (tree node weights, straw lengths)
+        self._tree_cache = None
+        self._straw_cache = None
 
     def items_array(self) -> np.ndarray:
         return np.asarray(self.items, dtype=np.int64)
 
     def weights_array(self) -> np.ndarray:
         return np.asarray(self.weights, dtype=np.int64)
+
+    # -- tree bucket (reference builder.c crush_make_tree_bucket) ----------
+
+    def tree_node_weights(self) -> np.ndarray:
+        """node_weights over the 2^depth binary-tree labels (items at
+        odd nodes via crush_calc_tree_node(i) = ((i+1)<<1)-1; each
+        ancestor holds its subtree's weight sum).  Cached: do_rule
+        draws per replica per retry, and the reference computes this
+        once at map build (builder.c crush_make_tree_bucket)."""
+        cached = getattr(self, "_tree_cache", None)
+        if cached is not None:
+            return cached
+        size = self.size
+        if size == 0:
+            return np.zeros(0, dtype=np.int64)
+        depth = 1
+        t = size - 1
+        while t:
+            t >>= 1
+            depth += 1
+        nw = np.zeros(1 << depth, dtype=np.int64)
+        for i, w in enumerate(self.weights):
+            node = ((i + 1) << 1) - 1
+            nw[node] = w
+            for _ in range(1, depth):
+                node = _tree_parent(node)
+                nw[node] += w
+        self._tree_cache = nw
+        return nw
+
+    # -- legacy straw1 (builder.c crush_calc_straw, calc version 1) --------
+
+    def straws(self) -> np.ndarray:
+        """Per-item straw lengths (16.16) for the legacy straw bucket,
+        per the hammer straw_calc_version=1 recipe: ascending-weight
+        walk, each weight step scales the remaining straws by
+        (1/pbelow)^(1/numleft).  Cached like the tree node weights."""
+        import math
+
+        cached = getattr(self, "_straw_cache", None)
+        if cached is not None:
+            return cached
+        size = self.size
+        straws = np.zeros(size, dtype=np.int64)
+        order = sorted(range(size), key=lambda i: self.weights[i])
+        numleft = size
+        straw = 1.0
+        wbelow = 0.0
+        lastw = 0.0
+        i = 0
+        while i < size:
+            if self.weights[order[i]] == 0:
+                straws[order[i]] = 0
+                i += 1
+                numleft -= 1
+                continue
+            straws[order[i]] = int(straw * 0x10000)
+            i += 1
+            if i == size:
+                break
+            wbelow += (float(self.weights[order[i - 1]]) - lastw) * numleft
+            numleft -= 1
+            wnext = numleft * (self.weights[order[i]]
+                               - self.weights[order[i - 1]])
+            pbelow = wbelow / (wbelow + wnext) if (wbelow + wnext) else 1.0
+            if pbelow > 0:
+                straw *= math.pow(1.0 / pbelow, 1.0 / numleft)
+            lastw = float(self.weights[order[i - 1]])
+        self._straw_cache = straws
+        return straws
+
+
+def _tree_parent(n: int) -> int:
+    """Parent in the tree bucket's node labelling (builder.c parent())."""
+    h = 0
+    t = n
+    while (t & 1) == 0:
+        h += 1
+        t >>= 1
+    if n & (1 << (h + 1)):  # on the right of its parent
+        return n - (1 << h)
+    return n + (1 << h)
 
 
 @dataclass
@@ -157,7 +244,9 @@ class CrushMap:
                     "id": b.id,
                     "name": b.name,
                     "type": b.type,
-                    "alg": {BUCKET_UNIFORM: "uniform", BUCKET_LIST: "list", BUCKET_STRAW2: "straw2"}.get(b.alg, b.alg),
+                    "alg": {BUCKET_UNIFORM: "uniform", BUCKET_LIST: "list",
+                            BUCKET_TREE: "tree", BUCKET_STRAW: "straw",
+                            BUCKET_STRAW2: "straw2"}.get(b.alg, b.alg),
                     "items": [
                         {"id": i, "weight": w / 0x10000}
                         for i, w in zip(b.items, b.weights)

@@ -16,10 +16,13 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from ceph_tpu.crush.hash import crush_hash32_2, crush_hash32_3
+from ceph_tpu.crush.hash import (crush_hash32_2, crush_hash32_3,
+                                 crush_hash32_4)
 from ceph_tpu.crush.map import (
     BUCKET_LIST,
+    BUCKET_STRAW,
     BUCKET_STRAW2,
+    BUCKET_TREE,
     BUCKET_UNIFORM,
     ITEM_NONE,
     ITEM_UNDEF,
@@ -100,6 +103,43 @@ def _list_choose(bucket: Bucket, x: int, r: int) -> int:
     return bucket.items[0]
 
 
+def _tree_choose(bucket: Bucket, x: int, r: int) -> int:
+    """Binary-tree descent (reference: mapper.c bucket_tree_choose
+    :195-222): at each interior node draw a point in [0, node weight)
+    via hash32_4(x, node, r, bucket id) and descend left when it falls
+    under the left subtree's weight; items sit at odd node labels."""
+    nw = bucket.tree_node_weights()
+    n = len(nw) >> 1  # root
+    if int(nw[n]) == 0:
+        # all-zero weights: every draw is 0 and the descent would walk
+        # into the right padding (IndexError); mirror straw2's
+        # all-zero tiebreak and answer item 0
+        return bucket.items[0]
+    while not (n & 1):
+        w = int(nw[n])
+        t = (int(crush_hash32_4(
+            x, n, r, bucket.id & 0xFFFFFFFF)) * w) >> 32
+        h = 0
+        m = n
+        while (m & 1) == 0:
+            h += 1
+            m >>= 1
+        left = n - (1 << (h - 1))
+        n = left if t < int(nw[left]) else n + (1 << (h - 1))
+    return bucket.items[n >> 1]
+
+
+def _straw_choose(bucket: Bucket, x: int, r: int) -> int:
+    """Legacy straw1 draw (reference: mapper.c bucket_straw_choose
+    :227-248): (hash & 0xffff) * precomputed straw length, max wins."""
+    straws = bucket.straws()
+    items = bucket.items_array()
+    draws = (np.asarray(crush_hash32_3(
+        x, (items & 0xFFFFFFFF).astype(np.uint64), r)
+    ).astype(np.int64) & 0xFFFF) * straws
+    return int(items[int(np.argmax(draws))])
+
+
 def _bucket_choose(bucket: Bucket, x: int, r: int) -> int:
     if bucket.alg == BUCKET_STRAW2:
         return _straw2_choose(bucket, x, r)
@@ -107,6 +147,10 @@ def _bucket_choose(bucket: Bucket, x: int, r: int) -> int:
         return _perm_choose(bucket, x, r)
     if bucket.alg == BUCKET_LIST:
         return _list_choose(bucket, x, r)
+    if bucket.alg == BUCKET_TREE:
+        return _tree_choose(bucket, x, r)
+    if bucket.alg == BUCKET_STRAW:
+        return _straw_choose(bucket, x, r)
     raise ValueError(f"unknown bucket alg {bucket.alg}")
 
 

@@ -3,10 +3,26 @@
 Reference: src/msg/async/AsyncMessenger.{h,cc} with the posix NetworkStack
 (src/msg/async/Stack.h:287, PosixStack.h) -- a listening socket per
 daemon, cached outgoing connections, a banner handshake naming the peer
-node, framed messages.  Policy is the reference's "lossy client": a send
-to an unreachable peer is dropped and the peer marked unreachable; later
-sends retry the connect, so a restarted daemon becomes reachable again
-(the reconnect role of the lossless-peer policy, minus replay).
+node, framed messages.
+
+Two policies, as in the reference (src/msg/Policy.h):
+
+* **lossy** (client connections): a send to an unreachable peer is
+  dropped and the peer marked unreachable; later sends retry the
+  connect, so a restarted daemon becomes reachable again.
+* **lossless peer** (OSD<->OSD, round 5): every message to a lossless
+  peer carries a per-direction sequence number and stays on the sender's
+  unacked queue until the receiver acks it; a connection drop triggers
+  reconnect + REPLAY of everything past the peer's delivered watermark,
+  and the receiver dedups by sequence -- the Pipe.cc connect/replay
+  protocol (src/msg/simple/Pipe.cc:1040-1260 connect(), got_ack,
+  in_seq/out_seq exchange).  Receive state is keyed by the sender's
+  per-process INSTANCE id (the reference's connect nonce), so a
+  restarted sender starts a fresh stream instead of colliding with its
+  predecessor's watermark.  Like the reference, delivery across a
+  RECEIVER restart degrades to at-least-once (unacked messages are
+  retransmitted to the fresh process; the version-gated OSD apply paths
+  make redelivery idempotent, the reqid-dedup role).
 
 One ``TCPMessenger`` per process ("node").  A node hosts one or more
 named entities (e.g. ``osd.3``); the address book maps every entity name
@@ -14,25 +30,55 @@ in the cluster to its node's (host, port).  Entity names co-hosted on
 this node short-circuit delivery in process (the reference's local
 fast-dispatch for self-sends, ECBackend.cc:2025-2032).
 
-Frames on the socket are ``encoding.frame`` records (magic+len+crc32c)
-whose payload is ``string src | string dst | encode_message(msg)``; the
-first frame on every outgoing connection is a banner naming the sender
-node and protocol version (Pipe.cc banner exchange).
+Frames on the socket are ``encoding.frame`` records (magic+len+crc32c);
+payloads start with a kind byte: MSG (src|dst|seq|body), ACK (seq), or
+SESSION (the reconnect watermark exchange).  The first frame on every
+outgoing connection is a banner naming the sender node, protocol
+version, and instance id (Pipe.cc banner exchange).
 """
 
 from __future__ import annotations
 
 import asyncio
+import os
 import struct
+from collections import deque
 from typing import Awaitable, Callable, Dict, Optional, Tuple
 
 from ceph_tpu.msg.wire import decode_message, encode_message
 from ceph_tpu.osd.messenger import FaultInjector
 from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
 
-_PROTOCOL_VERSION = 2
+_PROTOCOL_VERSION = 3
 _BANNER = "ceph-tpu-msgr"
 _SIG_LEN = 16
+
+# frame kinds (payload byte 0)
+_K_MSG = 0
+_K_ACK = 1
+_K_SESSION = 2
+
+
+class _SendSession:
+    """Per-lossless-peer send state (the Pipe out_seq/sent-queue role)."""
+
+    __slots__ = ("out_seq", "acked", "sent", "sent_bytes", "reconnecting")
+
+    def __init__(self):
+        self.out_seq = 0
+        self.acked = 0
+        #: unacked (seq, payload-bytes) oldest first; payloads are kept
+        #: UNSEALED -- signing is per-connection (fresh session key on
+        #: every reconnect), so frames seal at (re)transmit time
+        self.sent: deque = deque()
+        self.sent_bytes = 0
+        self.reconnecting = False
+
+    def prune(self, acked_seq: int) -> None:
+        self.acked = max(self.acked, acked_seq)
+        while self.sent and self.sent[0][0] <= self.acked:
+            _seq, payload = self.sent.popleft()
+            self.sent_bytes -= len(payload)
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
@@ -103,6 +149,30 @@ class TCPMessenger:
         except (KeyError, ValueError, TypeError):
             cap = 500 * 1024 * 1024
         self.dispatch_throttle = Throttle(f"{node}.msgr-dispatch", cap)
+        #: per-process instance id (the Pipe connect nonce): receive
+        #: state is keyed by it, so a restarted peer's fresh stream
+        #: never collides with its predecessor's sequence watermark
+        self.instance_id = os.urandom(8)
+        #: lossless-peer send sessions: peer node -> _SendSession
+        self._sessions: Dict[str, _SendSession] = {}
+        self._connect_locks: Dict[str, asyncio.Lock] = {}
+        #: last instance id seen from each peer node (inbound banners):
+        #: an accept pops our cached outgoing conn only on a CHANGE
+        #: (peer restart), never on ordinary bidirectional traffic
+        self._peer_instances: Dict[str, bytes] = {}
+        self._closing = False
+        #: receive watermarks: (peer node, instance id) -> delivered seq
+        self._in_seqs: Dict[tuple, int] = {}
+        #: backlog cap per lossless peer (beyond it, NEW messages drop
+        #: like lossy sends -- an honest bound; the reference relies on
+        #: its throttles for the same purpose)
+        self.lossless_max_backlog = 64 << 20
+
+    def _lossless(self, node: str) -> bool:
+        """Lossless-peer policy: OSD<->OSD connections (the reference's
+        cluster-messenger policy, src/msg/Policy.h lossless_peer);
+        everything else is a lossy client."""
+        return self.node.startswith("osd.") and node.startswith("osd.")
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -113,6 +183,7 @@ class TCPMessenger:
         )
 
     async def shutdown(self) -> None:
+        self._closing = True  # stops lossless reconnect loops
         if self._server is not None:
             self._server.close()
         for conn in self._conns.values():
@@ -221,6 +292,7 @@ class TCPMessenger:
             return
         peer_node = dec.string()
         client_nonce = dec.blob()
+        peer_instance = dec.blob()
         session_key = None
         if self.keyring is not None:
             session_key = await self._auth_accept(
@@ -230,14 +302,24 @@ class TCPMessenger:
                 writer.close()  # failed handshake: refuse (-EACCES)
                 return
         self._unreachable.pop(peer_node, None)
-        # the peer (re)connected: any cached outgoing connection to it may
-        # be a dead socket from its previous incarnation (writes into one
-        # are silently buffered by TCP, losing replies) -- drop it so the
-        # next send dials the live process (reference: lossy policy
-        # reconnect, Pipe.cc replaces the old session on accept)
-        stale = self._conns.pop(peer_node, None)
-        if stale is not None:
-            stale[1].close()
+        # the peer RESTARTED (new instance id): any cached outgoing
+        # connection targets its dead predecessor (writes into it are
+        # silently buffered by TCP, losing replies) -- drop it so the
+        # next send dials the live process (Pipe.cc replaces the old
+        # session on accept).  Same instance = ordinary bidirectional
+        # traffic: the healthy outgoing conn must survive the accept,
+        # or two chatty OSDs would tear each other's sessions down
+        # forever (review r5 finding).
+        prev_instance = self._peer_instances.get(peer_node)
+        self._peer_instances[peer_node] = peer_instance
+        if prev_instance != peer_instance:
+            # first contact or a restart: drop the (possibly stale)
+            # cached conn once; repeat accepts from the SAME instance
+            # leave it alone
+            stale = self._conns.pop(peer_node, None)
+            if stale is not None:
+                stale[1].close()
+        in_key = (peer_node, peer_instance)
         while True:
             rec = await _read_frame(reader)
             if rec is None:
@@ -251,9 +333,39 @@ class TCPMessenger:
                 if not _verify(session_key, rec, sig):
                     break  # forged/tampered frame: drop the connection
             dec = Decoder(rec)
+            kind = dec.u8()
+            if kind == _K_SESSION:
+                # reconnect watermark exchange (Pipe.cc connect reply):
+                # tell the peer what we have DELIVERED from this
+                # instance, so it replays everything after
+                reply = Encoder().u8(_K_SESSION).varint(
+                    self._in_seqs.get(in_key, 0)).bytes()
+                writer.write(frame(self._seal(reply, session_key)))
+                await writer.drain()
+                continue
+            if kind != _K_MSG:
+                continue  # ACK frames never arrive on an inbound socket
             src = dec.string()
             dst = dec.string()
-            msg = decode_message(dec.blob())
+            seq = dec.varint()
+            body = dec.blob()
+            if seq:
+                # lossless stream (in order per TCP connection).  A dst
+                # we do not host YET (the boot window between
+                # messenger.start and entity registration) must NOT be
+                # acked -- break the connection instead, so the sender
+                # replays once the entity exists.  A marked-down dst is
+                # an intentional kill: ack-and-drop.
+                if dst not in self._local_queues and \
+                        dst not in self._marked_down:
+                    break
+                ack = Encoder().u8(_K_ACK).varint(seq).bytes()
+                writer.write(frame(self._seal(ack, session_key)))
+                await writer.drain()
+                if seq <= self._in_seqs.get(in_key, 0):
+                    continue  # duplicate from a replay: already delivered
+                self._in_seqs[in_key] = seq
+            msg = decode_message(body)
             queue = self._local_queues.get(dst)
             if queue is not None and dst not in self._marked_down:
                 if isinstance(msg, dict) and msg.get("op") == "client_op":
@@ -306,7 +418,7 @@ class TCPMessenger:
         nonce = AuthHandshake.new_nonce() if self.keyring is not None else b""
         banner = (
             Encoder().string(_BANNER).varint(_PROTOCOL_VERSION)
-            .string(self.node).blob(nonce).bytes()
+            .string(self.node).blob(nonce).blob(self.instance_id).bytes()
         )
         writer.write(frame(banner))
         await writer.drain()
@@ -340,6 +452,135 @@ class TCPMessenger:
             session_key = hs.session_key()
         return reader, writer, asyncio.Lock(), session_key
 
+    def _conn_lock(self, node: str) -> asyncio.Lock:
+        lock = self._connect_locks.get(node)
+        if lock is None:
+            lock = self._connect_locks[node] = asyncio.Lock()
+        return lock
+
+    async def _try_establish(self, node: str):
+        """Connect to ``node`` and cache the connection; for a lossless
+        peer, run the session watermark exchange + replay first (the
+        Pipe.cc connect() path).  Returns the conn or None (peer down,
+        unreachable mark refreshed).  Serialized per node so concurrent
+        senders share one connection."""
+        async with self._conn_lock(node):
+            conn = self._conns.get(node)
+            if conn is not None:
+                return conn
+            try:
+                conn = await self._connect(node)
+            except OSError:
+                self._unreachable[node] = asyncio.get_event_loop().time()
+                return None
+            if self._lossless(node):
+                try:
+                    await self._session_handshake(node, conn)
+                except (OSError, ConnectionError, asyncio.TimeoutError):
+                    conn[1].close()
+                    self._unreachable[node] = \
+                        asyncio.get_event_loop().time()
+                    return None
+                except BaseException:
+                    # cancellation (e.g. a probe's outer wait_for): the
+                    # already-open socket must not leak
+                    conn[1].close()
+                    raise
+                self._spawn_ack_reader(node, conn)
+            self._conns[node] = conn
+            self._unreachable.pop(node, None)
+            return conn
+
+    async def _session_handshake(self, node: str, conn) -> None:
+        """Exchange delivered-watermarks with the peer and retransmit
+        everything it has not delivered (Pipe.cc connect/replay)."""
+        reader, writer, lock, skey = conn
+        sess = self._sessions.setdefault(node, _SendSession())
+        writer.write(frame(self._seal(
+            Encoder().u8(_K_SESSION).bytes(), skey)))
+        await writer.drain()
+        rec = await asyncio.wait_for(_read_frame(reader), 3.0)
+        if rec is None:
+            raise OSError(f"{node}: session handshake EOF")
+        dec = Decoder(self._unseal(rec, skey))
+        if dec.u8() != _K_SESSION:
+            raise OSError(f"{node}: bad session reply")
+        sess.prune(dec.varint())  # peer already delivered these
+        async with lock:
+            # re-snapshot until stable: a send that lands while the
+            # drain below is awaiting appends to sess.sent and is
+            # caught by the next iteration (review r5 finding)
+            sent_upto = 0
+            while True:
+                pending = [(s, p) for s, p in sess.sent if s > sent_upto]
+                if not pending:
+                    break
+                for s, payload in pending:
+                    writer.write(frame(self._seal(payload, skey)))
+                    sent_upto = s
+                await writer.drain()
+
+    def _spawn_ack_reader(self, node: str, conn) -> None:
+        """Consume ACK frames off a lossless outgoing connection,
+        pruning the unacked queue; on EOF drop the cached conn and, if
+        traffic is pending, start the reconnect loop."""
+
+        async def ack_loop():
+            reader, skey = conn[0], conn[3]
+            while True:
+                rec = await _read_frame(reader)
+                if rec is None:
+                    break
+                try:
+                    dec = Decoder(self._unseal(rec, skey))
+                except OSError:
+                    break
+                if dec.u8() == _K_ACK:
+                    sess = self._sessions.get(node)
+                    if sess is not None:
+                        sess.prune(dec.varint())
+            if self._conns.get(node) is conn:
+                self._conns.pop(node, None)
+                conn[1].close()
+                sess = self._sessions.get(node)
+                if sess is not None and sess.sent:
+                    self._spawn_reconnect(node)
+
+        self.adopt_task(
+            f"ack.{node}.{id(conn)}",
+            asyncio.get_event_loop().create_task(ack_loop()),
+        )
+
+    def _spawn_reconnect(self, node: str) -> None:
+        """Lossless-peer reconnect loop: keep dialing (bounded backoff)
+        until the peer answers and the queued messages replay, or the
+        peer is administratively down / the queue drains."""
+        sess = self._sessions.setdefault(node, _SendSession())
+        if sess.reconnecting:
+            return
+        sess.reconnecting = True
+
+        async def reconnect_loop():
+            try:
+                delay = 0.2
+                while True:
+                    if (self._closing or node in self._marked_down
+                            or not sess.sent):
+                        return
+                    if self._conns.get(node) is not None:
+                        return  # re-established elsewhere (replay done)
+                    if await self._try_establish(node) is not None:
+                        return
+                    await asyncio.sleep(delay)
+                    delay = min(delay * 1.7, 2.0)
+            finally:
+                sess.reconnecting = False
+
+        self.adopt_task(
+            f"reconnect.{node}",
+            asyncio.get_event_loop().create_task(reconnect_loop()),
+        )
+
     async def send_message(self, src: str, dst: str, msg: object) -> None:
         if src in self._marked_down or dst in self._marked_down:
             return
@@ -357,19 +598,22 @@ class TCPMessenger:
         if self.fault.maybe_drop():
             return
         await self.fault.maybe_delay()
+        body = encode_message(msg)
+        if self._lossless(node):
+            await self._send_lossless(src, dst, node, body)
+            return
         payload = (
-            Encoder().string(src).string(dst)
-            .blob(encode_message(msg)).bytes()
+            Encoder().u8(_K_MSG).string(src).string(dst).varint(0)
+            .blob(body).bytes()
         )
+        await self._send_lossy(node, payload)
+
+    async def _send_lossy(self, node: str, payload: bytes) -> None:
         conn = self._conns.get(node)
         if conn is None:
-            try:
-                conn = await self._connect(node)
-            except OSError:
-                self._unreachable[node] = asyncio.get_event_loop().time()
+            conn = await self._try_establish(node)
+            if conn is None:
                 return
-            self._conns[node] = conn
-            self._unreachable.pop(node, None)
         _, writer, lock, skey = conn
         rec = frame(self._seal(payload, skey))
         async with lock:
@@ -381,15 +625,54 @@ class TCPMessenger:
                 self._conns.pop(node, None)
                 writer.close()
                 # one reconnect attempt (peer may have restarted)
+                conn = await self._try_establish(node)
+                if conn is None:
+                    return
                 try:
-                    conn = await self._connect(node)
-                    self._conns[node] = conn
                     rec = frame(self._seal(payload, conn[3]))
                     conn[1].write(rec)
                     await conn[1].drain()
-                    self._unreachable.pop(node, None)
-                except OSError:
-                    self._unreachable[node] = asyncio.get_event_loop().time()
+                except (ConnectionError, OSError):
+                    self._conns.pop(node, None)
+                    conn[1].close()
+                    self._unreachable[node] = \
+                        asyncio.get_event_loop().time()
+
+    async def _send_lossless(self, src: str, dst: str, node: str,
+                             body: bytes) -> None:
+        """Queue-then-send with replay-on-reconnect (lossless peer)."""
+        sess = self._sessions.setdefault(node, _SendSession())
+        if sess.sent_bytes >= self.lossless_max_backlog:
+            return  # honest bound: beyond the backlog, drop like lossy
+        sess.out_seq += 1
+        payload = (
+            Encoder().u8(_K_MSG).string(src).string(dst)
+            .varint(sess.out_seq).blob(body).bytes()
+        )
+        sess.sent.append((sess.out_seq, payload))
+        sess.sent_bytes += len(payload)
+        conn = self._conns.get(node)
+        if conn is None:
+            conn = await self._try_establish(node)
+            if conn is None:
+                # queued; keep dialing in the background
+                self._spawn_reconnect(node)
+                return
+            # fall through and send: the establishing handshake may
+            # already have replayed this payload (it was queued first),
+            # in which case the receiver's watermark swallows the
+            # duplicate -- double-send is safe, silent loss is not
+        _, writer, lock, skey = conn
+        async with lock:
+            try:
+                writer.write(frame(self._seal(payload, skey)))
+                await writer.drain()
+                self._unreachable.pop(node, None)
+            except (ConnectionError, OSError):
+                self._conns.pop(node, None)
+                writer.close()
+                self._unreachable[node] = asyncio.get_event_loop().time()
+                self._spawn_reconnect(node)
 
     @staticmethod
     def _seal(payload: bytes, session_key) -> bytes:
@@ -398,6 +681,19 @@ class TCPMessenger:
         from ceph_tpu.auth.cephx import sign
 
         return payload + sign(session_key, payload)
+
+    @staticmethod
+    def _unseal(rec: bytes, session_key) -> bytes:
+        if session_key is None:
+            return rec
+        from ceph_tpu.auth.cephx import verify as _verify
+
+        if len(rec) < _SIG_LEN:
+            raise OSError("short signed frame")
+        body, sig = rec[:-_SIG_LEN], rec[-_SIG_LEN:]
+        if not _verify(session_key, body, sig):
+            raise OSError("bad frame signature")
+        return body
 
     async def probe(self, entity: str, timeout: float = 1.0) -> bool:
         """Liveness probe: can we (re)connect to the entity's node?
@@ -411,13 +707,12 @@ class TCPMessenger:
         if old is not None:
             old[1].close()
         try:
-            conn = await asyncio.wait_for(self._connect(node), timeout)
-        except (OSError, asyncio.TimeoutError):
+            conn = await asyncio.wait_for(
+                self._try_establish(node), timeout)
+        except asyncio.TimeoutError:
             self._unreachable[node] = asyncio.get_event_loop().time()
             return False
-        self._conns[node] = conn
-        self._unreachable.pop(node, None)
-        return True
+        return conn is not None
 
     # -- liveness view (thrasher + _shard_up hooks) ------------------------
 

@@ -254,3 +254,96 @@ def test_crushtool_cli(capsys):
     assert crushtool.main(["--build", "4x3", "--dump"]) == 0
     dump = capsys.readouterr().out
     assert "host0" in dump and "straw2" in dump
+
+
+# -- tree + legacy straw buckets (round 5; reference mapper.c:195-248) ------
+
+
+def test_tree_bucket_distribution_and_stability():
+    """Tree bucket: every item reachable, draws roughly proportional to
+    weight, and placement is deterministic (reference
+    bucket_tree_choose, builder.c crush_make_tree_bucket)."""
+    from collections import Counter
+
+    from ceph_tpu.crush.map import BUCKET_TREE, Bucket
+    from ceph_tpu.crush.mapper import _bucket_choose
+
+    b = Bucket(id=-1, type=1, alg=BUCKET_TREE,
+               items=[0, 1, 2, 3, 4],
+               weights=[0x10000, 0x10000, 0x20000, 0x10000, 0x10000])
+    # node weights: root carries the total
+    nw = b.tree_node_weights()
+    assert nw[len(nw) >> 1] == sum(b.weights)
+    picks = Counter(_bucket_choose(b, x, 0) for x in range(4000))
+    assert set(picks) == {0, 1, 2, 3, 4}
+    # item 2 has 2x weight: expect roughly 2x the draws of item 0
+    assert 1.4 < picks[2] / picks[0] < 2.8
+    assert _bucket_choose(b, 1234, 0) == _bucket_choose(b, 1234, 0)
+
+
+def test_straw1_bucket_distribution():
+    """Legacy straw bucket (hammer straw_calc_version=1): proportional
+    draws, zero-weight items never chosen (mapper.c
+    bucket_straw_choose + builder.c crush_calc_straw)."""
+    from collections import Counter
+
+    from ceph_tpu.crush.map import BUCKET_STRAW, Bucket
+    from ceph_tpu.crush.mapper import _bucket_choose
+
+    b = Bucket(id=-2, type=1, alg=BUCKET_STRAW,
+               items=[10, 11, 12, 13],
+               weights=[0x10000, 0x20000, 0x10000, 0])
+    straws = b.straws()
+    assert straws[3] == 0 and straws[1] > straws[0]
+    picks = Counter(_bucket_choose(b, x, 0) for x in range(4000))
+    assert 13 not in picks
+    assert 1.4 < picks[11] / picks[10] < 2.8
+
+
+def test_do_rule_over_tree_hierarchy():
+    """A full rule walk over a tree-bucket hierarchy places the
+    requested replicas on distinct devices."""
+    from ceph_tpu.crush.map import (BUCKET_TREE, RULE_CHOOSE_FIRSTN,
+                                    RULE_EMIT, RULE_TAKE, CrushMap, Rule,
+                                    Step)
+    from ceph_tpu.crush.mapper import do_rule
+
+    m = CrushMap()
+    root = m.new_bucket(type=2, alg=BUCKET_TREE, name="root")
+    for h in range(3):
+        host = m.new_bucket(type=1, alg=BUCKET_TREE, name=f"host{h}")
+        for d in range(2):
+            host.add_item(h * 2 + d, 0x10000)
+        root.add_item(host.id, host.weight)
+        m.max_device = max(m.max_device, h * 2 + 2)
+    m.rules.append(Rule(steps=[
+        Step(RULE_TAKE, root.id),
+        Step(RULE_CHOOSE_FIRSTN, 3, 1),
+        Step(RULE_CHOOSE_FIRSTN, 1, 0),
+        Step(RULE_EMIT),
+    ], name="tree-rule"))
+    seen = set()
+    for x in range(64):
+        out = do_rule(m, 0, x, 3)
+        assert len(out) == len(set(out)) == 3
+        seen.update(out)
+    assert seen == {0, 1, 2, 3, 4, 5}
+
+
+def test_tree_bucket_all_zero_weights_and_cache_invalidation():
+    """Review r5 findings: an all-zero tree bucket answers item 0
+    instead of walking off the node array, and add_item invalidates the
+    cached derived arrays."""
+    from ceph_tpu.crush.map import BUCKET_STRAW, BUCKET_TREE, Bucket
+    from ceph_tpu.crush.mapper import _bucket_choose
+
+    b = Bucket(id=-3, type=1, alg=BUCKET_TREE,
+               items=[0, 1, 2], weights=[0, 0, 0])
+    assert _bucket_choose(b, 99, 0) == 0
+    b.add_item(3, 0x10000)  # invalidates the zero-weight cache
+    assert _bucket_choose(b, 99, 0) == 3  # only positive-weight item
+    s = Bucket(id=-4, type=1, alg=BUCKET_STRAW,
+               items=[0], weights=[0x10000])
+    first = s.straws().copy()
+    s.add_item(1, 0x20000)
+    assert len(s.straws()) == 2 and s.straws()[1] != first[0]
