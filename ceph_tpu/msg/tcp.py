@@ -315,23 +315,24 @@ class TCPMessenger:
         if prev_instance != peer_instance:
             # first contact or a restart: drop the (possibly stale)
             # cached conn once; repeat accepts from the SAME instance
-            # leave it alone
-            stale = self._conns.pop(peer_node, None)
-            if stale is not None:
-                stale[1].close()
+            # leave it alone.  _drop_conn re-arms the reconnect loop if
+            # unacked lossless traffic is pending (a popped conn's ack
+            # reader cannot, its currency check fails by then), and
+            # dead-instance receive watermarks are pruned with their
+            # incarnation.
+            self._drop_conn(peer_node)
+            for key in [k for k in self._in_seqs
+                        if k[0] == peer_node and k[1] != peer_instance]:
+                del self._in_seqs[key]
         in_key = (peer_node, peer_instance)
         while True:
             rec = await _read_frame(reader)
             if rec is None:
                 break
-            if session_key is not None:
-                if len(rec) < _SIG_LEN:
-                    break
-                from ceph_tpu.auth.cephx import verify as _verify
-
-                rec, sig = rec[:-_SIG_LEN], rec[-_SIG_LEN:]
-                if not _verify(session_key, rec, sig):
-                    break  # forged/tampered frame: drop the connection
+            try:
+                rec = self._unseal(rec, session_key)
+            except OSError:
+                break  # short/forged/tampered frame: drop the connection
             dec = Decoder(rec)
             kind = dec.u8()
             if kind == _K_SESSION:
@@ -452,6 +453,19 @@ class TCPMessenger:
             session_key = hs.session_key()
         return reader, writer, asyncio.Lock(), session_key
 
+    def _drop_conn(self, node: str) -> None:
+        """Pop + close the cached conn to ``node``; if unacked lossless
+        traffic is queued, re-arm the reconnect loop (the popped conn's
+        own ack reader can no longer do it -- its currency check fails
+        once the conn left the cache)."""
+        conn = self._conns.pop(node, None)
+        if conn is not None:
+            conn[1].close()
+        sess = self._sessions.get(node)
+        if sess is not None and sess.sent and not self._closing \
+                and node not in self._marked_down:
+            self._spawn_reconnect(node)
+
     def _conn_lock(self, node: str) -> asyncio.Lock:
         lock = self._connect_locks.get(node)
         if lock is None:
@@ -540,11 +554,9 @@ class TCPMessenger:
                     if sess is not None:
                         sess.prune(dec.varint())
             if self._conns.get(node) is conn:
-                self._conns.pop(node, None)
-                conn[1].close()
-                sess = self._sessions.get(node)
-                if sess is not None and sess.sent:
-                    self._spawn_reconnect(node)
+                self._drop_conn(node)
+            else:
+                conn[1].close()  # superseded conn: just release it
 
         self.adopt_task(
             f"ack.{node}.{id(conn)}",
@@ -703,9 +715,7 @@ class TCPMessenger:
             return False
         # drop any cached connection: it may be a dead socket whose peer
         # was SIGKILLed -- a probe must test the wire, not the cache
-        old = self._conns.pop(node, None)
-        if old is not None:
-            old[1].close()
+        self._drop_conn(node)
         try:
             conn = await asyncio.wait_for(
                 self._try_establish(node), timeout)

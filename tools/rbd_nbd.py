@@ -1,0 +1,66 @@
+#!/usr/bin/env python3
+"""rbd-nbd: serve a pool's RBD images over the NBD protocol.
+
+Reference: src/tools/rbd_nbd/rbd-nbd.cc (`rbd-nbd map`).  This serves
+the standard fixed-newstyle NBD protocol on a TCP port; attach with any
+NBD client, e.g.:
+
+    nbd-client 127.0.0.1 <port> /dev/nbd0 -name <image>
+    qemu-nbd --connect=... / nbdfuse mnt 'nbd://127.0.0.1:<port>/<image>'
+
+Usage:
+  rbd_nbd.py --dir RUN [--port P]          serve a vstart cluster's pool
+  (runs until SIGINT/SIGTERM; prints the bound port when up)
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import signal
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from ceph_tpu.daemon.client import RemoteClient  # noqa: E402
+from ceph_tpu.rbd.nbd import NBDServer  # noqa: E402
+
+
+async def serve(args) -> None:
+    with open(os.path.join(args.dir, "cluster.json")) as f:
+        conf = json.load(f)
+    keyring = os.path.join(args.dir, "keyring")
+    c = await RemoteClient.connect(
+        os.path.join(args.dir, "addr_map.json"), dict(conf["profile"]),
+        keyring=keyring if conf.get("auth") and os.path.exists(keyring)
+        else None,
+    )
+    srv = NBDServer(c.backend, port=args.port)
+    port = await srv.start()
+    print(f"nbd server up on 127.0.0.1:{port}", flush=True)
+    stop = asyncio.get_event_loop().create_future()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        asyncio.get_event_loop().add_signal_handler(
+            sig, lambda: stop.done() or stop.set_result(True))
+    await stop
+    await srv.stop()
+    await c.close()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--dir", required=True,
+                    help="vstart run directory (addr_map/cluster.json)")
+    ap.add_argument("--port", type=int, default=0)
+    args = ap.parse_args(argv)
+    try:
+        asyncio.run(serve(args))
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
