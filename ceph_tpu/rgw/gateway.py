@@ -45,8 +45,21 @@ def uploads_oid(bucket: str) -> str:
 def acl_oid(bucket: str) -> str:
     """Per-bucket ACL store: omap key "@bucket" holds the bucket ACL,
     key "<obj>" an object ACL (reference: ACLs ride the bucket/object
-    attrs, src/rgw/rgw_acl.h:1; stored form here is JSON)."""
+    attrs, src/rgw/rgw_acl.h:1; stored form here is JSON).  Key
+    "@versioning" holds the bucket versioning status."""
     return f"rgw.aclstore.{bucket}"
+
+
+def versions_oid(bucket: str) -> str:
+    """Per-bucket version index: omap key "<key>\\x00<vid>" -> metadata
+    "<size>\\x00<etag>\\x00<ts>\\x00put|marker" (the reference keeps
+    version instances as bucket-index olh entries, rgw_rados.cc
+    RGWRados::Bucket::UpdateIndex + rgw_obj_key instances)."""
+    return f"rgw.versions.{bucket}"
+
+
+def ver_obj_oid(bucket: str, key: str, vid: str) -> str:
+    return f"rgw.objver.{bucket}/{key}\x00{vid}"
 
 
 #: canned ACLs -> grant lists (reference rgw_acl_s3.cc canned-ACL table)
@@ -440,6 +453,30 @@ class RGWGateway:
             raise S3Error("AccessDenied" if owner is None else
                           "InvalidRequest", f"{method} on service root")
         if not key:
+            if "versioning" in params:
+                # bucket versioning config (reference rgw olh versioning;
+                # `PUT ?versioning` owner-only, like S3)
+                if method == "PUT":
+                    await self._check_owner(bucket, owner)
+                    status = (b"Enabled" if b"Enabled" in body
+                              else b"Suspended")
+                    await self.index.omap_set(
+                        acl_oid(bucket), {"@versioning": status})
+                    return "200 OK", "application/xml", b"", {}
+                if method == "GET":
+                    await self._check_owner(bucket, owner)
+                    got = await self.index.omap_get(
+                        acl_oid(bucket), ["@versioning"])
+                    status = (got.get("@versioning") or b"").decode()
+                    xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+                           "<VersioningConfiguration>"
+                           + (f"<Status>{status}</Status>" if status
+                              else "")
+                           + "</VersioningConfiguration>")
+                    return "200 OK", "application/xml", xml.encode(), {}
+            if method == "GET" and "versions" in params:
+                await self._check_access(bucket, owner, "READ")
+                return await self._list_versions(bucket)
             if method == "PUT" and "acl" in params:
                 # PUT /bucket?acl: replace the bucket ACL (owner only)
                 await self._check_owner(bucket, owner)
@@ -525,11 +562,14 @@ class RGWGateway:
                 await self.index.omap_rm(acl_oid(bucket), [key])
             return out
         if method == "GET":
-            return await self._get_object(bucket, key)
+            return await self._get_object(
+                bucket, key, version_id=params.get("versionId"))
         if method == "HEAD":
-            return await self._head_object(bucket, key)
+            return await self._head_object(
+                bucket, key, version_id=params.get("versionId"))
         if method == "DELETE":
-            return await self._delete_object(bucket, key)
+            return await self._delete_object(
+                bucket, key, version_id=params.get("versionId"))
         raise S3Error("InvalidRequest", f"{method} on object")
 
     # -- Swift API (rgw_rest_swift.cc + rgw_swift_auth.cc subset) ----------
@@ -697,6 +737,11 @@ class RGWGateway:
         index = await self.index.omap_get(bucket_index_oid(bucket))
         if index:
             raise S3Error("BucketNotEmpty", bucket)
+        vers = await self.index.omap_get(versions_oid(bucket))
+        if any(k != "_seq" for k in vers):
+            # versions (incl. delete markers) still exist: S3 refuses
+            raise S3Error("BucketNotEmpty", f"{bucket} (versions remain)")
+        await self.index.omap_clear(versions_oid(bucket))
         # abort any in-progress multipart uploads: leaving their parts
         # behind would let a future same-name bucket's owner complete
         # the previous tenant's upload and read its data
@@ -726,7 +771,8 @@ class RGWGateway:
         for k in sorted(index):
             if not k.startswith(prefix):
                 continue
-            size, etag, mtime = index[k].decode().split("\x00")
+            # versioned entries carry a 4th (vid) field
+            size, etag = index[k].decode().split("\x00")[:2]
             items.append(
                 f"<Contents><Key>{escape(k)}</Key><Size>{size}</Size>"
                 f'<ETag>"{etag}"</ETag></Contents>'
@@ -741,10 +787,67 @@ class RGWGateway:
 
     # -- object ops (rgw_rados.cc put/get paths) ---------------------------
 
+    # -- versioning (reference rgw olh/versioning, rgw_rados.cc) ----------
+
+    async def _versioning_enabled(self, bucket: str) -> bool:
+        got = await self.index.omap_get(acl_oid(bucket), ["@versioning"])
+        return got.get("@versioning") == b"Enabled"
+
+    async def _next_vid(self, bucket: str) -> str:
+        """Monotonic per-bucket version id (CAS-allocated, so racing
+        PUTs get distinct ids; zero-padded so lexicographic order is
+        chronological)."""
+        while True:
+            cur = await self.index.omap_get(versions_oid(bucket), ["_seq"])
+            have = int(cur["_seq"]) if "_seq" in cur else 0
+            ok, _ = await self.index.omap_cas(
+                versions_oid(bucket), "_seq", cur.get("_seq"),
+                str(have + 1).encode())
+            if ok:
+                return f"{have + 1:010d}"
+
+    async def _archive_plain_current(self, bucket: str, key: str) -> None:
+        """A pre-versioning (plain) current object must survive as a
+        version when versioning operations replace or delete it (the S3
+        'null version' role): it becomes a version whose data stays at
+        the plain oid (kind 'plain')."""
+        got = await self.index.omap_get(bucket_index_oid(bucket), [key])
+        if key not in got:
+            return
+        parts = got[key].decode().split("\x00")
+        if len(parts) > 3:
+            return  # already version-pointing
+        avid = await self._next_vid(bucket)
+        await self.index.omap_set(versions_oid(bucket), {
+            f"{key}\x00{avid}":
+                f"{parts[0]}\x00{parts[1]}\x00{parts[2]}\x00plain".encode(),
+        })
+
+    async def _store_version(self, bucket: str, key: str, body: bytes,
+                             etag: str) -> str:
+        """Archive ``body`` as a new version and point the bucket index
+        at it (every PUT to a versioned bucket creates a version)."""
+        await self._archive_plain_current(bucket, key)
+        vid = await self._next_vid(bucket)
+        ts = int(time.time())
+        await self.backend.write(ver_obj_oid(bucket, key, vid), body)
+        await self.index.omap_set(versions_oid(bucket), {
+            f"{key}\x00{vid}":
+                f"{len(body)}\x00{etag}\x00{ts}\x00put".encode(),
+        })
+        await self.index.omap_set(bucket_index_oid(bucket), {
+            key: f"{len(body)}\x00{etag}\x00{ts}\x00{vid}".encode(),
+        })
+        return vid
+
     async def _put_object(self, bucket: str, key: str, body: bytes):
         if not await self._bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
         etag = hashlib.md5(body).hexdigest()
+        if await self._versioning_enabled(bucket):
+            vid = await self._store_version(bucket, key, body, etag)
+            return "200 OK", "application/xml", b"", {
+                "ETag": f'"{etag}"', "x-amz-version-id": vid}
         # data first, then the index entry (the reference's bucket-index
         # prepare/complete keeps the index authoritative)
         await self.backend.write(obj_oid(bucket, key), body)
@@ -754,26 +857,93 @@ class RGWGateway:
         return "200 OK", "application/xml", b"", {"ETag": f'"{etag}"'}
 
     async def _index_entry(self, bucket: str, key: str):
+        """-> (size, etag, current version id | None for plain objects)."""
         if not await self._bucket_exists(bucket):
             raise S3Error("NoSuchBucket", bucket)
         got = await self.index.omap_get(bucket_index_oid(bucket), [key])
         if key not in got:
             raise S3Error("NoSuchKey", key)
-        size, etag, mtime = got[key].decode().split("\x00")
-        return int(size), etag
+        parts = got[key].decode().split("\x00")
+        return int(parts[0]), parts[1], parts[3] if len(parts) > 3 else None
 
-    async def _get_object(self, bucket: str, key: str):
-        size, etag = await self._index_entry(bucket, key)
-        data = await self.backend.read(obj_oid(bucket, key))
-        return "200 OK", "application/octet-stream", data, {
-            "ETag": f'"{etag}"',
-        }
+    async def _version_meta(self, bucket: str, key: str, vid: str):
+        got = await self.index.omap_get(
+            versions_oid(bucket), [f"{key}\x00{vid}"])
+        raw = got.get(f"{key}\x00{vid}")
+        if raw is None:
+            raise S3Error("NoSuchKey", f"{key} versionId={vid}")
+        size_s, etag, ts, kind = raw.decode().split("\x00")
+        return int(size_s), etag, kind
 
-    async def _head_object(self, bucket: str, key: str):
-        size, etag = await self._index_entry(bucket, key)
+    async def _get_object(self, bucket: str, key: str,
+                          version_id: Optional[str] = None):
+        if version_id is not None:
+            _size, etag, kind = await self._version_meta(
+                bucket, key, version_id)
+            if kind == "marker":
+                raise S3Error("NoSuchKey", f"{key} (delete marker)")
+            data = await self.backend.read(
+                obj_oid(bucket, key) if kind == "plain"
+                else ver_obj_oid(bucket, key, version_id))
+            return "200 OK", "application/octet-stream", data, {
+                "ETag": f'"{etag}"', "x-amz-version-id": version_id}
+        size, etag, vid = await self._index_entry(bucket, key)
+        data = await self.backend.read(
+            ver_obj_oid(bucket, key, vid) if vid else obj_oid(bucket, key))
+        hdrs = {"ETag": f'"{etag}"'}
+        if vid:
+            hdrs["x-amz-version-id"] = vid
+        return "200 OK", "application/octet-stream", data, hdrs
+
+    async def _head_object(self, bucket: str, key: str,
+                           version_id: Optional[str] = None):
+        if version_id is not None:
+            size, etag, kind = await self._version_meta(
+                bucket, key, version_id)
+            if kind == "marker":
+                raise S3Error("NoSuchKey", f"{key} (delete marker)")
+        else:
+            size, etag, _vid = await self._index_entry(bucket, key)
         return "200 OK", "application/octet-stream", b"", {
             "ETag": f'"{etag}"', "X-Object-Size": str(size),
         }
+
+    async def _list_versions(self, bucket: str):
+        """GET /bucket?versions -> ListVersionsResult (Version +
+        DeleteMarker entries, newest first per key)."""
+        if not await self._bucket_exists(bucket):
+            raise S3Error("NoSuchBucket", bucket)
+        vers = await self.index.omap_get(versions_oid(bucket))
+        newest: Dict[str, str] = {}
+        for vk in vers:
+            if vk == "_seq":
+                continue
+            key, _, vid = vk.rpartition("\x00")
+            if vid > newest.get(key, ""):
+                newest[key] = vid
+        items = []
+        for vk in sorted(vers, reverse=True):
+            if vk == "_seq":
+                continue
+            key, _, vid = vk.rpartition("\x00")
+            size_s, etag, ts, kind = vers[vk].decode().split("\x00")
+            latest = "true" if newest.get(key) == vid else "false"
+            if kind == "marker":
+                items.append(
+                    f"<DeleteMarker><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest></DeleteMarker>")
+            else:
+                items.append(
+                    f"<Version><Key>{escape(key)}</Key>"
+                    f"<VersionId>{vid}</VersionId>"
+                    f"<IsLatest>{latest}</IsLatest>"
+                    f"<Size>{size_s}</Size>"
+                    f'<ETag>"{etag}"</ETag></Version>')
+        xml = ('<?xml version="1.0" encoding="UTF-8"?>'
+               f"<ListVersionsResult><Name>{escape(bucket)}</Name>"
+               + "".join(items) + "</ListVersionsResult>")
+        return "200 OK", "application/xml", xml.encode(), {}
 
     # -- multipart upload (reference rgw multipart meta objects:
     # RGWMultipartUpload in rgw_multi.cc -- an upload id names a meta
@@ -859,11 +1029,16 @@ class RGWGateway:
             blob += data
             md5s += bytes.fromhex(etag)
         final_etag = f"{hashlib.md5(md5s).hexdigest()}-{len(parts)}"
-        await self.backend.write(obj_oid(bucket, key), bytes(blob))
-        await self.index.omap_set(bucket_index_oid(bucket), {
-            key: f"{len(blob)}\x00{final_etag}\x00"
-                 f"{int(time.time())}".encode(),
-        })
+        extra_hdrs = {}
+        if await self._versioning_enabled(bucket):
+            extra_hdrs["x-amz-version-id"] = await self._store_version(
+                bucket, key, bytes(blob), final_etag)
+        else:
+            await self.backend.write(obj_oid(bucket, key), bytes(blob))
+            await self.index.omap_set(bucket_index_oid(bucket), {
+                key: f"{len(blob)}\x00{final_etag}\x00"
+                     f"{int(time.time())}".encode(),
+            })
         # a completed upload REPLACES the object: default-private, the
         # previous object's grants must not carry over
         await self.index.omap_rm(acl_oid(bucket), [key])
@@ -875,7 +1050,7 @@ class RGWGateway:
             f'<ETag>"{final_etag}"</ETag>'
             "</CompleteMultipartUploadResult>"
         )
-        return "200 OK", "application/xml", xml.encode(), {}
+        return "200 OK", "application/xml", xml.encode(), extra_hdrs
 
     async def _abort_multipart(self, bucket: str, key: str,
                                upload_id: str):
@@ -915,7 +1090,53 @@ class RGWGateway:
         )
         return "200 OK", "application/xml", xml.encode(), {}
 
-    async def _delete_object(self, bucket: str, key: str):
+    async def _delete_object(self, bucket: str, key: str,
+                             version_id: Optional[str] = None):
+        if version_id is not None:
+            # permanent removal of ONE version (S3 DELETE ?versionId);
+            # if it was current, the newest surviving put-version is
+            # promoted (or the key disappears from the plain namespace)
+            _s, _e, kind = await self._version_meta(bucket, key, version_id)
+            await self.index.omap_rm(
+                versions_oid(bucket), [f"{key}\x00{version_id}"])
+            if kind != "marker":
+                try:
+                    await self.backend.remove_object(
+                        obj_oid(bucket, key) if kind == "plain"
+                        else ver_obj_oid(bucket, key, version_id))
+                except IOError:
+                    pass
+            have_entry, cur = False, None
+            try:
+                _size, _etag, cur = await self._index_entry(bucket, key)
+                have_entry = True
+            except S3Error:
+                pass
+            if (have_entry and cur == version_id) or not have_entry:
+                # the removed version was current -- or a delete marker
+                # was on top (no plain-namespace entry): surface the
+                # newest surviving version.  A PLAIN current entry
+                # (have_entry, cur None) stays untouched.
+                await self._promote_latest_version(bucket, key)
+            return "204 No Content", "application/xml", b"", {}
+        if await self._versioning_enabled(bucket):
+            # versioned delete: a DELETE MARKER becomes the latest
+            # version; older versions stay readable by id (S3 semantics,
+            # reference olh delete-marker instances).  Idempotent like
+            # S3: deleting an already-hidden (or never-written) key
+            # still answers 204 and stacks a marker.
+            if not await self._bucket_exists(bucket):
+                raise S3Error("NoSuchBucket", bucket)
+            await self._archive_plain_current(bucket, key)
+            vid = await self._next_vid(bucket)
+            await self.index.omap_set(versions_oid(bucket), {
+                f"{key}\x00{vid}":
+                    f"0\x00\x00{int(time.time())}\x00marker".encode(),
+            })
+            await self.index.omap_rm(bucket_index_oid(bucket), [key])
+            await self.index.omap_rm(acl_oid(bucket), [key])
+            return "204 No Content", "application/xml", b"", {
+                "x-amz-version-id": vid, "x-amz-delete-marker": "true"}
         await self._index_entry(bucket, key)  # NoSuchKey check
         await self.index.omap_rm(bucket_index_oid(bucket), [key])
         await self.index.omap_rm(acl_oid(bucket), [key])  # its object ACL
@@ -924,3 +1145,36 @@ class RGWGateway:
         except IOError:
             pass  # zero-byte object: nothing was written
         return "204 No Content", "application/xml", b"", {}
+
+    async def _promote_latest_version(self, bucket: str, key: str) -> None:
+        """Re-point the plain-namespace index at the newest surviving
+        put-version of ``key`` (after its current version was removed);
+        a marker or nothing on top hides the key."""
+        vers = await self.index.omap_get(versions_oid(bucket))
+        best = None  # (vid, meta)
+        for vk, raw in vers.items():
+            if vk == "_seq":
+                continue
+            k, _, vid = vk.rpartition("\x00")
+            if k != key:
+                continue
+            if best is None or vid > best[0]:
+                best = (vid, raw)
+        if best is None:
+            await self.index.omap_rm(bucket_index_oid(bucket), [key])
+            return
+        size_s, etag, ts, kind = best[1].decode().split("\x00")
+        if kind == "marker":
+            await self.index.omap_rm(bucket_index_oid(bucket), [key])
+            return
+        if kind == "plain":
+            # the archived pre-versioning object resurfaces as a plain
+            # current (its data still lives at the plain oid)
+            await self.index.omap_set(bucket_index_oid(bucket), {
+                key: f"{size_s}\x00{etag}\x00{ts}".encode()})
+            await self.index.omap_rm(
+                versions_oid(bucket), [f"{key}\x00{best[0]}"])
+            return
+        await self.index.omap_set(bucket_index_oid(bucket), {
+            key: f"{size_s}\x00{etag}\x00{ts}\x00{best[0]}".encode(),
+        })

@@ -628,3 +628,122 @@ def test_acl_reset_on_overwrite():
         await c.shutdown()
 
     run(main())
+
+
+# -- object versioning (reference rgw olh versioning, rgw_rados.cc) ---------
+
+
+def test_object_versioning_lifecycle():
+    """Enable versioning; every PUT becomes a version, DELETE leaves a
+    marker hiding the key, old versions stay readable by id, version
+    listing shows Version + DeleteMarker entries, and deleting a marker
+    resurfaces the previous version (VERDICT r4 missing #3)."""
+
+    async def main():
+        c, gw, port = await _gateway()
+        await _request(port, "PUT", "/vb")
+        # enable + read back
+        st, _, _b = await _request(port, "PUT", "/vb?versioning",
+                                   body=b"<Status>Enabled</Status>")
+        assert st == 200
+        st, _, body = await _request(port, "GET", "/vb?versioning")
+        assert st == 200 and b"<Status>Enabled</Status>" in body
+        # two puts = two versions
+        st, h1, _b = await _request(port, "PUT", "/vb/doc", body=b"v one")
+        assert st == 200 and "x-amz-version-id" in h1
+        v1 = h1["x-amz-version-id"]
+        st, h2, _b = await _request(port, "PUT", "/vb/doc", body=b"v two")
+        v2 = h2["x-amz-version-id"]
+        assert v2 > v1
+        # plain GET serves the latest; explicit ids serve each version
+        st, hdrs, body = await _request(port, "GET", "/vb/doc")
+        assert body == b"v two" and hdrs["x-amz-version-id"] == v2
+        st, _, body = await _request(port, "GET",
+                                     f"/vb/doc?versionId={v1}")
+        assert st == 200 and body == b"v one"
+        # delete: marker hides the key, versions survive
+        st, hdrs, _b = await _request(port, "DELETE", "/vb/doc")
+        assert st == 204 and hdrs.get("x-amz-delete-marker") == "true"
+        marker = hdrs["x-amz-version-id"]
+        st, _, _b = await _request(port, "GET", "/vb/doc")
+        assert st == 404
+        st, _, body = await _request(port, "GET",
+                                     f"/vb/doc?versionId={v2}")
+        assert st == 200 and body == b"v two"
+        # listing shows both versions + the marker
+        st, _, body = await _request(port, "GET", "/vb?versions")
+        assert st == 200
+        assert body.count(b"<Version>") == 2
+        assert body.count(b"<DeleteMarker>") == 1
+        assert f"<VersionId>{marker}</VersionId>".encode() in body
+        # removing the marker resurfaces v2 as current
+        st, _, _b = await _request(port, "DELETE",
+                                   f"/vb/doc?versionId={marker}")
+        assert st == 204
+        st, _, body = await _request(port, "GET", "/vb/doc")
+        assert st == 200 and body == b"v two"
+        # removing the current version promotes v1
+        st, _, _b = await _request(port, "DELETE",
+                                   f"/vb/doc?versionId={v2}")
+        assert st == 204
+        st, _, body = await _request(port, "GET", "/vb/doc")
+        assert st == 200 and body == b"v one"
+        # a versioned bucket with surviving versions refuses deletion
+        st, _, _b = await _request(port, "DELETE", "/vb/doc")
+        assert st == 204  # marker again
+        st, _, body = await _request(port, "DELETE", "/vb")
+        assert st == 409 and b"BucketNotEmpty" in body
+        await gw.stop()
+        await c.shutdown()
+
+    run(main())
+
+
+def test_versioning_preserves_pre_versioning_object():
+    """Review r5 finding: the plain object written BEFORE versioning was
+    enabled survives as an archived version (the S3 null-version role)
+    and resurfaces when the newer versions are removed; listing a
+    versioned bucket must not crash on 4-field entries; versioned
+    DELETE is idempotent."""
+
+    async def main():
+        c, gw, port = await _gateway()
+        await _request(port, "PUT", "/nv")
+        await _request(port, "PUT", "/nv/doc", body=b"pre-versioning")
+        await _request(port, "PUT", "/nv?versioning",
+                       body=b"<Status>Enabled</Status>")
+        st, h2, _b = await _request(port, "PUT", "/nv/doc", body=b"v2")
+        v2 = h2["x-amz-version-id"]
+        # plain listing works on the versioned bucket (4-field entry)
+        st, _, body = await _request(port, "GET", "/nv")
+        assert st == 200 and b"doc" in body
+        # the archived plain object is listed and readable by id
+        st, _, body = await _request(port, "GET", "/nv?versions")
+        assert st == 200 and body.count(b"<Version>") == 2
+        import re
+
+        vids = sorted(re.findall(rb"<VersionId>(\d+)</VersionId>", body))
+        plain_vid = vids[0].decode()
+        st, _, body = await _request(
+            port, "GET", f"/nv/doc?versionId={plain_vid}")
+        assert st == 200 and body == b"pre-versioning"
+        # removing v2 promotes the archived plain object back to current
+        st, _, _b = await _request(port, "DELETE",
+                                   f"/nv/doc?versionId={v2}")
+        assert st == 204
+        st, _, body = await _request(port, "GET", "/nv/doc")
+        assert st == 200 and body == b"pre-versioning"
+        # idempotent versioned DELETE: two in a row both answer 204
+        st, _, _b = await _request(port, "DELETE", "/nv/doc")
+        assert st == 204
+        st, h, _b = await _request(port, "DELETE", "/nv/doc")
+        assert st == 204 and h.get("x-amz-delete-marker") == "true"
+        # versioning status reads back Suspended distinctly
+        await _request(port, "PUT", "/nv?versioning",
+                       body=b"<Status>Suspended</Status>")
+        st, _, body = await _request(port, "GET", "/nv?versioning")
+        assert b"<Status>Suspended</Status>" in body
+        await gw.stop()
+        await c.shutdown()
+
+    run(main())
