@@ -19,5 +19,6 @@ lands in data-pool objects, user/bucket metadata lives in meta objects
 """
 
 from ceph_tpu.rgw.gateway import RGWGateway, sign_v2, sign_v4
+from ceph_tpu.rgw.sync import RGWSyncAgent
 
-__all__ = ["RGWGateway", "sign_v2", "sign_v4"]
+__all__ = ["RGWGateway", "RGWSyncAgent", "sign_v2", "sign_v4"]
