@@ -49,7 +49,7 @@ class RGWSyncAgent:
             await self._sync_bucket(name, stats)
         # buckets deleted on the master vanish on the secondary
         for name in set(dst_buckets) - set(src_buckets):
-            await self._purge_bucket(name, dst_buckets[name], stats)
+            await self._purge_bucket(name, stats)
         return stats
 
     async def _sync_omap(self, oid: str, stats, counter: str) -> None:
@@ -90,7 +90,11 @@ class RGWSyncAgent:
             if vk != "_seq":
                 data_oid = self._version_data_oid(bucket, vk, raw)
                 if data_oid is not None:
-                    data = await self.src_data.read(data_oid)
+                    try:
+                        data = await self.src_data.read(data_oid)
+                    except IOError:
+                        continue  # deleted on the live master mid-pass:
+                        # the next pass converges (entry not recorded)
                     await self.dst_data.write(data_oid, data)
                     stats["versions_copied"] += 1
             await self.dst_index.omap_set(versions_oid(bucket), {vk: raw})
@@ -115,17 +119,27 @@ class RGWSyncAgent:
                 continue  # etag/size/vid unchanged: no data I/O
             parts = raw.decode().split("\x00")
             if len(parts) <= 3:  # plain object: ship the body
-                data = await self.src_data.read(obj_oid(bucket, key))
+                try:
+                    data = await self.src_data.read(obj_oid(bucket, key))
+                except IOError:
+                    continue  # deleted on the live master mid-pass
                 await self.dst_data.write(obj_oid(bucket, key), data)
             stats["objects_copied"] += 1
             await self.dst_index.omap_set(bucket_index_oid(bucket),
                                           {key: raw})
+        # plain bodies still referenced by an archived 'plain' version
+        # (the null-version role) must survive their index entry
+        plain_archived = {
+            vk.rpartition("\x00")[0] for vk, vraw in src_vers.items()
+            if vk != "_seq" and vraw.decode().split("\x00")[3] == "plain"
+        }
         for key in set(dst_idx) - set(src_idx):
             parts = dst_idx[key].decode().split("\x00")
-            if len(parts) <= 3:
+            if len(parts) <= 3 and key not in plain_archived:
                 # plain body owned by the index entry; version bodies
-                # stay -- a delete marker on the master hides the key
-                # but ?versionId reads must keep working (review r5)
+                # (incl. plain-archived ones) stay -- a delete marker on
+                # the master hides the key but ?versionId reads must
+                # keep working (review r5)
                 try:
                     await self.dst_data.remove_object(obj_oid(bucket, key))
                 except IOError:
@@ -133,7 +147,7 @@ class RGWSyncAgent:
             await self.dst_index.omap_rm(bucket_index_oid(bucket), [key])
             stats["objects_deleted"] += 1
 
-    async def _purge_bucket(self, bucket: str, raw: bytes, stats) -> None:
+    async def _purge_bucket(self, bucket: str, stats) -> None:
         idx = await self.dst_index.omap_get(bucket_index_oid(bucket))
         for key in idx:
             parts = idx[key].decode().split("\x00")

@@ -822,6 +822,25 @@ def test_multisite_sync_converges_secondary_zone():
         st, _, body = await _request(
             portb, "GET", f"/site/v.txt?versionId={v_ver2}")
         assert st == 200 and body == b"ver2"  # body survived the sync
+
+        # review r5 repro: a pre-versioning plain body archived as the
+        # null version must survive sync of its index-entry removal
+        await _request(porta, "PUT", "/nb")
+        await _request(porta, "PUT", "/nb/k", body=b"plainbody")
+        await agent.sync_once()
+        await _request(porta, "PUT", "/nb?versioning",
+                       body=b"<Status>Enabled</Status>")
+        await _request(porta, "DELETE", "/nb/k")  # archives plain + marker
+        await agent.sync_once()
+        import re as _re
+
+        st, _, body = await _request(porta, "GET", "/nb?versions")
+        pvid = _re.findall(rb"<VersionId>(\d+)</VersionId>"
+                           rb"<IsLatest>false</IsLatest>", body)[0].decode()
+        for port in (porta, portb):
+            st, _, body = await _request(
+                port, "GET", f"/nb/k?versionId={pvid}")
+            assert st == 200 and body == b"plainbody", (port, st)
         await gwa.stop()
         await gwb.stop()
         await a.shutdown()

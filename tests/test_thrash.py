@@ -46,20 +46,25 @@ def test_log_gating():
     get_config().apply_changes({"debug_ec": 0})
 
 
-def test_thrash_cluster():
-    PROFILE = {
-        "k": "4",
-        "m": "2",
-        "technique": "reed_sol_van",
-        "plugin": "jerasure",
-    }
+@pytest.mark.parametrize("pool_type,profile,max_read_down", [
+    ("erasure", {"k": "4", "m": "2", "technique": "reed_sol_van",
+                 "plugin": "jerasure"}, 2),
+    # replicated size=3 min_size=2: reads refuse once >= min_size placed
+    # replicas are unreachable (quorum-intersection rule), so the loop
+    # only reads with at most one of an object's replicas down
+    ("replicated", {"size": "3"}, 1),
+])
+def test_thrash_cluster(pool_type, profile, max_read_down):
+    """The qa thrasher loop, parameterized over BOTH pool types (the
+    round-4 verdict's done-criterion for the TYPE_REPLICATED seam)."""
 
     async def main():
         PerfCounters.reset_all()
         fault = FaultInjector(
             delay_probability=0.3, max_delay=0.002, seed=42
         )
-        cluster = ECCluster(10, dict(PROFILE), fault=fault)
+        cluster = ECCluster(10, dict(profile), fault=fault,
+                            pool_type=pool_type)
         rng = random.Random(7)
         objects = {}
         down = []
@@ -84,7 +89,7 @@ def test_thrash_cluster():
                 objects[oid] = data
             elif oid in objects:
                 n_down_shards = sum(a in down for a in acting)
-                if n_down_shards <= 2:
+                if n_down_shards <= max_read_down:
                     got = await cluster.read(oid)
                     assert got == objects[oid], f"round {round_no} {oid}"
         for osd in list(down):
