@@ -39,6 +39,10 @@ class KeyRing:
     def get(self, entity: str) -> Optional[bytes]:
         return self._keys.get(entity)
 
+    def remove(self, entity: str) -> None:
+        """Revoke an entity's key (the `auth rm` flow)."""
+        self._keys.pop(entity, None)
+
     def entities(self):
         return sorted(self._keys)
 

@@ -31,7 +31,12 @@ async def serve(args) -> None:
     with open(args.addr_map) as f:
         addr_map = {k: tuple(v) for k, v in json.load(f).items()}
     name = f"mon.{args.rank}"
-    messenger = TCPMessenger(name, addr_map)
+    keyring = None
+    if args.keyring:
+        from ceph_tpu.auth import KeyRing
+
+        keyring = KeyRing.load(args.keyring)
+    messenger = TCPMessenger(name, addr_map, keyring=keyring)
     await messenger.start()
     mon = Monitor(args.rank, args.mons, messenger,
                   store_path=args.store_path or None)
@@ -92,6 +97,10 @@ def main(argv=None) -> int:
     ap.add_argument("--mons", type=int, required=True)
     ap.add_argument("--addr-map", required=True)
     ap.add_argument("--store-path", default="")
+    ap.add_argument("--keyring", default="",
+                    help="keyring enabling cephx-style auth; entities "
+                         "minted later via `auth get-or-create` are "
+                         "learned from the replicated AuthDB")
     ap.add_argument("--admin-socket", default="")
     ap.add_argument("--settle", type=float, default=0.5,
                     help="seconds rank 0 waits before the first election")

@@ -250,6 +250,26 @@ class Monitor:
             return "clog"
         if op.startswith("auth_"):
             self.authdb.apply(inc)
+            # a mon running with cephx verifies CONNECTING peers against
+            # its own keyring: keys minted/rotated through the AuthDB
+            # must flow into it, or daemons provisioned via
+            # `auth get-or-create` could never connect (the reference
+            # mon validates against its auth database the same way)
+            ring = getattr(self.messenger, "keyring", None)
+            if ring is not None:
+                ent = inc.get("entity")
+                if op in ("auth_add", "auth_rotate") and ent is not None:
+                    have = self.authdb.entities.get(ent)
+                    if have is not None:
+                        try:
+                            ring.add(ent, bytes.fromhex(have["key"]))
+                        except ValueError:
+                            pass  # non-hex externally-set key: skip
+                elif op == "auth_rm" and ent is not None:
+                    # revocation must bite: a removed entity can no
+                    # longer complete the cephx handshake (store replay
+                    # re-applies add THEN rm, converging removed)
+                    ring.remove(ent)
             return "auth"
         if op.startswith("mgr_"):
             self.mgrmap.apply(inc)

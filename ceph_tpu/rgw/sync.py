@@ -127,13 +127,15 @@ class RGWSyncAgent:
             stats["objects_copied"] += 1
             await self.dst_index.omap_set(bucket_index_oid(bucket),
                                           {key: raw})
+        gone_keys = set(dst_idx) - set(src_idx)
         # plain bodies still referenced by an archived 'plain' version
-        # (the null-version role) must survive their index entry
-        plain_archived = {
+        # (the null-version role) must survive their index entry; only
+        # worth computing when there are deletions to guard
+        plain_archived = set() if not gone_keys else {
             vk.rpartition("\x00")[0] for vk, vraw in src_vers.items()
             if vk != "_seq" and vraw.decode().split("\x00")[3] == "plain"
         }
-        for key in set(dst_idx) - set(src_idx):
+        for key in gone_keys:
             parts = dst_idx[key].decode().split("\x00")
             if len(parts) <= 3 and key not in plain_archived:
                 # plain body owned by the index entry; version bodies

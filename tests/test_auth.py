@@ -96,3 +96,64 @@ def test_authed_process_cluster_roundtrip(tmp_path):
         asyncio.run(run())
     finally:
         vstart.stop_cluster(run_dir)
+
+
+def test_mon_backed_key_provisioning():
+    """vstart --mons --auth: only mon + bootstrap-client keys exist
+    locally; OSD keys are minted THROUGH the AuthMonitor
+    (`auth get-or-create`) and flow into the daemons' keyring; signed
+    I/O then works end to end (the ceph-authtool provisioning flow,
+    reference src/mon/AuthMonitor.cc)."""
+    import asyncio
+    import json
+    import os as _os
+    import sys as _sys
+    import tempfile
+
+    _sys.path.insert(0, _os.path.join(
+        _os.path.dirname(__file__), "..", "tools"))
+    import vstart
+    from ceph_tpu.auth import KeyRing
+
+    with tempfile.TemporaryDirectory() as run_dir:
+        vstart.start_cluster(run_dir, 4,
+                             {"plugin": "jerasure", "k": "2", "m": "1"},
+                             wait=30.0, auth=True, n_mons=3)
+
+        async def run():
+            from ceph_tpu.daemon.client import RemoteClient
+
+            conf = json.load(open(f"{run_dir}/cluster.json"))
+            c = await RemoteClient.connect(
+                f"{run_dir}/addr_map.json", conf["profile"],
+                keyring=f"{run_dir}/keyring")
+            await c.write("obj", b"mon-minted-keys")
+            assert await c.read("obj") == b"mon-minted-keys"
+            await c.close()
+            # the keyring's OSD keys came from the mon: `auth get` over
+            # the mon command path returns the same secrets
+            from ceph_tpu.mon.monitor import MonClient
+            from ceph_tpu.msg.tcp import TCPMessenger
+
+            with open(f"{run_dir}/addr_map.json") as f:
+                addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+            ring = KeyRing.load(f"{run_dir}/keyring")
+            ms = TCPMessenger("client", addr_map, keyring=ring)
+            await ms.start()
+            monc = MonClient(ms, 3, "client")
+
+            async def dispatch(src, msg):
+                if isinstance(msg, dict):
+                    await monc.handle_reply(msg)
+
+            ms.register("client", dispatch)
+            rc, out = await monc.command(
+                {"prefix": "auth get", "entity": "osd.0"}, timeout=5.0)
+            assert rc == 0
+            assert bytes.fromhex(out["key"]) == ring.get("osd.0")
+            await ms.shutdown()
+
+        try:
+            asyncio.run(run())
+        finally:
+            vstart.stop_cluster(run_dir)

@@ -275,3 +275,31 @@ def test_mds_monitor_fsmap_ranks_and_failover():
         await ms.shutdown()
 
     run(main())
+
+
+def test_auth_rm_revokes_messenger_key():
+    """Review r5 finding: `auth rm` must also revoke the key from the
+    mon's messenger keyring, or the removed entity could keep passing
+    the cephx handshake."""
+    from ceph_tpu.auth import KeyRing
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        # attach a keyring to every mon's messenger view (shared bus)
+        ms.keyring = KeyRing()
+        cl, _ = _client(ms, "client0")
+        rc, out = await cl.command({
+            "prefix": "auth get-or-create", "entity": "osd.9"})
+        assert rc == 0
+        for m in mc.mons:
+            assert ms.keyring.get("osd.9") == bytes.fromhex(out["key"])
+            break  # shared ring: one check suffices
+        rc, _o = await cl.command({"prefix": "auth rm",
+                                   "entity": "osd.9"})
+        assert rc == 0
+        assert ms.keyring.get("osd.9") is None
+        await ms.shutdown()
+
+    run(main())

@@ -73,8 +73,18 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
         from ceph_tpu.auth import KeyRing
 
         ring = KeyRing()
-        for entity in addr_map:
-            ring.add(entity)
+        if n_mons:
+            # mon-backed provisioning (the ceph-deploy/ceph-authtool
+            # bootstrap flow): only the mon + bootstrap-client keys are
+            # generated locally; OSD keys are minted THROUGH the
+            # AuthMonitor (`auth get-or-create`) during bootstrap and
+            # appended to the keyring before the OSDs spawn
+            for r in range(n_mons):
+                ring.add(f"mon.{r}")
+            ring.add("client")
+        else:
+            for entity in addr_map:
+                ring.add(entity)
         ring.save(os.path.join(run_dir, "keyring"))
     with open(os.path.join(run_dir, "cluster.json"), "w") as f:
         json.dump({"profile": profile, "n_osds": n_osds,
@@ -83,18 +93,19 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
     data_path = os.path.join(run_dir, "data")
     if n_mons:
         mon_deadline = time.time() + wait
-        mon_pids = {r: spawn_mon(run_dir, r, n_mons)
+        mon_pids = {r: spawn_mon(run_dir, r, n_mons, auth=auth)
                     for r in range(n_mons)}
         with open(os.path.join(run_dir, "mon_pids"), "w") as f:
             json.dump({str(r): p for r, p in mon_pids.items()}, f)
         for r in range(n_mons):
             _wait_port(addr_map[f"mon.{r}"], mon_deadline, f"mon.{r}")
         # pools flow mon -> daemons: create them BEFORE the osds boot so
-        # the subscription's first map already carries them
+        # the subscription's first map already carries them; with auth,
+        # OSD keys are minted through the AuthMonitor here too
         import asyncio as _asyncio
 
         _asyncio.new_event_loop().run_until_complete(
-            _bootstrap_pools(run_dir, n_osds, profile)
+            _bootstrap_pools(run_dir, n_osds, profile, auth=auth)
         )
     pids = {}
     for i in range(n_osds):
@@ -107,7 +118,43 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
     deadline = time.time() + wait
     for i in range(n_osds):
         _wait_port(addr_map[f"osd.{i}"], deadline, f"osd.{i}")
+    if n_mons:
+        # mon-integrated daemons learn their pools from the osdmap
+        # SUBSCRIPTION after boot: a client dispatching the instant the
+        # ports open can land on an OSD that hosts no pool yet.  Poll
+        # the admin sockets until every daemon hosts the pool.
+        _wait_pools(n_osds, data_path, deadline + wait)
     return map_path
+
+
+def _wait_pools(n_osds, data_path, deadline):
+    import asyncio
+
+    from ceph_tpu.utils.admin_socket import admin_command
+
+    async def ready(i):
+        try:
+            st = await admin_command(
+                os.path.join(data_path, f"osd.{i}.asok"), "status")
+            return bool(st.get("pools"))
+        except (OSError, ValueError):
+            # ValueError covers a daemon dying mid-reply (empty/truncated
+            # JSON); either way this OSD is simply not ready yet
+            return False
+
+    async def wait_all():
+        pending = set(range(n_osds))
+        while pending:
+            done = {i for i in pending if await ready(i)}
+            pending -= done
+            if not pending:
+                return
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"osds {sorted(pending)} never hosted the pool")
+            await asyncio.sleep(0.1)
+
+    asyncio.new_event_loop().run_until_complete(wait_all())
 
 
 def _wait_port(addr, deadline, who):
@@ -122,7 +169,7 @@ def _wait_port(addr, deadline, who):
             time.sleep(0.05)
 
 
-def spawn_mon(run_dir, rank, n_mons):
+def spawn_mon(run_dir, rank, n_mons, auth=False):
     """Start one monitor daemon process; returns its pid."""
     log = open(os.path.join(run_dir, f"mon.{rank}.log"), "ab")
     store = os.path.join(run_dir, "mon", str(rank))
@@ -131,15 +178,20 @@ def spawn_mon(run_dir, rank, n_mons):
            "--rank", str(rank), "--mons", str(n_mons),
            "--addr-map", os.path.join(run_dir, "addr_map.json"),
            "--store-path", store]
+    if auth:
+        cmd += ["--keyring", os.path.join(run_dir, "keyring")]
     proc = subprocess.Popen(
         cmd, stdout=log, stderr=log, env=_daemon_env(), cwd=REPO,
     )
     return proc.pid
 
 
-async def _bootstrap_pools(run_dir, n_osds, profile, pool="ecpool"):
+async def _bootstrap_pools(run_dir, n_osds, profile, pool="ecpool",
+                           auth=False):
     """Create osds + the pool through the mon quorum (the `ceph osd ...`
-    command flow, reference src/mon/OSDMonitor.cc)."""
+    command flow, reference src/mon/OSDMonitor.cc); with auth, mint the
+    OSD keys through the AuthMonitor and append them to the keyring the
+    daemons will load (the ceph-authtool provisioning flow)."""
     import asyncio
 
     from ceph_tpu.mon.monitor import MonClient
@@ -148,7 +200,12 @@ async def _bootstrap_pools(run_dir, n_osds, profile, pool="ecpool"):
     with open(os.path.join(run_dir, "addr_map.json")) as f:
         addr_map = {k: tuple(v) for k, v in json.load(f).items()}
     n_mons = sum(1 for k in addr_map if k.startswith("mon."))
-    ms = TCPMessenger("client", addr_map)
+    keyring = None
+    if auth:
+        from ceph_tpu.auth import KeyRing
+
+        keyring = KeyRing.load(os.path.join(run_dir, "keyring"))
+    ms = TCPMessenger("client", addr_map, keyring=keyring)
     await ms.start()
     monc = MonClient(ms, n_mons, "client")
 
@@ -186,6 +243,19 @@ async def _bootstrap_pools(run_dir, n_osds, profile, pool="ecpool"):
             })
         if rc != 0:
             raise RuntimeError(f"pool create: {out}")
+        if auth:
+            # mint the OSD keys through the AuthMonitor and persist them
+            # for the daemons (reference: `ceph auth get-or-create osd.N`
+            # at provisioning time)
+            for i in range(n_osds):
+                rc, out = await monc.command({
+                    "prefix": "auth get-or-create", "entity": f"osd.{i}",
+                    "caps": {"osd": "allow *"},
+                }, timeout=5.0)
+                if rc != 0:
+                    raise RuntimeError(f"auth get-or-create osd.{i}: {out}")
+                keyring.add(f"osd.{i}", bytes.fromhex(out["key"]))
+            keyring.save(os.path.join(run_dir, "keyring"))
     finally:
         await ms.shutdown()
 
