@@ -249,6 +249,10 @@ class Monitor:
             self.clog.apply(inc)
             return "clog"
         if op.startswith("auth_"):
+            # membership BEFORE apply: the keyring hook below must only
+            # revoke AuthDB-managed entities, never file-provisioned
+            # quorum/admin keys (mon.*, client) that share the ring
+            was_managed = inc.get("entity") in self.authdb.entities
             self.authdb.apply(inc)
             # a mon running with cephx verifies CONNECTING peers against
             # its own keyring: keys minted/rotated through the AuthDB
@@ -265,7 +269,7 @@ class Monitor:
                             ring.add(ent, bytes.fromhex(have["key"]))
                         except ValueError:
                             pass  # non-hex externally-set key: skip
-                elif op == "auth_rm" and ent is not None:
+                elif op == "auth_rm" and ent is not None and was_managed:
                     # revocation must bite: a removed entity can no
                     # longer complete the cephx handshake (store replay
                     # re-applies add THEN rm, converging removed)
@@ -607,6 +611,8 @@ class Monitor:
                 "op": "auth_rotate", "entity": cmd["entity"], "key": key})
             return (0, {"key": key}) if ok else (-11, "no quorum")
         if prefix == "auth rm":
+            if cmd["entity"] not in self.authdb.entities:
+                return -2, "not found"  # never strips file-provisioned keys
             ok = await self._propose(
                 {"op": "auth_rm", "entity": cmd["entity"]})
             return (0, "") if ok else (-11, "no quorum")

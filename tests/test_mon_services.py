@@ -303,3 +303,25 @@ def test_auth_rm_revokes_messenger_key():
         await ms.shutdown()
 
     run(main())
+
+
+def test_auth_rm_never_strips_provisioned_keys():
+    """Review r5: `auth rm` of an entity the AuthDB never managed (a
+    file-provisioned mon/client key) is -ENOENT and leaves the
+    messenger keyring intact."""
+    from ceph_tpu.auth import KeyRing
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        ms.keyring = KeyRing()
+        monkey = ms.keyring.add("mon.1")
+        cl, _ = _client(ms, "client0")
+        rc, _o = await cl.command({"prefix": "auth rm",
+                                   "entity": "mon.1"})
+        assert rc == -2
+        assert ms.keyring.get("mon.1") == monkey
+        await ms.shutdown()
+
+    run(main())

@@ -12,7 +12,7 @@ Commands:
     osd erasure-code-profile get <name>
     osd erasure-code-profile ls
     osd erasure-code-profile rm <name>
-    osd pool create <pool> erasure [<profile>]
+    osd pool create <pool> erasure [<profile>] | replicated [<size>]
     osd pool ls
     status
     compression ls
@@ -95,7 +95,8 @@ def main(argv=None) -> int:
             return 0
         if args[:3] == ["osd", "erasure-code-profile", "rm"]:
             name = args[3]
-            used = [p for p, meta in state["pools"].items() if meta["profile"] == name]
+            used = [p for p, meta in state["pools"].items()
+                    if meta.get("profile") == name]  # replicated: no profile
             if used:
                 print(f"profile {name} is in use by pools {used}", file=sys.stderr)
                 return 1
@@ -104,11 +105,25 @@ def main(argv=None) -> int:
             return 0
         if args[:3] == ["osd", "pool", "create"]:
             pool = args[3]
-            assert args[4] == "erasure", "only erasure pools supported"
+            kind = args[4]  # type REQUIRED (omitting it is usage rc 2,
+            # as before; the reference CLI also takes it explicitly)
+            if kind == "replicated":
+                # `ceph osd pool create <pool> replicated [<size>]`
+                # (reference OSDMonitor::prepare_new_pool TYPE_REPLICATED)
+                size = int(args[5]) if len(args) > 5 else 3
+                assert size >= 1, f"bad size {size}"
+                info = {"pool_type": "replicated", "size": size,
+                        "min_size": max(1, size - size // 2)}
+                state["pools"][pool] = dict(info)
+                save_state(state_path, state)
+                out({"pool": pool, **info})
+                return 0
+            assert kind == "erasure", f"unknown pool type {kind!r}"
             prof_name = args[5] if len(args) > 5 else "default"
             profile = state["profiles"][prof_name]
             info = validate_profile(profile)
-            state["pools"][pool] = {"profile": prof_name, **info}
+            state["pools"][pool] = {
+                "pool_type": "erasure", "profile": prof_name, **info}
             save_state(state_path, state)
             out({"pool": pool, "profile": prof_name, **info})
             return 0
