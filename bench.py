@@ -474,6 +474,20 @@ def main() -> int:
     dev_dec = _secondary(_device_resident_decode_gibps)
     storage = _secondary(_storage_path_device_gibps)
 
+    def _storage_path_host():
+        """Round-6 tentpole metric: the HOST OSD storage path (assemble /
+        transpose / encode / commit + signature-grouped degraded decode)
+        with concurrent writers, per-op vs coalesced, bit-exactness gated
+        before timing.  Runs on the cpu-fallback harness too -- no relay
+        dependency (ceph_tpu/osd/storage_bench.py)."""
+        from ceph_tpu.osd.storage_bench import run_storage_path_bench
+
+        return run_storage_path_bench(
+            tpu_ec, n_objects=64, obj_bytes=1 << 14, writers=8, iters=2
+        )
+
+    sp_host = _secondary(_storage_path_host)
+
     def _r3(v):
         return round(v, 3) if v is not None else None
 
@@ -493,6 +507,15 @@ def main() -> int:
         "device_resident_GiBs": _r3(dev),
         "device_resident_decode_GiBs": _r3(dev_dec),
         "storage_path_device_GiBs": _r3(storage),
+        "storage_path_host_perop_GiBs": _r3(
+            sp_host["per_op"]["write_GiBs"]) if sp_host else None,
+        "storage_path_host_coalesced_GiBs": _r3(
+            sp_host["coalesced"]["write_GiBs"]) if sp_host else None,
+        "storage_path_host_write_speedup": (
+            sp_host["write_speedup"] if sp_host else None),
+        "storage_path_host_read_speedup": (
+            sp_host["read_speedup"] if sp_host else None),
+        "storage_path_host": sp_host,
         "platform": jax.devices()[0].platform + (
             "-fallback"
             if os.environ.get("CEPH_TPU_BENCH_FALLBACK")
@@ -510,7 +533,8 @@ def main() -> int:
         f"tool-path tpu encode {enc:.3f} / decode {dec:.3f} GiB/s vs cpu "
         f"{cpu_combined:.3f}; tunnel h2d {h2d:.3f} d2h {d2h:.3f} -> encode "
         f"ceiling {ceiling:.3f}; device-resident {dev} GiB/s, "
-        f"storage-path {storage} GiB/s on "
+        f"storage-path {storage} GiB/s, host storage-path coalesced "
+        f"{sp_host['write_speedup'] if sp_host else '?'}x per-op on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

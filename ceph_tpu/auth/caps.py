@@ -136,3 +136,32 @@ OP_NEEDS = {
 def op_capable(cap: OSDCap, pool: str, obj: str, op_kind: str) -> bool:
     need_r, need_w, need_x = OP_NEEDS.get(op_kind, (True, True, False))
     return cap.is_capable(pool, obj, need_r, need_w, need_x)
+
+
+class MonCap:
+    """Minimal monitor capability (reference: src/mon/MonCap.{h,cc}).
+
+    Only the decision the AuthMonitor needs is modeled: does this entity
+    hold mon ADMIN authority (``allow *`` / ``allow all`` / ``allow
+    profile admin``)?  Service profiles (``allow profile osd`` etc.) and
+    r/w grants parse without error but confer no admin authority --
+    exactly the property that stops a minted osd.* key from minting or
+    revoking other keys.
+    """
+
+    def __init__(self, admin: bool = False):
+        self.admin = admin
+
+    @classmethod
+    def parse(cls, caps: str) -> "MonCap":
+        admin = False
+        for clause in (caps or "").split(","):
+            toks = clause.split()
+            if toks[:2] in (["allow", "*"], ["allow", "all"]):
+                admin = True
+            elif toks[:3] == ["allow", "profile", "admin"]:
+                admin = True
+        return cls(admin)
+
+    def is_admin(self) -> bool:
+        return self.admin

@@ -344,7 +344,12 @@ class Monitor:
                 fwd["reply_to"] = src
                 await self._send_to_rank(self.leader, fwd)
             return
-        rc, out = await self.do_command(cmd)
+        # authenticated caller for cap checks: the wire source, or the
+        # original requester when a peer mon forwarded.  A reply_to set
+        # by anything that is NOT a quorum peer is a spoof attempt and
+        # is ignored for authorization purposes.
+        caller = msg.get("reply_to") if src.startswith("mon.") else src
+        rc, out = await self.do_command(cmd, caller=caller or src)
         await self.messenger.send_message(
             self.name,
             msg.get("reply_to", src),
@@ -371,9 +376,45 @@ class Monitor:
                     return True
             return False
 
-    async def do_command(self, cmd: dict):
-        """Returns (rc, out).  Command names follow the ceph CLI."""
+    #: AuthMonitor mutations: minting, rotating, revoking or re-capping
+    #: keys needs mon admin capability (reference: MonCap gates on
+    #: 'allow *' / 'allow profile admin'; an osd.* service key minted via
+    #: get-or-create must NOT be able to mint or revoke other keys)
+    _AUTH_MUTATIONS = ("auth get-or-create", "auth rotate", "auth rm",
+                      "auth caps")
+
+    def _caller_admin_capable(self, caller: Optional[str]) -> bool:
+        """Minimal mon-cap check mirroring the OSDCap enforcement model
+        (ceph_tpu/osd/shard.py client_caps): entities with a registered
+        AuthDB record are confined to their mon caps; unregistered
+        entities (file-provisioned admin/bootstrap keys, open clusters
+        without cephx) keep the open default; quorum peers and local
+        (in-process, caller=None) invocations are trusted."""
+        if caller is None:
+            return True
+        ent = caller.split("[")[0]
+        if ent.startswith("mon."):
+            return True
+        rec = self.authdb.entities.get(ent)
+        if rec is None:
+            return True
+        from ceph_tpu.auth.caps import MonCap
+
+        return MonCap.parse((rec.get("caps") or {}).get("mon", "")).is_admin()
+
+    async def do_command(self, cmd: dict, caller: Optional[str] = None):
+        """Returns (rc, out).  Command names follow the ceph CLI.
+        ``caller`` is the authenticated wire entity (None for local
+        invocations); AuthMonitor mutations are gated on its mon caps."""
         prefix = cmd.get("prefix", "")
+        if prefix in self._AUTH_MUTATIONS and \
+                not self._caller_admin_capable(caller):
+            self.clog.apply({
+                "op": "clog_append", "who": self.name, "level": "warn",
+                "message": f"denied '{prefix}' from {caller}: no mon "
+                           f"admin capability", "stamp": 0.0,
+            })
+            return -13, f"access denied: {caller} lacks mon admin caps"
         if prefix == "status":
             return 0, {
                 "quorum": self.quorum,
@@ -507,12 +548,22 @@ class Monitor:
             ec = registry_mod.instance().factory(
                 plugin, {k: v for k, v in profile.items() if k != "plugin"}
             )
+            ec_k = ec.get_data_chunk_count()
+            ec_m = ec.get_chunk_count() - ec_k
+            # EC min_size default k + min(1, m-1) (reference
+            # OSDMonitor::prepare_new_pool pg_pool_t): a write accepted
+            # with exactly k shards up has zero redundancy
+            min_size = int(cmd.get(
+                "min_size", ec_k + min(1, max(0, ec_m - 1))))
+            if not ec_k <= min_size <= ec_k + ec_m:
+                return -22, f"bad min_size {min_size} (k={ec_k} m={ec_m})"
             pool = {
                 "name": name,
                 "pool_type": "erasure",
                 "profile_name": pname,
-                "k": ec.get_data_chunk_count(),
-                "m": ec.get_chunk_count() - ec.get_data_chunk_count(),
+                "k": ec_k,
+                "m": ec_m,
+                "min_size": min_size,
                 "pg_num": cmd.get("pg_num", 128),
                 "hosts": cmd.get("hosts"),
             }

@@ -189,14 +189,26 @@ class TCPMessenger:
         for conn in self._conns.values():
             conn[1].close()
         self._conns.clear()
-        pending = list(self._tasks.values()) + list(self._serve_tasks)
-        for task in pending:
-            task.cancel()
-        for task in pending:
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+        # cancel in ROUNDS (mirrors the in-process Messenger.shutdown):
+        # under py<3.11 asyncio.wait_for can swallow a cancellation that
+        # races its future's completion (bpo-42130); a tick loop that
+        # lost its one cancel that way keeps running and a single
+        # unbounded `await task` here then wedges the daemon inside its
+        # SIGTERM handler -- the process never exits and the caller's
+        # waitpid hangs.  Re-cancelling lands the next CancelledError at
+        # the task's next await point; bounded rounds keep shutdown
+        # finite no matter what.
+        pending = [
+            t for t in list(self._tasks.values()) + list(self._serve_tasks)
+            if not t.done()
+        ]
+        for _ in range(50):
+            if not pending:
+                break
+            for task in pending:
+                task.cancel()
+            _done, still = await asyncio.wait(pending, timeout=0.5)
+            pending = list(still)
         if self._server is not None:
             await self._server.wait_closed()
 

@@ -39,6 +39,7 @@ class ECCluster:
         data_path: str = "",
         pool: str = "ecpool",
         pool_type: str = "erasure",
+        min_size: Optional[int] = None,
     ):
         self.messenger = Messenger(fault)
         self.osds: List[OSDShard] = [
@@ -68,7 +69,7 @@ class ECCluster:
         # the placement object (weight updates propagate to everyone)
         for osd in self.osds:
             osd.host_pool(pool, self.ec, n_osds, placement,
-                          pool_type=pool_type, size=km)
+                          pool_type=pool_type, size=km, min_size=min_size)
         self.backend = Objecter(
             self.messenger, km, n_osds, placement=placement, pool=pool,
         )

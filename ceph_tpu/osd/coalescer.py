@@ -1,0 +1,146 @@
+"""Per-PG codec batching for the OSD EC data path (the encode coalescer).
+
+Round-2 built the async stripe-batching pipeline (``ceph_tpu/ops/
+pipeline.py``) but wired it only into the plugin/tool surface
+(``encode_batch``/``decode_batch``); every ECBackend client op still made
+one synchronous per-op codec call.  With the device kernel closed at
+~45 GiB/s (PERF_NOTES round 4) the per-op dispatch overhead IS the
+storage-path bottleneck -- exactly the pattern "Understanding System
+Characteristics of Online Erasure Coding" documents: once the codec is
+fast, datapath overheads dominate.
+
+This module is the seam that closes the gap: concurrent in-flight client
+ops on one PG gather their codec work into batched dispatches.
+
+Flush policy (documented in docs/ec-storage-path.md):
+
+* **queue-drain**: the first submission of a batch schedules a flush via
+  ``loop.call_soon``, i.e. the batch dispatches at the end of the current
+  event-loop tick, after every already-runnable task has had its chance
+  to add its stripe.  Latency cost is bounded by one loop tick; a lone
+  write is dispatched immediately on the next callback slot.
+* **size threshold**: a batch that reaches ``max_batch`` items or
+  ``max_bytes`` payload bytes dispatches immediately (bounded memory).
+* **bounded depth**: at most ``depth`` batched dispatches run
+  concurrently; excess batches queue behind a semaphore.
+
+Deadlock-freedom argument (mirrors the round-4 dispatch throttle's
+scoping): only CLIENT ops route through the coalescer -- recovery,
+scrub and peering reconstruction keep their direct codec calls -- and a
+flush depends on nothing but the event loop running (``call_soon`` always
+fires; it never waits on another op's completion, an ack, or a quota
+held by a queued op).  Submitters await only their own future, and the
+dispatch function never re-enters the coalescer, so no cycle of waits
+can form.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Awaitable, Callable, List, Optional, Sequence
+
+from ceph_tpu.utils.perf import PerfCounters
+
+#: default flush thresholds: a batch larger than this dispatches without
+#: waiting for the tick to end
+DEFAULT_MAX_BATCH = 64
+DEFAULT_MAX_BYTES = 64 << 20
+#: bounded in-flight batched dispatches (the pipeline overlaps granules
+#: internally; this bounds whole-batch concurrency)
+DEFAULT_DEPTH = 2
+
+
+class BatchCoalescer:
+    """Gathers same-kind work items submitted in one event-loop tick into
+    one batched dispatch.
+
+    ``dispatch_many(items) -> results`` is called with every item of a
+    batch (in submission order) and must return one result per item; it
+    may be sync or async.  ``submit(item, nbytes)`` awaits that item's
+    result.  Per-instance, single-event-loop; not thread-safe (the OSD
+    data path is asyncio-single-threaded by construction).
+    """
+
+    def __init__(
+        self,
+        dispatch_many: Callable[[List], "Sequence | Awaitable[Sequence]"],
+        *,
+        max_batch: int = DEFAULT_MAX_BATCH,
+        max_bytes: int = DEFAULT_MAX_BYTES,
+        depth: int = DEFAULT_DEPTH,
+        perf: Optional[PerfCounters] = None,
+        counter: str = "coalesce",
+    ):
+        self._dispatch_many = dispatch_many
+        self.max_batch = max_batch
+        self.max_bytes = max_bytes
+        self._sem = asyncio.Semaphore(max(1, depth))
+        self._pending: List[tuple] = []  # (item, future)
+        self._pending_bytes = 0
+        self._flush_scheduled = False
+        self.perf = perf
+        self._counter = counter
+
+    # -- submission ---------------------------------------------------------
+
+    async def submit(self, item, nbytes: int = 0):
+        """Queue one work item; resolves with its dispatch result."""
+        loop = asyncio.get_event_loop()
+        fut = loop.create_future()
+        self._pending.append((item, fut))
+        self._pending_bytes += nbytes
+        if (
+            len(self._pending) >= self.max_batch
+            or self._pending_bytes >= self.max_bytes
+        ):
+            self._spawn_flush(loop)
+        elif not self._flush_scheduled:
+            # queue-drain flush: end of the current tick, so every task
+            # runnable RIGHT NOW can still join this batch
+            self._flush_scheduled = True
+            loop.call_soon(self._on_tick_end, loop)
+        return await fut
+
+    def _on_tick_end(self, loop) -> None:
+        self._flush_scheduled = False
+        if self._pending:
+            self._spawn_flush(loop)
+
+    def _spawn_flush(self, loop) -> None:
+        batch, self._pending = self._pending, []
+        self._pending_bytes = 0
+        task = loop.create_task(self._run_batch(batch))
+        # keep a strong reference until the batch lands (asyncio tasks
+        # are otherwise collectable mid-flight)
+        refs = getattr(self, "_tasks", None)
+        if refs is None:
+            refs = self._tasks = set()
+        refs.add(task)
+        task.add_done_callback(refs.discard)
+
+    async def _run_batch(self, batch: List[tuple]) -> None:
+        async with self._sem:
+            items = [item for item, _fut in batch]
+            try:
+                results = self._dispatch_many(items)
+                if asyncio.iscoroutine(results):
+                    results = await results
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 -- each waiter gets the
+                # failure; the coalescer itself stays serviceable
+                for _item, fut in batch:
+                    if not fut.done():
+                        fut.set_exception(
+                            type(e)(*e.args) if e.args else IOError(str(e))
+                        )
+                return
+            if self.perf is not None:
+                self.perf.inc(self._counter)
+                self.perf.inc(f"{self._counter}_items", len(batch))
+                if len(batch) > 1:
+                    self.perf.inc(f"{self._counter}_batched",
+                                  len(batch))
+            for (_item, fut), res in zip(batch, results):
+                if not fut.done():
+                    fut.set_result(res)

@@ -77,19 +77,72 @@ class ECBackend(PG):
         register: bool = True,
         tid_alloc=None,
         perf: Optional[PerfCounters] = None,
+        min_size: Optional[int] = None,
+        coalesce: Optional[bool] = None,
     ):
         self.ec = ec
         self.k = ec.get_data_chunk_count()
         self.km = ec.get_chunk_count()
         self.m = self.km - self.k
-        #: EC pools need k live shards to accept writes (min_size role)
-        self.min_size = self.k
+        #: write-acceptance floor: the reference defaults EC min_size to
+        #: k + min(1, m-1) (OSDMonitor::prepare_new_pool pg_pool_t) --
+        #: accepting a write with exactly k shards up would commit it
+        #: with zero redundancy.  m == 1 keeps k (no redundancy exists
+        #: to demand); an explicit pool min_size overrides.
+        self.min_size = min_size if min_size is not None else (
+            self.k + min(1, max(0, self.m - 1))
+        )
         stripe_width = self.k * ec.get_chunk_size(1)
         self.sinfo = ecutil.StripeInfo(self.k, stripe_width)
         super().__init__(
             osds, messenger, name=name, placement=placement,
             register=register, tid_alloc=tid_alloc, perf=perf,
         )
+        # per-PG codec coalescers: concurrent CLIENT ops gather their
+        # encode/decode work into batched dispatches (recovery, scrub
+        # and peering keep direct codec calls -- the client-op-only
+        # scoping that keeps the batching deadlock-free, see
+        # ceph_tpu/osd/coalescer.py)
+        if coalesce is None:
+            from ceph_tpu.utils.config import get_config
+
+            coalesce = bool(get_config().get_val("osd_ec_op_coalesce"))
+        from ceph_tpu.osd.coalescer import BatchCoalescer
+
+        self._enc_coalescer = BatchCoalescer(
+            self._encode_dispatch, perf=self.perf,
+            counter="ec_encode_coalesce",
+        ) if coalesce else None
+        self._dec_coalescer = BatchCoalescer(
+            self._decode_dispatch, perf=self.perf,
+            counter="ec_decode_coalesce",
+        ) if coalesce else None
+
+    # -- batched codec dispatch (the stripe-batching pipeline seam) --------
+
+    def _encode_dispatch(self, blocks):
+        return ecutil.encode_shard_major_many(self.ec, blocks,
+                                              range(self.km))
+
+    def _decode_dispatch(self, maps):
+        return ecutil.decode_concat_many(self.sinfo, self.ec, maps)
+
+    async def _encode_op(self, buf) -> dict:
+        """Client-op encode: the transpose runs per op (cheap host view
+        work), the codec dispatch batches with every other client op in
+        flight this tick."""
+        if self._enc_coalescer is None:
+            return ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+        block = ecutil.to_shard_major(self.sinfo, self.k, buf)
+        return await self._enc_coalescer.submit(block, block.nbytes)
+
+    async def _decode_op(self, chunks) -> bytes:
+        """Client-op decode: stripes sharing an erasure signature ride
+        one fused reconstruction dispatch."""
+        if self._dec_coalescer is None:
+            return ecutil.decode_concat(self.sinfo, self.ec, chunks)
+        nbytes = sum(c.nbytes for c in chunks.values())
+        return await self._dec_coalescer.submit(chunks, nbytes)
 
     # -- write path --------------------------------------------------------
 
@@ -114,7 +167,7 @@ class ECBackend(PG):
         span = trace.new_trace("ec write")
         span.event("start_rmw")
         if padded_len:
-            encoded = ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+            encoded = await self._encode_op(buf)
         else:
             # zero-byte object (S3 markers, touch): no stripes to encode
             encoded = [np.zeros(0, dtype=np.uint8) for _ in range(self.km)]
@@ -124,8 +177,9 @@ class ECBackend(PG):
             hinfo.append(0, encoded)
 
         acting = self.acting_set(oid)
-        # min_size: an EC pool needs at least k live shards to accept writes
-        up = await self._up_for_write(oid, acting, self.k)
+        # min_size: write acceptance needs min_size live shards (commit
+        # quorum below stays k -- acceptance, not completion, is gated)
+        up = await self._up_for_write(oid, acting, self.min_size)
         tid = self._new_tid()
         entry = LogEntry(version=version[0], oid=oid, op="append",
                          prior_size=0)
@@ -196,7 +250,7 @@ class ECBackend(PG):
             raise IOError(f"cannot read {oid}: only {len(chunks)} shards")
         if logical_size is None:
             raise IOError(f"no size metadata for {oid}")
-        data = ecutil.decode_concat(self.sinfo, self.ec, chunks)
+        data = await self._decode_op(chunks)
         self.perf.inc("read")
         return data[:logical_size]
 
@@ -232,7 +286,7 @@ class ECBackend(PG):
         )
         if len(chunks) < self.k:
             raise IOError(f"cannot range-read {oid}")
-        data = ecutil.decode_concat(self.sinfo, self.ec, chunks)
+        data = await self._decode_op(chunks)
         lo = offset - start
         self.perf.inc("read_range")
         return data[lo : lo + length]
@@ -269,7 +323,7 @@ class ECBackend(PG):
             data, dtype=np.uint8
         )
 
-        encoded = ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+        encoded = await self._encode_op(buf)
         chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
 
         if plan.is_append and hinfo_d is not None and chunk_off == (
@@ -292,7 +346,7 @@ class ECBackend(PG):
 
         version = self._next_version(oid)
         acting = self.acting_set(oid)
-        up = await self._up_for_write(oid, acting, self.k)
+        up = await self._up_for_write(oid, acting, self.min_size)
         tid = self._new_tid()
         entry = LogEntry(version=version[0], oid=oid, op="append",
                          prior_size=size)

@@ -63,6 +63,27 @@ class StripeInfo:
         return start, length
 
 
+def as_u8(data) -> np.ndarray:
+    """Zero-copy uint8 view of bytes / bytearray / memoryview / ndarray
+    input (``np.frombuffer`` shares the caller's buffer; the old
+    ``bytes(data)`` round-trip copied memoryviews and bytearrays)."""
+    if isinstance(data, np.ndarray):
+        return data if data.dtype == np.uint8 else data.view(np.uint8)
+    return np.frombuffer(data, dtype=np.uint8)
+
+
+def to_shard_major(sinfo: StripeInfo, k: int, data) -> np.ndarray:
+    """[k, shard_len] shard-major view of a stripe-aligned logical
+    buffer: the ONE transpose copy the host write path makes (every
+    other step is a view)."""
+    buf = as_u8(data)
+    assert len(buf) % sinfo.stripe_width == 0, "input must be stripe-aligned"
+    n_stripes = len(buf) // sinfo.stripe_width
+    # reshape so each shard's stripes are contiguous: [stripes, k, chunk]
+    per_stripe = buf.reshape(n_stripes, k, sinfo.chunk_size)
+    return np.ascontiguousarray(per_stripe.transpose(1, 0, 2)).reshape(k, -1)
+
+
 def encode(
     sinfo: StripeInfo,
     ec,
@@ -77,21 +98,51 @@ def encode(
     per-stripe loop concatenated (each stripe's chunk is contiguous within
     its shard at offset stripe_index * chunk_size).
     """
-    buf = np.frombuffer(bytes(data), dtype=np.uint8) if not isinstance(
-        data, np.ndarray
-    ) else data
-    assert len(buf) % sinfo.stripe_width == 0, "input must be stripe-aligned"
-    n_stripes = len(buf) // sinfo.stripe_width
-    k = ec.get_data_chunk_count()
+    block = to_shard_major(sinfo, ec.get_data_chunk_count(), data)
+    return encode_shard_major_many(ec, [block], want)[0]
+
+
+def encode_shard_major_many(
+    ec,
+    blocks: List[np.ndarray],
+    want: Iterable[int],
+) -> List[Dict[int, np.ndarray]]:
+    """ONE batched codec dispatch covering many shard-major [k, bs]
+    blocks -- the write-path coalescer's dispatch function.
+
+    Pipeline-backed plugins fuse the whole set into granules
+    (``encode_batch``: one H2D + dispatch + D2H ladder covers every
+    block, bounded in-flight depth); other codecs fall back to one
+    encode per block.  Same bytes either way: each block's flattening is
+    exactly the per-shard chunk split the codec's own algebra performs.
+    """
+    want = list(want)
     km = ec.get_chunk_count()
-    # reshape so each shard's stripes are contiguous: [stripes, k, chunk]
-    per_stripe = buf.reshape(n_stripes, k, sinfo.stripe_width // k)
-    shard_major = np.ascontiguousarray(
-        per_stripe.transpose(1, 0, 2)
-    ).reshape(k, -1)
-    # encode the concatenated shard streams in a single codec call
-    encoded = ec.encode(set(range(km)), shard_major.reshape(-1))
-    return {i: encoded[i] for i in want}
+    if hasattr(ec, "encode_batch") and all(b.shape[1] for b in blocks):
+        encs = ec.encode_batch([b.reshape(-1) for b in blocks])
+        return [{i: enc[i] for i in want} for enc in encs]
+    out = []
+    for b in blocks:
+        if b.shape[1] == 0:
+            out.append({i: np.zeros(0, dtype=np.uint8) for i in want})
+            continue
+        enc = ec.encode(set(range(km)), b.reshape(-1))
+        out.append({i: enc[i] for i in want})
+    return out
+
+
+def encode_many(
+    sinfo: StripeInfo,
+    ec,
+    bufs: List,
+    want: Iterable[int],
+) -> List[Dict[int, np.ndarray]]:
+    """Batched multi-object encode: one transpose per buffer, one batched
+    codec dispatch for the whole set."""
+    k = ec.get_data_chunk_count()
+    return encode_shard_major_many(
+        ec, [to_shard_major(sinfo, k, b) for b in bufs], want
+    )
 
 
 def data_positions(ec) -> List[int]:
@@ -103,22 +154,56 @@ def data_positions(ec) -> List[int]:
     return list(range(k))
 
 
+def _reassemble(sinfo: StripeInfo, ec, out: Dict[int, np.ndarray]) -> bytes:
+    """Shard-major decode output -> logical bytes (one transpose copy)."""
+    k = ec.get_data_chunk_count()
+    pos = data_positions(ec)
+    shard_len = len(out[pos[0]])
+    n_stripes = shard_len // sinfo.chunk_size
+    stacked = np.stack([as_u8(out[p]) for p in pos])  # [k, shard_len]
+    per_stripe = stacked.reshape(k, n_stripes, sinfo.chunk_size).transpose(
+        1, 0, 2
+    )
+    return per_stripe.tobytes()
+
+
 def decode_concat(
     sinfo: StripeInfo,
     ec,
     to_decode: Dict[int, np.ndarray],
 ) -> bytes:
     """Rebuild the logical buffer from per-shard chunk streams."""
-    k = ec.get_data_chunk_count()
+    return decode_concat_many(sinfo, ec, [to_decode])[0]
+
+
+def decode_concat_many(
+    sinfo: StripeInfo,
+    ec,
+    maps: List[Dict[int, np.ndarray]],
+) -> List[bytes]:
+    """Batched logical reads -- the read-path coalescer's dispatch.
+
+    Stripes sharing an erasure signature share one fused reconstruction
+    dispatch (``decode_batch`` groups by available-set and reuses the
+    pipeline's per-signature decode stream); codecs without the batched
+    API decode per map.  Zero-length maps (zero-byte objects) short-
+    circuit without touching the codec.
+    """
     pos = data_positions(ec)
-    out = ec.decode(set(pos), to_decode)
-    shard_len = len(next(iter(out.values())))
-    n_stripes = shard_len // sinfo.chunk_size
-    stacked = np.stack([out[p] for p in pos])  # [k, shard_len] logical order
-    per_stripe = stacked.reshape(k, n_stripes, sinfo.chunk_size).transpose(
-        1, 0, 2
-    )
-    return per_stripe.tobytes()
+    results: List[bytes] = [b""] * len(maps)
+    need = [
+        i for i, m in enumerate(maps)
+        if m and len(next(iter(m.values()))) > 0
+    ]
+    if not need:
+        return results
+    if hasattr(ec, "decode_batch"):
+        outs = ec.decode_batch([maps[i] for i in need])
+    else:
+        outs = [ec.decode(set(pos), maps[i]) for i in need]
+    for i, out in zip(need, outs):
+        results[i] = _reassemble(sinfo, ec, out)
+    return results
 
 
 def decode_shards(

@@ -159,11 +159,25 @@ class Messenger:
         # Snapshot: the adopt_task done-callbacks prune self._tasks as each
         # cancelled task completes, so iterating the live dict here races
         # with its own mutation (dictionary-changed-size RuntimeError).
-        tasks = list(self._tasks.values())
+        #
+        # Cancel in ROUNDS, not once: under py<3.11 asyncio.wait_for can
+        # swallow a cancellation that races its future's completion
+        # (bpo-42130).  A tick loop whose peering pass lost its one
+        # cancel that way keeps running and then blocks forever on a
+        # reply future no (cancelled) dispatch loop will ever resolve --
+        # the whole-suite wedge the tier-1 run hit.  Re-cancelling lands
+        # the next CancelledError at the task's next await point.
+        tasks = [t for t in self._tasks.values() if not t.done()]
+        for _ in range(50):
+            if not tasks:
+                return
+            for task in tasks:
+                task.cancel()
+            done, pending = await asyncio.wait(tasks, timeout=0.5)
+            tasks = list(pending)
+        # a task still alive after 50 cancel rounds is looping over
+        # CancelledError; abandon it rather than hang the caller
+        import sys
+
         for task in tasks:
-            task.cancel()
-        for task in tasks:
-            try:
-                await task
-            except (asyncio.CancelledError, Exception):
-                pass
+            print(f"messenger shutdown: abandoning {task}", file=sys.stderr)

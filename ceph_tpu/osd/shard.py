@@ -168,6 +168,7 @@ class OSDShard:
                 ec, list(range(n_osds)), self.messenger, name=self.name,
                 placement=placement, register=False,
                 tid_alloc=self._next_host_tid, perf=self.perf,
+                min_size=min_size,
             )
         backend.pool_name = pool
         self.pools[pool] = backend

@@ -26,7 +26,10 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 import numpy as np
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # pre-0.6 jax exposes it under experimental only
+    from jax.experimental.shard_map import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ceph_tpu.matrices.bitmatrix import matrix_to_bitmatrix

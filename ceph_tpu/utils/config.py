@@ -51,6 +51,10 @@ OPTIONS: Dict[str, Option] = {
              "pallas kernel lane tile (int32 lanes)"),
         _opt("ec_batch_stripes", int, 64, LEVEL_ADVANCED,
              "stripes fused per device dispatch in the batching shim"),
+        _opt("osd_ec_op_coalesce", bool, True, LEVEL_ADVANCED,
+             "gather concurrent client-op EC codec work into batched "
+             "dispatches (the per-PG encode/decode coalescer; client "
+             "ops only, recovery/scrub stay per-call)"),
         _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
              "max bytes per recovery window"),
         _opt("osd_recovery_max_active", int, 3, LEVEL_ADVANCED,

@@ -325,3 +325,72 @@ def test_auth_rm_never_strips_provisioned_keys():
         await ms.shutdown()
 
     run(main())
+
+
+def test_auth_mutations_gated_on_mon_admin_caps():
+    """ADVICE r5: an entity whose minted caps carry no mon admin grant
+    must not be able to mint/rotate/revoke/re-cap keys; admin-capable
+    and unregistered (file-provisioned) entities keep working; a spoofed
+    reply_to on a direct client command confers nothing."""
+
+    async def main():
+        ms = Messenger()
+        mc = MonCluster(3, ms)
+        await mc.form_quorum()
+        admin, _ = _client(ms, "client0")  # unregistered: open default
+        # a service key with osd-only caps (the vstart get-or-create
+        # shape) and an explicitly admin-capable client
+        rc, _o = await admin.command({
+            "prefix": "auth get-or-create", "entity": "osd.9",
+            "caps": {"osd": "allow *"}})
+        assert rc == 0
+        rc, _o = await admin.command({
+            "prefix": "auth get-or-create", "entity": "client.ops",
+            "caps": {"mon": "allow profile admin", "osd": "allow *"}})
+        assert rc == 0
+        await asyncio.sleep(0.05)  # let the auth_add commits replicate
+
+        svc, _ = _client(ms, "osd.9")
+        for cmd in (
+            {"prefix": "auth get-or-create", "entity": "client.evil"},
+            {"prefix": "auth rotate", "entity": "client.ops"},
+            {"prefix": "auth rm", "entity": "client.ops"},
+            {"prefix": "auth caps", "entity": "osd.9",
+             "caps": {"mon": "allow *"}},
+        ):
+            rc, out = await svc.command(cmd)
+            assert rc == -13, (cmd, rc, out)
+        # reads stay open to the service key (status/monitoring paths)
+        rc, _o = await svc.command({"prefix": "auth get", "entity": "osd.9"})
+        assert rc == 0
+        # no key was minted, nothing was revoked
+        leader = await mc.wait_for_leader()
+        assert "client.evil" not in leader.authdb.entities
+        assert "client.ops" in leader.authdb.entities
+
+        # a spoofed reply_to on a DIRECT (non-forwarded) command must not
+        # lend the caller someone else's identity: the mutation is still
+        # denied (the reply itself goes to the spoofed name and vanishes)
+        async def drop(src, msg):
+            pass
+
+        ms.register("osd.9b", drop)
+        rc, _o = await admin.command({
+            "prefix": "auth get-or-create", "entity": "osd.9b",
+            "caps": {"osd": "allow *"}})
+        assert rc == 0
+        await asyncio.sleep(0.05)
+        await ms.send_message("osd.9b", f"mon.{leader.rank}", {
+            "type": "mon_command", "id": 1, "reply_to": "client.admin",
+            "cmd": {"prefix": "auth rm", "entity": "client.ops"}})
+        await asyncio.sleep(0.2)
+        assert "client.ops" in leader.authdb.entities
+
+        # the admin-capable minted entity CAN mutate
+        ops, _ = _client(ms, "client.ops")
+        rc, _o = await ops.command({
+            "prefix": "auth rotate", "entity": "osd.9"})
+        assert rc == 0
+        await ms.shutdown()
+
+    run(main())

@@ -499,7 +499,9 @@ def test_read_detects_stale_minimum_set():
     async def run():
         from ceph_tpu.osd.cluster import ECCluster
 
-        c = ECCluster(8, {"k": "2", "m": "2"})
+        # min_size=k: this scenario NEEDS a write accepted with exactly k
+        # shards up (the default k+1 floor would refuse it -- correctly)
+        c = ECCluster(8, {"k": "2", "m": "2"}, min_size=2)
         old = b"old-old-old!" * 250
         new = b"NEW_NEW_NEW!" * 200
         await c.write("obj", old)

@@ -37,7 +37,7 @@ def parse_args(argv):
     p.add_argument("-p", "--plugin", default="jerasure",
                    help="erasure code plugin name")
     p.add_argument("-w", "--workload", default="encode",
-                   choices=["encode", "decode"])
+                   choices=["encode", "decode", "storage-path"])
     p.add_argument("-e", "--erasures", type=int, default=1,
                    help="number of erasures when decoding")
     p.add_argument("--erased", type=int, action="append", default=[],
@@ -53,6 +53,10 @@ def parse_args(argv):
                         "plugin API (encode_batch/decode_batch); bytes "
                         "processed scale by the batch size. 0 = reference "
                         "per-call loop")
+    p.add_argument("--writers", type=int, default=8,
+                   help="concurrent writers for --workload storage-path")
+    p.add_argument("--objects", type=int, default=64,
+                   help="objects per storage-path pass")
     p.add_argument("--payload", default="X", choices=["X", "random"],
                    help="payload contents: 'X' matches the reference tool "
                         "(ceph_erasure_code_benchmark.cc:173); 'random' "
@@ -141,6 +145,31 @@ def main(argv=None) -> int:
     else:
         payload = np.full(args.size, ord("X"), dtype=np.uint8)
     want = set(range(ec.get_chunk_count()))
+
+    if args.workload == "storage-path":
+        # Host OSD storage-path stage (round 6): assemble -> transpose ->
+        # encode -> commit (+ signature-grouped degraded decode) with
+        # concurrent writers, coalescing on vs off, bit-exactness gated
+        # before timing.  Prints one JSON line with the per-stage
+        # breakdown (the shape bench.py records in the round JSON).
+        import json
+
+        from ceph_tpu.osd.storage_bench import run_storage_path_bench
+
+        result = run_storage_path_bench(
+            ec, n_objects=args.objects, obj_bytes=args.size,
+            writers=args.writers, iters=max(1, args.iterations),
+        )
+        print(json.dumps(result))
+        print(
+            f"storage-path k={result['k']} m={result['m']} "
+            f"{args.objects}x{args.size}B x{args.writers} writers: "
+            f"coalesced write {result['coalesced']['write_GiBs']:.4f} "
+            f"GiB/s ({result['write_speedup']}x per-op), read "
+            f"{result['coalesced']['read_GiBs']:.4f} GiB/s "
+            f"({result['read_speedup']}x)", file=sys.stderr,
+        )
+        return 0
 
     if args.batch and not hasattr(ec, "encode_batch"):
         print(f"plugin {args.plugin} has no batched API; ignoring --batch",

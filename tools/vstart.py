@@ -342,11 +342,34 @@ def stop_cluster(run_dir):
             os.kill(pid, signal.SIGTERM)
         except ProcessLookupError:
             pass
+    # bounded graceful wait, then SIGKILL: a daemon that wedges inside
+    # its own SIGTERM shutdown must not hang this caller forever (the
+    # unbounded waitpid here turned one stuck daemon into a stuck test
+    # run) nor leak as an orphan holding its port
+    deadline = time.time() + 10.0
     for pid in pids.values():
-        try:
-            os.waitpid(pid, 0)
-        except (ChildProcessError, ProcessLookupError):
-            pass
+        while True:
+            try:
+                if os.waitpid(pid, os.WNOHANG)[0]:
+                    break  # reaped
+            except (ChildProcessError, ProcessLookupError):
+                # not our child (CLI stop from another process) or
+                # already reaped: poll raw liveness instead
+                try:
+                    os.kill(pid, 0)
+                except ProcessLookupError:
+                    break  # gone
+            if time.time() > deadline:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                try:
+                    os.waitpid(pid, 0)
+                except (ChildProcessError, ProcessLookupError):
+                    pass
+                break
+            time.sleep(0.05)
     _save_pids(run_dir, {})
     try:
         os.remove(os.path.join(run_dir, "mon_pids"))
