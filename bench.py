@@ -488,6 +488,23 @@ def main() -> int:
 
     sp_host = _secondary(_storage_path_host)
 
+    def _lint_findings_total():
+        """Static-health trend metric: unsuppressed cephlint findings
+        across ceph_tpu/tools/tests (tools/cephlint.py --format json).
+        0 is the gated steady state; any rise is new debt the tier-1
+        gate will also be failing on."""
+        import subprocess
+
+        root = __file__.rsplit("/", 1)[0]
+        proc = subprocess.run(
+            [sys.executable, os.path.join(root, "tools", "cephlint.py"),
+             "--format", "json", "ceph_tpu", "tools", "tests"],
+            capture_output=True, text=True, timeout=300,
+        )
+        return json.loads(proc.stdout)["lint_findings_total"]
+
+    lint_total = _secondary(_lint_findings_total)
+
     def _r3(v):
         return round(v, 3) if v is not None else None
 
@@ -516,6 +533,7 @@ def main() -> int:
         "storage_path_host_read_speedup": (
             sp_host["read_speedup"] if sp_host else None),
         "storage_path_host": sp_host,
+        "lint_findings_total": lint_total,
         "platform": jax.devices()[0].platform + (
             "-fallback"
             if os.environ.get("CEPH_TPU_BENCH_FALLBACK")

@@ -1,0 +1,24 @@
+"""cephlint: AST-based static analysis for the ceph_tpu tree.
+
+Reference: Ceph ships invariant-enforcement tooling alongside its data
+path (lockdep, src/test static suites, CI clang analyses); this package
+plays that role for the reproduction.  Three rule packs:
+
+* **async** -- orphaned ``create_task`` results, unawaited coroutines,
+  blocking calls inside ``async def``, ``await`` while holding a
+  non-async lock.  The motivating bug class is the PR-1 messenger wedge:
+  a dropped tick-loop task that survived shutdown and hung tier-1.
+* **jax** -- host<->device syncs in the codec/coalescer hot paths,
+  dtype drift away from the GF word dtype in kernel code, Python loops
+  over device arrays.
+* **ceph** -- config keys read but never declared in the
+  ``utils/config.py`` options registry, encode/decode struct pairing in
+  ``utils/encoding.py`` users.
+
+Entry points: :func:`ceph_tpu.analysis.runner.run` (programmatic) and
+``tools/cephlint.py`` (CLI).  Rules self-register on import via the
+``@rule`` decorator in :mod:`ceph_tpu.analysis.core`.
+"""
+
+from ceph_tpu.analysis.core import Finding, Rule, all_rules, rule  # noqa: F401
+from ceph_tpu.analysis.runner import run, run_paths  # noqa: F401

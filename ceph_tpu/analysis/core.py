@@ -1,0 +1,191 @@
+"""cephlint core: findings, the rule registry, and AST helpers.
+
+A *rule* is a function ``check(ctx: FileContext) -> Iterable[Finding]``
+registered with the :func:`rule` decorator; the runner calls every
+registered rule on every scanned file.  Rules are pure AST/source
+consumers -- they never import or execute the code under analysis, so
+the analyzer is safe to run over broken or half-written trees (parse
+failures surface as a ``parse-error`` finding instead of crashing the
+scan).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Callable, Dict, Iterable, List, Optional
+
+SEV_ERROR = "error"
+SEV_WARNING = "warning"
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    """One diagnostic at a source location."""
+
+    rule: str
+    path: str          # repo-relative posix path
+    line: int          # 1-based
+    col: int           # 0-based (ast convention)
+    message: str
+    severity: str = SEV_WARNING
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: "
+                f"{self.severity}: [{self.rule}] {self.message}")
+
+
+@dataclasses.dataclass
+class Rule:
+    name: str
+    pack: str          # "async" | "jax" | "ceph"
+    severity: str
+    description: str
+    check: Callable[["FileContext"], Iterable[Finding]]
+
+
+#: name -> Rule; populated by the @rule decorator at import time
+_RULES: Dict[str, Rule] = {}
+
+
+def rule(name: str, pack: str, severity: str, description: str):
+    """Register a rule-check function under ``name``."""
+
+    def deco(fn):
+        if name in _RULES:
+            raise ValueError(f"duplicate rule {name!r}")
+        _RULES[name] = Rule(name, pack, severity, description, fn)
+        return fn
+
+    return deco
+
+
+def all_rules() -> Dict[str, Rule]:
+    # import the packs lazily so `import ceph_tpu.analysis.core` alone
+    # doesn't force them, but any registry consumer sees every rule
+    from ceph_tpu.analysis import rules_async  # noqa: F401
+    from ceph_tpu.analysis import rules_config  # noqa: F401
+    from ceph_tpu.analysis import rules_jax  # noqa: F401
+
+    return dict(_RULES)
+
+
+class FileContext:
+    """Everything a rule may look at for one file."""
+
+    def __init__(self, path: str, source: str, tree: ast.Module):
+        self.path = path
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = tree
+        self._parents: Optional[Dict[ast.AST, ast.AST]] = None
+
+    # -- shared helpers ----------------------------------------------------
+
+    def parent_map(self) -> Dict[ast.AST, ast.AST]:
+        if self._parents is None:
+            self._parents = {}
+            for node in ast.walk(self.tree):
+                for child in ast.iter_child_nodes(node):
+                    self._parents[child] = node
+        return self._parents
+
+    def finding(self, rule_obj_or_name, node: ast.AST, message: str,
+                severity: Optional[str] = None) -> Finding:
+        name = getattr(rule_obj_or_name, "name", rule_obj_or_name)
+        sev = severity or _RULES[name].severity
+        return Finding(name, self.path, getattr(node, "lineno", 1),
+                       getattr(node, "col_offset", 0), message, sev)
+
+    def imports_module(self, *names: str) -> bool:
+        """True if the file imports any of ``names`` (top-level module
+        match: ``jax`` matches ``import jax.numpy`` and
+        ``from jax import ...``)."""
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for alias in node.names:
+                    if alias.name.split(".")[0] in names or \
+                            alias.name in names:
+                        return True
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                if node.module.split(".")[0] in names or \
+                        node.module in names:
+                    return True
+        return False
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted name of an expression: ``asyncio.create_task``,
+    ``loop.create_task``, ``().create_task`` (call results collapse to
+    ``()``).  Used to match call targets without type inference."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    if isinstance(node, ast.Call):
+        return "()"
+    return "?"
+
+
+def call_name(call: ast.Call) -> str:
+    return dotted_name(call.func)
+
+
+def call_attr(call: ast.Call) -> str:
+    """Last attribute segment of the call target (``create_task`` for
+    any of the spellings)."""
+    return call_name(call).rsplit(".", 1)[-1]
+
+
+def enclosing_functions(ctx: FileContext, node: ast.AST) -> List[ast.AST]:
+    """Function-def chain from outermost to innermost around ``node``."""
+    chain: List[ast.AST] = []
+    parents = ctx.parent_map()
+    cur = node
+    while cur in parents:
+        cur = parents[cur]
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            chain.append(cur)
+    chain.reverse()
+    return chain
+
+
+def in_async_context(ctx: FileContext, node: ast.AST) -> bool:
+    """True when ``node`` executes on the event loop: its *innermost*
+    enclosing function is ``async def`` (a nested sync def runs wherever
+    it is called from -- the call site gets flagged, not the body)."""
+    chain = enclosing_functions(ctx, node)
+    return bool(chain) and isinstance(chain[-1], ast.AsyncFunctionDef)
+
+
+def decorator_names(fn: ast.AST) -> List[str]:
+    out = []
+    for dec in getattr(fn, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            out.append(dotted_name(dec.func))
+            out.extend(dotted_name(a) for a in dec.args)
+        else:
+            out.append(dotted_name(dec))
+    return out
+
+
+def is_jitted(fn: ast.AST) -> bool:
+    """Decorated with jax.jit / jit / functools.partial(jax.jit, ...)."""
+    return any("jit" == d.rsplit(".", 1)[-1] or d.endswith(".jit")
+               for d in decorator_names(fn))
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments (lets rules resolve
+    e.g. ``os.environ.get(STATE_ENV)`` through the constant)."""
+    out: Dict[str, str] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            out[node.targets[0].id] = node.value.value
+    return out

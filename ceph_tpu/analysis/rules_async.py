@@ -1,0 +1,191 @@
+"""Async hygiene rules (the PR-1 wedge class and its relatives).
+
+The motivating incident: PR 1 lost a full round to a messenger tick
+loop whose ``create_task`` result was dropped -- cancellation raced a
+``wait_for`` (bpo-42130), the lone cancel was swallowed, and the
+immortal loop wedged the entire tier-1 suite.  Every rule here is a
+mechanically-detectable face of that bug class.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Set
+
+from ceph_tpu.analysis.core import (SEV_ERROR, SEV_WARNING, FileContext,
+                                    Finding, call_attr, call_name,
+                                    in_async_context, rule)
+
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+
+#: call targets that block the event loop when made from a coroutine
+_BLOCKING_CALLS = {
+    "time.sleep": "use `await asyncio.sleep(...)`",
+    "subprocess.run": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.call": "use `asyncio.create_subprocess_exec` or an executor",
+    "subprocess.check_call": "use `asyncio.create_subprocess_exec` or an "
+                             "executor",
+    "subprocess.check_output": "use `asyncio.create_subprocess_exec` or an "
+                               "executor",
+    "os.system": "use `asyncio.create_subprocess_shell` or an executor",
+    "os.popen": "use `asyncio.create_subprocess_shell` or an executor",
+}
+
+
+@rule(
+    "async-orphan-task", "async", SEV_ERROR,
+    "create_task/ensure_future result dropped: without a retained "
+    "reference the task is garbage-collectable mid-flight, and without a "
+    "done-callback its exception (or survival across shutdown) is "
+    "invisible -- the PR-1 tick-loop wedge class",
+)
+def check_orphan_task(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        # a spawn whose value is the whole statement: nothing retained
+        if isinstance(node, ast.Expr) and isinstance(node.value, ast.Call) \
+                and call_attr(node.value) in _SPAWN_ATTRS:
+            yield ctx.finding(
+                "async-orphan-task", node,
+                f"result of {call_name(node.value)}(...) is dropped; "
+                "retain it (e.g. messenger.adopt_task) or attach a "
+                "done-callback that logs exceptions",
+            )
+        # an awaited spawn is pointless but not an orphan; skip
+
+
+def _scope_defs(ctx: FileContext):
+    """Lexical name tables: (scope node -> {fn name: is_async}) for
+    module/function scopes, plus {method name: is_async} for methods
+    (a name defined as BOTH sync and async method anywhere stays
+    ambiguous and is dropped -- no types here)."""
+    parents = ctx.parent_map()
+    scopes: dict = {}
+    methods: dict = {}
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        parent = parents.get(node, ctx.tree)
+        is_async = isinstance(node, ast.AsyncFunctionDef)
+        if isinstance(parent, ast.ClassDef):
+            if node.name in methods and methods[node.name] != is_async:
+                methods[node.name] = None  # ambiguous across classes
+            else:
+                methods.setdefault(node.name, is_async)
+        # the scope a def's NAME lives in: its innermost enclosing
+        # function, else the module
+        scope: ast.AST = ctx.tree
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                scope = cur
+                break
+        scopes.setdefault(scope, {})[node.name] = is_async
+    return scopes, methods
+
+
+@rule(
+    "async-unawaited-coroutine", "async", SEV_ERROR,
+    "bare call to a coroutine function defined in this module: the "
+    "coroutine object is created and silently discarded, the body never "
+    "runs (RuntimeWarning at best)",
+)
+def check_unawaited_coroutine(ctx: FileContext) -> Iterator[Finding]:
+    from ceph_tpu.analysis.core import enclosing_functions
+
+    scopes, methods = _scope_defs(ctx)
+    if not scopes and not methods:
+        return
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Expr) and
+                isinstance(node.value, ast.Call)):
+            continue
+        func = node.value.func
+        is_async = None
+        name = None
+        if isinstance(func, ast.Name):
+            # resolve lexically, innermost scope outward (a nested
+            # `async def run` must not taint an outer sync `run`)
+            name = func.id
+            for scope in reversed(
+                    [ctx.tree] + enclosing_functions(ctx, node)):
+                if name in scopes.get(scope, {}):
+                    is_async = scopes[scope][name]
+                    break
+        elif isinstance(func, ast.Attribute) and \
+                isinstance(func.value, ast.Name) and func.value.id == "self":
+            name = func.attr
+            is_async = methods.get(name)
+        if is_async:
+            yield ctx.finding(
+                "async-unawaited-coroutine", node,
+                f"coroutine {name}(...) is neither awaited nor spawned; "
+                "the call creates a coroutine object and drops it",
+            )
+
+
+@rule(
+    "async-blocking-call", "async", SEV_WARNING,
+    "blocking call inside `async def` stalls the whole event loop (every "
+    "dispatch loop, tick and client op on it)",
+)
+def check_blocking_call(ctx: FileContext) -> Iterator[Finding]:
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if not in_async_context(ctx, node):
+            continue
+        name = call_name(node)
+        if name in _BLOCKING_CALLS:
+            yield ctx.finding(
+                "async-blocking-call", node,
+                f"{name}(...) blocks the event loop; "
+                f"{_BLOCKING_CALLS[name]}",
+            )
+        elif name == "open":
+            yield ctx.finding(
+                "async-blocking-call", node,
+                "sync file I/O (`open`) inside `async def` blocks the "
+                "event loop; move it to `loop.run_in_executor` (or do it "
+                "before entering async context)",
+            )
+
+
+def _mentions_lock(node: ast.expr) -> bool:
+    """Context-manager expression names a lock: `lock`, `self._lock`,
+    `self._conn_lock(node)` ...  The lockdep convention (utils/lockdep)
+    is that every lock object's name ends in 'lock'."""
+    from ceph_tpu.analysis.core import dotted_name
+
+    if isinstance(node, ast.Call):
+        return _mentions_lock(node.func)
+    tail = dotted_name(node).rsplit(".", 1)[-1].lower()
+    return tail.endswith("lock")
+
+
+@rule(
+    "async-sync-lock-await", "async", SEV_ERROR,
+    "await while holding a NON-async lock (`with ...lock:` instead of "
+    "`async with`): the awaiting task parks on the loop with the lock "
+    "held and every other task that touches it deadlocks -- asyncio "
+    "locks (utils/lockdep TrackedLock) are the rail here",
+)
+def check_sync_lock_await(ctx: FileContext) -> Iterator[Finding]:
+    from ceph_tpu.analysis.core import enclosing_functions
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.With):  # async with is fine
+            continue
+        if not any(_mentions_lock(item.context_expr) for item in node.items):
+            continue
+        holder = enclosing_functions(ctx, node)
+        for inner in ast.walk(node):
+            # an await inside a NESTED def does not run under this lock
+            if isinstance(inner, ast.Await) and \
+                    enclosing_functions(ctx, inner) == holder:
+                yield ctx.finding(
+                    "async-sync-lock-await", inner,
+                    "await inside a sync `with ...lock:` block; hold an "
+                    "asyncio lock (`async with`) across await points",
+                )
+                break  # one finding per with-block is enough

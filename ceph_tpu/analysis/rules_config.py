@@ -1,0 +1,193 @@
+"""Ceph invariant rules: the options registry and encode/decode pairing.
+
+* ``ceph-config-undeclared-key``: the reference declares every option
+  once in src/common/options.cc; readers then cannot drift from the
+  schema.  Here the same single-declaration invariant is
+  ``utils/config.py``'s OPTIONS dict.  The rule covers both access
+  styles: ``get_val("k")``/``set_val("k", ...)`` (raise at runtime only
+  when the bad key is actually hit) and the raw env layer
+  (``os.environ.get("CEPH_TPU_K")``), which never raises and so drifts
+  silently.
+* ``ceph-encoding-version-pair``: every struct that serializes through
+  ``utils/encoding.py`` must keep encode and decode together (the
+  ENCODE_START/DECODE_START discipline of src/include/encoding.h): an
+  ``encode*`` without its ``decode*`` twin is a wire/persist format
+  with no reader, and a version constant referenced on only one side is
+  a compat break waiting for the next format bump.
+"""
+
+from __future__ import annotations
+
+import ast
+import functools
+import os
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis.core import (SEV_ERROR, SEV_WARNING, FileContext,
+                                    Finding, call_attr, call_name,
+                                    module_str_constants, rule)
+
+_ENV_PREFIX = "CEPH_TPU_"
+_CONFIG_REL_PATH = os.path.join("ceph_tpu", "utils", "config.py")
+
+
+@functools.lru_cache(maxsize=1)
+def declared_options() -> Tuple[str, ...]:
+    """Option names declared in utils/config.py, extracted from its AST
+    (never imported: the analyzer must work on a broken tree)."""
+    root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    cfg_path = os.path.join(root, _CONFIG_REL_PATH)
+    try:
+        with open(cfg_path) as fh:
+            tree = ast.parse(fh.read())
+    except (OSError, SyntaxError):
+        return ()
+    names: List[str] = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                call_name(node) in ("_opt", "Option") and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            names.append(node.args[0].value)
+    return tuple(names)
+
+
+def _env_key_node(call: ast.Call) -> Optional[ast.expr]:
+    name = call_name(call)
+    if name in ("os.environ.get", "os.getenv", "environ.get") and call.args:
+        return call.args[0]
+    return None
+
+
+def _literal_str(node: ast.expr, consts: Dict[str, str]) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    return None
+
+
+@rule(
+    "ceph-config-undeclared-key", "ceph", SEV_ERROR,
+    "config key read/written but never declared in the utils/config.py "
+    "OPTIONS registry: lookups and the schema can drift apart (typo'd "
+    "keys, phantom env knobs with no default, no description, no "
+    "`config show`)",
+)
+def check_undeclared_key(ctx: FileContext) -> Iterator[Finding]:
+    if ctx.path.replace("\\", "/").endswith("ceph_tpu/utils/config.py"):
+        return  # the registry itself builds keys dynamically
+    options: Set[str] = set(declared_options())
+    if not options:
+        return  # registry unreadable: stay silent rather than spam
+    consts = module_str_constants(ctx.tree)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call):
+            if call_attr(node) in ("get_val", "set_val") and node.args:
+                key = _literal_str(node.args[0], consts)
+                if key is not None and key not in options:
+                    yield ctx.finding(
+                        "ceph-config-undeclared-key", node,
+                        f"option {key!r} is not declared in the "
+                        "utils/config.py OPTIONS registry",
+                    )
+                continue
+            env_arg = _env_key_node(node)
+            if env_arg is not None:
+                key = _literal_str(env_arg, consts)
+                if key and key.startswith(_ENV_PREFIX) and \
+                        key[len(_ENV_PREFIX):].lower() not in options:
+                    yield ctx.finding(
+                        "ceph-config-undeclared-key", node,
+                        f"env knob {key!r} has no `"
+                        f"{key[len(_ENV_PREFIX):].lower()}` option in "
+                        "the utils/config.py OPTIONS registry (the env "
+                        "layer reads CEPH_TPU_<NAME>; undeclared keys "
+                        "are invisible to `config show`)",
+                    )
+        elif isinstance(node, (ast.Subscript,)) and \
+                call_name_of_sub(node) == "os.environ":
+            key = _literal_str(node.slice, consts)
+            if key and key.startswith(_ENV_PREFIX) and \
+                    key[len(_ENV_PREFIX):].lower() not in options:
+                yield ctx.finding(
+                    "ceph-config-undeclared-key", node,
+                    f"env knob {key!r} (subscript access) has no "
+                    f"`{key[len(_ENV_PREFIX):].lower()}` option in the "
+                    "utils/config.py OPTIONS registry",
+                )
+
+
+def call_name_of_sub(node: ast.Subscript) -> str:
+    from ceph_tpu.analysis.core import dotted_name
+
+    return dotted_name(node.value)
+
+
+_VERSION_CONST = re.compile(r"^_?[A-Z][A-Z0-9_]*VERSION[A-Z0-9_]*$|"
+                            r"^_?[A-Z][A-Z0-9_]*_V$")
+
+
+def _referenced_version_consts(fn: ast.AST) -> Set[str]:
+    out: Set[str] = set()
+    for node in ast.walk(fn):
+        name = None
+        if isinstance(node, ast.Name):
+            name = node.id
+        elif isinstance(node, ast.Attribute):
+            name = node.attr
+        if name and _VERSION_CONST.match(name):
+            out.add(name)
+    return out
+
+
+def _pairing_findings(ctx: FileContext, scope_desc: str,
+                      fns: Dict[str, ast.AST]) -> Iterator[Finding]:
+    for name, fn in fns.items():
+        if name.startswith("encode"):
+            twin = "decode" + name[len("encode"):]
+        elif name.startswith("decode"):
+            twin = "encode" + name[len("decode"):]
+        else:
+            continue
+        if twin not in fns:
+            yield ctx.finding(
+                "ceph-encoding-version-pair", fn,
+                f"{scope_desc}{name}() has no {twin}() counterpart; "
+                "serialized formats must keep both directions together "
+                "(src/include/encoding.h ENCODE/DECODE discipline)",
+            )
+            continue
+        if name.startswith("encode"):
+            enc_v = _referenced_version_consts(fn)
+            dec_v = _referenced_version_consts(fns[twin])
+            for missing in sorted(enc_v - dec_v):
+                yield ctx.finding(
+                    "ceph-encoding-version-pair", fn,
+                    f"{scope_desc}{name}() writes version constant "
+                    f"{missing} but {twin}() never reads it: the "
+                    "decoder cannot gate on struct version at the next "
+                    "format bump",
+                )
+
+
+@rule(
+    "ceph-encoding-version-pair", "ceph", SEV_WARNING,
+    "encode*/decode* pairing in utils/encoding.py users: one-sided "
+    "serializers and one-sided struct-version constants",
+)
+def check_encoding_pairs(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.imports_module("ceph_tpu.utils.encoding"):
+        return
+    mod_fns = {n.name: n for n in ctx.tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+    yield from _pairing_findings(ctx, "", mod_fns)
+    for node in ctx.tree.body:
+        if isinstance(node, ast.ClassDef):
+            methods = {n.name: n for n in node.body
+                       if isinstance(n, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))}
+            yield from _pairing_findings(
+                ctx, f"{node.name}.", methods)

@@ -1,0 +1,142 @@
+"""cephlint runner: collect files, run rules, apply suppressions and
+the baseline, format results."""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+from typing import Dict, Iterable, List, Optional, Tuple
+
+from ceph_tpu.analysis import baseline as baseline_mod
+from ceph_tpu.analysis import suppress as suppress_mod
+from ceph_tpu.analysis.core import (SEV_ERROR, FileContext, Finding,
+                                    all_rules)
+
+#: paths skipped by default: the lint fixtures are DELIBERATE findings
+#: (each rule's positive examples) and would otherwise fail the gate
+DEFAULT_EXCLUDES = ("tests/fixtures/lint",)
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def collect_files(paths: Iterable[str], root: Optional[str] = None,
+                  excludes: Tuple[str, ...] = DEFAULT_EXCLUDES
+                  ) -> List[str]:
+    """Expand files/directories into a sorted list of repo-relative
+    posix paths to .py files."""
+    root = root or repo_root()
+    out = set()
+    for p in paths:
+        full = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isfile(full):
+            out.add(os.path.relpath(full, root))
+        else:
+            for dirpath, dirnames, filenames in os.walk(full):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fn in filenames:
+                    if fn.endswith(".py"):
+                        out.add(os.path.relpath(
+                            os.path.join(dirpath, fn), root))
+    rel = sorted(p.replace(os.sep, "/") for p in out)
+    return [p for p in rel
+            if not any(p.startswith(e) for e in excludes)]
+
+
+class ScanResult:
+    def __init__(self):
+        self.new: List[Finding] = []          # unsuppressed, not baselined
+        self.suppressed: List[Finding] = []   # inline-disabled
+        self.baselined: List[Finding] = []    # accepted legacy
+        self.files_scanned = 0
+        self.suppression_audit: List[dict] = []
+        #: raw per-file lines (baseline hashing)
+        self.file_lines: Dict[str, List[str]] = {}
+
+    @property
+    def all_findings(self) -> List[Finding]:
+        return self.new + self.suppressed + self.baselined
+
+    def to_dict(self) -> dict:
+        counts: Dict[str, int] = {}
+        for f in self.new:
+            counts[f.rule] = counts.get(f.rule, 0) + 1
+        return {
+            "lint_findings_total": len(self.new),
+            "files_scanned": self.files_scanned,
+            "suppressed": len(self.suppressed),
+            "baselined": len(self.baselined),
+            "counts_by_rule": dict(sorted(counts.items())),
+            "findings": [f.to_dict() for f in self.new],
+        }
+
+
+def scan_file(path: str, source: str) -> List[Finding]:
+    """All raw findings for one file (no suppression/baseline yet)."""
+    try:
+        tree = ast.parse(source)
+    except SyntaxError as e:
+        return [Finding("parse-error", path, e.lineno or 1, 0,
+                        f"file does not parse: {e.msg}", SEV_ERROR)]
+    ctx = FileContext(path, source, tree)
+    findings: List[Finding] = []
+    for r in all_rules().values():
+        findings.extend(r.check(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def run_paths(paths: Iterable[str], root: Optional[str] = None,
+              baseline_path: Optional[str] = None,
+              excludes: Tuple[str, ...] = DEFAULT_EXCLUDES) -> ScanResult:
+    root = root or repo_root()
+    result = ScanResult()
+    accepted = baseline_mod.load(baseline_path) if baseline_path else {}
+    for rel in collect_files(paths, root, excludes):
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            continue
+        result.files_scanned += 1
+        result.file_lines[rel] = source.splitlines()
+        raw = scan_file(rel, source)
+        result.suppression_audit.extend(suppress_mod.audit(rel, source))
+        if not raw:
+            continue
+        sup = suppress_mod.parse_suppressions(source)
+        live = []
+        for f in raw:
+            if suppress_mod.is_suppressed(sup, f.rule, f.line):
+                result.suppressed.append(f)
+            else:
+                live.append(f)
+        new, old = baseline_mod.split(live, result.file_lines, accepted)
+        result.new.extend(new)
+        result.baselined.extend(old)
+    return result
+
+
+def run(paths: Iterable[str], fmt: str = "text",
+        baseline_path: Optional[str] = None,
+        root: Optional[str] = None,
+        excludes: Tuple[str, ...] = DEFAULT_EXCLUDES) -> Tuple[int, str]:
+    """(exit_code, rendered_output); exit 0 iff no new findings."""
+    result = run_paths(paths, root=root, baseline_path=baseline_path,
+                       excludes=excludes)
+    if fmt == "json":
+        out = json.dumps(result.to_dict(), indent=2)
+    else:
+        lines = [f.format() for f in result.new]
+        lines.append(
+            f"cephlint: {len(result.new)} finding(s) in "
+            f"{result.files_scanned} files "
+            f"({len(result.suppressed)} inline-suppressed, "
+            f"{len(result.baselined)} baselined)"
+        )
+        out = "\n".join(lines)
+    return (1 if result.new else 0), out

@@ -40,8 +40,12 @@ class RemoteClient:
         op_timeout: float = 30.0,
     ) -> "RemoteClient":
         if isinstance(addr_map, str):
-            with open(addr_map) as f:
-                addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+            from ceph_tpu.utils import aio
+
+            addr_map = {
+                k: tuple(v)
+                for k, v in (await aio.read_json(addr_map)).items()
+            }
         if isinstance(keyring, str):
             from ceph_tpu.auth import KeyRing
 

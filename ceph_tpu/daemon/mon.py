@@ -28,8 +28,12 @@ async def serve(args) -> None:
     from ceph_tpu.mon.monitor import Monitor
     from ceph_tpu.msg.tcp import TCPMessenger
 
-    with open(args.addr_map) as f:
-        addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+    from ceph_tpu.utils import aio
+
+    addr_map = {
+        k: tuple(v)
+        for k, v in (await aio.read_json(args.addr_map)).items()
+    }
     name = f"mon.{args.rank}"
     keyring = None
     if args.keyring:

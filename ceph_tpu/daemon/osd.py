@@ -183,8 +183,12 @@ async def serve(args) -> None:
     from ceph_tpu.msg.tcp import TCPMessenger
     from ceph_tpu.osd.ecbackend import OSDShard
 
-    with open(args.addr_map) as f:
-        addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+    from ceph_tpu.utils import aio
+
+    addr_map = {
+        k: tuple(v)
+        for k, v in (await aio.read_json(args.addr_map)).items()
+    }
     name = f"osd.{args.id}"
     keyring = None
     if args.keyring:
@@ -192,13 +196,21 @@ async def serve(args) -> None:
 
         keyring = KeyRing.load(args.keyring)
     messenger = TCPMessenger(name, addr_map, keyring=keyring)
+    mon_ranks = sorted(
+        int(k.split(".", 1)[1]) for k in addr_map if k.startswith("mon.")
+    )
+    conf = None
+    if args.cluster_conf and not mon_ranks:
+        # read the pool conf BEFORE the socket listens: the moment
+        # start() returns, peers replay queued lossless sub-ops (a
+        # revived OSD's backlog), and the stretch from listen to
+        # host_pool below must stay yield-free or early ops are
+        # dispatched into a shard that "hosts no pool"
+        conf = await aio.read_json(args.cluster_conf)
     await messenger.start()
     shard = OSDShard(
         args.id, messenger, op_queue=args.op_queue,
         objectstore=args.objectstore, data_path=args.data_path,
-    )
-    mon_ranks = sorted(
-        int(k.split(".", 1)[1]) for k in addr_map if k.startswith("mon.")
     )
     if mon_ranks:
         # monitor-integrated boot (reference src/ceph_osd.cc:650 ->
@@ -207,13 +219,11 @@ async def serve(args) -> None:
         # heartbeats and report failures -- no static pool conf needed
         await _mon_integrate(args, shard, messenger, addr_map,
                              len(mon_ranks))
-    if args.cluster_conf and not mon_ranks:
+    if conf is not None:
         # legacy static bring-up: host a primary engine for the cluster's
         # pool from a JSON conf: THIS daemon (not the client) owns
         # placement, version authority and sub-op fan-out for objects
         # whose acting set it leads (the PrimaryLogPG role)
-        with open(args.cluster_conf) as f:
-            conf = json.load(f)
         profile = dict(conf["profile"])
         from ceph_tpu.osd.placement import CrushPlacement
 

@@ -32,6 +32,11 @@ def element_bitmatrix(e: int, w: int) -> np.ndarray:
 
 def matrix_to_bitmatrix(M: np.ndarray, w: int) -> np.ndarray:
     """Expand an m x k element matrix into an (m*w) x (k*w) GF(2) bitmatrix."""
+    # runtime backstop for the cephlint jax-gf-dtype-drift rule: a float
+    # element matrix (e.g. np.zeros without dtype) would int()-truncate
+    # per element below and build a plausible-but-wrong bitmatrix
+    assert np.issubdtype(np.asarray(M).dtype, np.integer), \
+        f"element matrix must be an integer dtype, got {np.asarray(M).dtype}"
     m, k = M.shape
     B = np.zeros((m * w, k * w), dtype=np.uint8)
     for i in range(m):
@@ -55,6 +60,10 @@ def n_ones(e: int, w: int) -> int:
 
 def invert_bitmatrix(B: np.ndarray) -> np.ndarray:
     """Invert a square 0/1 matrix over GF(2) (Gauss-Jordan with XOR rows)."""
+    # dtype backstop (cephlint jax-gf-dtype-drift): float input would
+    # silently truncate through the astype below
+    assert np.issubdtype(np.asarray(B).dtype, np.integer), \
+        f"bitmatrix must be an integer dtype, got {np.asarray(B).dtype}"
     B = B.astype(np.uint8).copy()
     n = B.shape[0]
     assert B.shape == (n, n)
@@ -89,6 +98,8 @@ def survivor_decode_bitmatrix(bitmatrix: np.ndarray, k: int, w: int,
     ``erased_data``: erased data-chunk ids; returns a
     [len(erased_data)*w, k*w] bitmatrix applied to the survivors in
     ``sel`` order."""
+    assert bitmatrix.dtype == np.uint8, \
+        f"coding bitmatrix must be uint8, got {bitmatrix.dtype}"
     A = np.zeros((k * w, k * w), dtype=np.uint8)
     for r, cid in enumerate(sel):
         if cid < k:

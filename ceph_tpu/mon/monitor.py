@@ -63,6 +63,8 @@ class Monitor:
         self._election_acks: set = set()
         self._election_done: Optional[asyncio.Future] = None
         self._subscribers: set = set()
+        #: names spawned tasks uniquely (commands, subscriber pushes)
+        self._cmd_seq = 0
         self._cmd_lock = asyncio.Lock()
         self._last_lease = 0.0
         #: pending OSD failure reports: osd id -> {reporter: stamp}
@@ -165,8 +167,17 @@ class Monitor:
                     {"type": "election_ack", "epoch": msg["epoch"]},
                 )
             else:
-                # I outrank them: run my own election
-                asyncio.get_event_loop().create_task(self.start_election())
+                # I outrank them: run my own election (spawned -- it
+                # awaits acks that arrive through this dispatch loop;
+                # adopt_task retains it and logs a crash).  Unique name:
+                # re-using one would untrack a still-running
+                # predecessor, hiding it from shutdown's cancel rounds.
+                self._cmd_seq += 1
+                self.messenger.adopt_task(
+                    f"{self.name}.election{self._cmd_seq}",
+                    asyncio.get_event_loop().create_task(
+                        self.start_election()),
+                )
         elif t == "election_ack":
             if msg["epoch"] == self.election_epoch:
                 self._election_acks.add(src_rank)
@@ -226,9 +237,14 @@ class Monitor:
             )
         elif t == "mon_command":
             # spawn: a proposal awaits peer accepts, which arrive through
-            # this same dispatch loop — handling inline would deadlock
-            asyncio.get_event_loop().create_task(
-                self._handle_command(src, msg)
+            # this same dispatch loop — handling inline would deadlock.
+            # adopt_task retains the task (collectable mid-flight
+            # otherwise) and logs a handler crash.
+            self._cmd_seq += 1
+            self.messenger.adopt_task(
+                f"{self.name}.cmd{self._cmd_seq}",
+                asyncio.get_event_loop().create_task(
+                    self._handle_command(src, msg)),
             )
 
     # -- committed-state application ---------------------------------------
@@ -317,9 +333,12 @@ class Monitor:
             # deep copy per subscriber: the in-process messenger passes
             # dicts by reference, and a receiver mutating its nested
             # map must not corrupt what the others see
-            asyncio.get_event_loop().create_task(
-                self.messenger.send_message(self.name, sub,
-                                            copy.deepcopy(msg))
+            self._cmd_seq += 1
+            self.messenger.adopt_task(
+                f"{self.name}.push{self._cmd_seq}",
+                asyncio.get_event_loop().create_task(
+                    self.messenger.send_message(self.name, sub,
+                                                copy.deepcopy(msg))),
             )
 
     # -- commands (OSDMonitor analogue) ------------------------------------
@@ -832,7 +851,12 @@ class MonCluster:
         """Kick an election from the lowest live rank and wait for quorum."""
         for mon in self.mons:
             if not self.messenger.is_down(mon.name):
-                asyncio.get_event_loop().create_task(mon.start_election())
+                mon._cmd_seq += 1
+                self.messenger.adopt_task(
+                    f"{mon.name}.election{mon._cmd_seq}",
+                    asyncio.get_event_loop().create_task(
+                        mon.start_election()),
+                )
                 break
         leader = await self.wait_for_leader(timeout)
         if self._tick:

@@ -225,12 +225,18 @@ class TCPMessenger:
 
     def adopt_task(self, name: str, task: "asyncio.Task") -> None:
         # completed tasks prune themselves (per-op tasks would otherwise
-        # accumulate without bound on a long-lived daemon)
+        # accumulate without bound on a long-lived daemon) and log any
+        # unhandled exception on the way out
+        from ceph_tpu.utils.aio import log_task_exception
+
         self._tasks[name] = task
-        task.add_done_callback(
-            lambda t, name=name: self._tasks.pop(name, None)
-            if self._tasks.get(name) is t else None
-        )
+
+        def _done(t, name=name):
+            log_task_exception(t, name)
+            if self._tasks.get(name) is t:
+                self._tasks.pop(name, None)
+
+        task.add_done_callback(_done)
 
     async def _dispatch_loop(self, name: str) -> None:
         queue = self._local_queues[name]

@@ -137,12 +137,19 @@ class Messenger:
         """Track an auxiliary task (e.g. a daemon's tick loop) so shutdown
         cancels it with the dispatch loops.  Completed tasks prune
         themselves -- per-op tasks (client ops, notify acks) would
-        otherwise accumulate without bound."""
+        otherwise accumulate without bound -- and log any unhandled
+        exception on the way out: a silently-dead tick loop is the same
+        outage as a wedged one, just later."""
+        from ceph_tpu.utils.aio import log_task_exception
+
         self._tasks[name] = task
-        task.add_done_callback(
-            lambda t, name=name: self._tasks.pop(name, None)
-            if self._tasks.get(name) is t else None
-        )
+
+        def _done(t, name=name):
+            log_task_exception(t, name)
+            if self._tasks.get(name) is t:
+                self._tasks.pop(name, None)
+
+        task.add_done_callback(_done)
 
     # -- failure control (thrasher hooks) ----------------------------------
 

@@ -104,6 +104,29 @@ OPTIONS: Dict[str, Option] = {
         _opt("debug_ec", int, 0, LEVEL_DEV, "EC subsystem log level 0..20"),
         _opt("debug_osd", int, 0, LEVEL_DEV, "OSD subsystem log level 0..20"),
         _opt("debug_ms", int, 0, LEVEL_DEV, "messenger log level 0..20"),
+        # -- keys below are read through the raw env layer
+        # (CEPH_TPU_<NAME>) by call sites that must see runtime env
+        # changes or run before a Config exists; declared here so the
+        # schema stays the single source of truth (cephlint
+        # ceph-config-undeclared-key enforces it) and `config show`
+        # surfaces them.  Defaults mirror the call-site fallbacks.
+        _opt("no_h2d_cache", bool, False, LEVEL_DEV,
+             "disable the device-side H2D stripe cache in the batching "
+             "pipeline (ops/pipeline.py; bench.py toggles this per run "
+             "to measure upload cost)"),
+        _opt("cli_state", str, "", LEVEL_DEV,
+             "path of the ceph CLI's persisted mini-cluster state file "
+             "(tools/ceph_cli.py; empty = its per-user default)"),
+        _opt("bench_probe_timeout", float, 120.0, LEVEL_DEV,
+             "seconds bench.py allows each TPU availability probe"),
+        _opt("bench_retry_secs", float, 600.0, LEVEL_DEV,
+             "total seconds bench.py keeps re-probing for a free TPU "
+             "before falling back"),
+        _opt("bench_retry_interval", float, 30.0, LEVEL_DEV,
+             "seconds between bench.py TPU re-probes"),
+        _opt("bench_fallback", str, "", LEVEL_DEV,
+             "internal bench.py marker: set in the child process after "
+             "a TPU-probe fallback so it reports the real backend"),
     ]
 }
 

@@ -1,0 +1,38 @@
+"""Fixture: async-blocking-call positives and negatives."""
+import asyncio
+import subprocess
+import time
+
+
+async def bad():
+    time.sleep(1.0)  # LINT: async-blocking-call
+    subprocess.run(["true"])  # LINT: async-blocking-call
+    subprocess.check_output(["true"])  # LINT: async-blocking-call
+    with open("/etc/hostname") as f:  # LINT: async-blocking-call
+        return f.read()
+
+
+async def good():
+    await asyncio.sleep(1.0)
+    loop = asyncio.get_event_loop()
+    data = await loop.run_in_executor(None, _read_config)
+    proc = await asyncio.create_subprocess_exec("true")
+    await proc.wait()
+    return data
+
+
+def _read_config():
+    # sync helper: blocking calls are fine OUTSIDE async defs (the
+    # executor runs this off-loop)
+    time.sleep(0.01)
+    with open("/etc/hostname") as f:
+        return f.read()
+
+
+async def nested_sync_def_is_not_flagged():
+    def helper():
+        # body of a nested sync def: runs wherever it is CALLED from,
+        # so the call site is the place to flag, not this body
+        return open("/etc/hostname")
+
+    return await asyncio.get_event_loop().run_in_executor(None, helper)

@@ -1,0 +1,25 @@
+"""Fixture: async-orphan-task positives and negatives (never executed)."""
+import asyncio
+
+
+async def tick_loop():
+    while True:
+        await asyncio.sleep(5.0)
+
+
+def bad_spawns(loop):
+    asyncio.create_task(tick_loop())  # LINT: async-orphan-task
+    loop.create_task(tick_loop())  # LINT: async-orphan-task
+    asyncio.get_event_loop().create_task(tick_loop())  # LINT: async-orphan-task
+    asyncio.ensure_future(tick_loop())  # LINT: async-orphan-task
+
+
+def good_spawns(loop, messenger):
+    # retained reference
+    task = loop.create_task(tick_loop())
+    # handed to a keeper (argument position, not a dropped statement)
+    messenger.adopt_task("tick", loop.create_task(tick_loop()))
+    # retained + exception-logging done-callback
+    t2 = asyncio.create_task(tick_loop())
+    t2.add_done_callback(lambda t: t.exception())
+    return task, t2

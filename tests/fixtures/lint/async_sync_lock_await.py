@@ -1,0 +1,42 @@
+"""Fixture: async-sync-lock-await positives and negatives."""
+import asyncio
+import threading
+
+_lock = threading.Lock()
+_alock = asyncio.Lock()
+
+
+async def bad(messenger):
+    with _lock:
+        await messenger.flush()  # LINT: async-sync-lock-await
+
+
+class Store:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._oplock = asyncio.Lock()
+
+    async def bad_method(self, txn):
+        with self._lock:
+            await txn.commit()  # LINT: async-sync-lock-await
+
+    async def good_async_with(self, txn):
+        async with self._oplock:
+            await txn.commit()  # asyncio lock held across await: fine
+
+    def good_sync_use(self):
+        with self._lock:
+            return 1  # no await under the lock: fine
+
+    async def good_non_lock_cm(self, path):
+        with memoryview(b"x") as mv:  # not a lock: fine
+            await asyncio.sleep(0)
+            return mv
+
+    async def nested_def_escapes(self):
+        with self._lock:
+            async def later():
+                # runs AFTER the with-block exits, not under the lock
+                await asyncio.sleep(0)
+
+            return later

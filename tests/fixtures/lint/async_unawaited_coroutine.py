@@ -1,0 +1,46 @@
+"""Fixture: async-unawaited-coroutine positives and negatives."""
+import asyncio
+
+
+async def ping():
+    await asyncio.sleep(0)
+
+
+def sync_helper():
+    return 42
+
+
+async def caller():
+    ping()  # LINT: async-unawaited-coroutine
+    await ping()       # awaited: fine
+    sync_helper()      # plain sync call: fine
+    t = asyncio.create_task(ping())  # spawned: fine
+    return t
+
+
+class Daemon:
+    async def beat(self):
+        await asyncio.sleep(0)
+
+    def sync_beat(self):
+        return 0
+
+    def kick(self):
+        self.beat()  # LINT: async-unawaited-coroutine
+        self.sync_beat()   # sync method: fine
+
+
+def shadowing():
+    # an async def nested in SOME OTHER function must not taint the
+    # module-level sync name (the tests/test_osd.py `run(coro)` pattern)
+    async def run():
+        await asyncio.sleep(0)
+
+    return run
+
+
+def run(coro):
+    return asyncio.get_event_loop().run_until_complete(coro)
+
+
+run(None)  # resolves to the module-level sync run(): fine

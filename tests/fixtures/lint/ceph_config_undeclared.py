@@ -1,0 +1,29 @@
+"""Fixture: ceph-config-undeclared-key."""
+import os
+
+from ceph_tpu.utils.config import get_config
+
+_GOOD_ENV = "CEPH_TPU_NO_H2D_CACHE"
+_BAD_ENV = "CEPH_TPU_PHANTOM_KNOB"
+
+
+def reads():
+    cfg = get_config()
+    cfg.get_val("phantom_option")  # LINT: ceph-config-undeclared-key
+    cfg.set_val("another_phantom", 3)  # LINT: ceph-config-undeclared-key
+    os.environ.get("CEPH_TPU_PHANTOM_KNOB")  # LINT: ceph-config-undeclared-key
+    os.environ.get(_BAD_ENV)  # LINT: ceph-config-undeclared-key
+    os.environ["CEPH_TPU_PHANTOM_KNOB"] = "1"  # LINT: ceph-config-undeclared-key
+    os.getenv("CEPH_TPU_PHANTOM_KNOB")  # LINT: ceph-config-undeclared-key
+
+    # declared keys: fine
+    cfg.get_val("lockdep")
+    cfg.set_val("debug_ec", 10)
+    os.environ.get("CEPH_TPU_NO_H2D_CACHE")
+    os.environ.get(_GOOD_ENV)
+    # non-config env vars (no CEPH_TPU_ prefix): out of scope
+    os.environ.get("HOME")
+    # dynamic keys are unresolvable without running the code: skipped
+    subsys = "ec"
+    cfg.get_val(f"debug_{subsys}")
+    return cfg

@@ -1,0 +1,47 @@
+"""Fixture: ceph-encoding-version-pair."""
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+JOURNAL_VERSION = 2
+RECORD_VERSION = 1
+
+
+class WriteOnlyRecord:
+    def encode(self) -> bytes:  # LINT: ceph-encoding-version-pair
+        return Encoder().u8(RECORD_VERSION).string("x").bytes()
+
+
+class VersionSkewRecord:
+    # encode stamps JOURNAL_VERSION but decode never reads it back
+    def encode(self) -> bytes:  # LINT: ceph-encoding-version-pair
+        return Encoder().u8(JOURNAL_VERSION).string("x").bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VersionSkewRecord":
+        dec = Decoder(data)
+        dec.u8()  # version byte dropped on the floor
+        return cls()
+
+
+class GoodRecord:
+    def encode(self) -> bytes:
+        return Encoder().u8(RECORD_VERSION).string("x").bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "GoodRecord":
+        dec = Decoder(data)
+        v = dec.u8()
+        assert v <= RECORD_VERSION
+        return cls()
+
+
+def encode_entry(seq: int) -> bytes:
+    return Encoder().varint(seq).bytes()
+
+
+def decode_entry(data: bytes) -> int:
+    return Decoder(data).varint()
+
+
+def decode_legacy_entry(data: bytes):  # LINT: ceph-encoding-version-pair
+    # reader with no writer: the one-sided twin is also flagged
+    return Decoder(data).varint()

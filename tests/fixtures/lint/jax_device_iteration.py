@@ -1,0 +1,23 @@
+"""Fixture: jax-device-array-iteration."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def bad_iteration(chunks):
+    dev = jnp.asarray(chunks)
+    total = 0
+    for row in dev:  # LINT: jax-device-array-iteration
+        total += row.sum()
+    return total
+
+
+def good_iteration(chunks):
+    dev = jnp.asarray(chunks)
+    host = np.asarray(jax.device_get(dev))
+    total = 0
+    for row in host:  # host array after one D2H: fine
+        total += row.sum()
+    for c in chunks:  # plain python sequence: fine
+        total += len(c)
+    return total

@@ -1,0 +1,21 @@
+"""Fixture: jax-gf-dtype-drift (tested under a pseudo path inside
+ceph_tpu/matrices/ -- the rule is scoped to GF kernel code)."""
+import numpy as np
+
+
+def bad_builders(k, w):
+    A = np.zeros((k, k))  # LINT: jax-gf-dtype-drift
+    B = np.empty(k * w)  # LINT: jax-gf-dtype-drift
+    idx = np.arange(256)  # LINT: jax-gf-dtype-drift
+    C = np.zeros((k, k), dtype=np.float64)  # LINT: jax-gf-dtype-drift
+    D = A.astype(np.float64)  # LINT: jax-gf-dtype-drift
+    return A, B, idx, C, D
+
+
+def good_builders(k, w):
+    A = np.zeros((k, k), dtype=np.uint8)
+    B = np.empty(k * w, np.uint8)           # positional dtype: fine
+    idx = np.arange(256, dtype=np.uint32)   # wider word, explicit: fine
+    E = np.eye(w, dtype=np.uint8)
+    F = A.astype(np.float32)  # the sanctioned MXU float detour: fine
+    return A, B, idx, E, F

@@ -1,0 +1,40 @@
+"""Fixture: jax-host-sync-hot-path (tested under a pseudo path inside
+ceph_tpu/ops/ -- the rule is scoped to the codec hot paths)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def per_stripe_decode(granules):
+    out = []
+    for g in granules:
+        host = np.asarray(g.out)  # LINT: jax-host-sync-hot-path
+        out.append(host)
+    while granules:
+        g = granules.pop()
+        g.out.block_until_ready()  # LINT: jax-host-sync-hot-path
+        jax.device_get(g.out)  # LINT: jax-host-sync-hot-path
+    return out
+
+
+def per_element_pull(arr, idx):
+    total = 0
+    for i in idx:
+        total += int(arr[i])  # LINT: jax-host-sync-hot-path
+    return total
+
+
+@jax.jit
+def kernel(x):
+    y = jnp.dot(x, x)
+    return np.asarray(y)  # LINT: jax-host-sync-hot-path
+
+
+def boundary_wrapper(chunks):
+    # ONE conversion at the wrapper boundary is the designed H2D/D2H
+    # edge: not flagged
+    dev = jnp.asarray(np.ascontiguousarray(chunks))
+    out = kernel(dev)
+    host = np.asarray(out)
+    n = int(host.shape[0])  # int() on a non-subscript: fine
+    return host, n

@@ -1,0 +1,16 @@
+"""Fixture: inline suppression syntax (the findings here are REAL but
+suppressed; the test asserts they land in the suppressed bucket)."""
+import asyncio
+import time
+
+
+async def tolerated():
+    # same-line disable
+    time.sleep(0.1)  # cephlint: disable=async-blocking-call
+    # next-line disable
+    # cephlint: disable-next-line=async-blocking-call
+    time.sleep(0.2)
+    # disable=all
+    asyncio.create_task(tolerated())  # cephlint: disable=all
+    # an unrelated disable does NOT cover this rule
+    time.sleep(0.3)  # cephlint: disable=async-orphan-task  # LINT: async-blocking-call

@@ -122,8 +122,9 @@ def test_mon_backed_key_provisioning():
 
         async def run():
             from ceph_tpu.daemon.client import RemoteClient
+            from ceph_tpu.utils import aio
 
-            conf = json.load(open(f"{run_dir}/cluster.json"))
+            conf = await aio.read_json(f"{run_dir}/cluster.json")
             c = await RemoteClient.connect(
                 f"{run_dir}/addr_map.json", conf["profile"],
                 keyring=f"{run_dir}/keyring")
@@ -135,8 +136,10 @@ def test_mon_backed_key_provisioning():
             from ceph_tpu.mon.monitor import MonClient
             from ceph_tpu.msg.tcp import TCPMessenger
 
-            with open(f"{run_dir}/addr_map.json") as f:
-                addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+            addr_map = {
+                k: tuple(v) for k, v in
+                (await aio.read_json(f"{run_dir}/addr_map.json")).items()
+            }
             ring = KeyRing.load(f"{run_dir}/keyring")
             ms = TCPMessenger("client", addr_map, keyring=ring)
             await ms.start()

@@ -1,0 +1,212 @@
+"""cephlint tier-1 tests: per-rule fixtures, the repo-wide
+zero-new-findings gate, and the PR-1 wedge pattern.
+
+Fixture convention (tests/fixtures/lint/): every line a rule must flag
+carries a trailing ``# LINT: <rule>[,<rule>...]`` annotation; the test
+asserts the analyzer's finding set equals the annotation set EXACTLY,
+so both missed positives and over-matched negatives fail.  Path-scoped
+rules (the jax pack) are exercised by presenting the fixture under a
+pseudo hot-path name.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ceph_tpu.analysis import baseline as baseline_mod
+from ceph_tpu.analysis import runner
+from ceph_tpu.analysis import suppress as suppress_mod
+from ceph_tpu.analysis.core import all_rules
+from ceph_tpu.analysis.runner import scan_file
+
+REPO = runner.repo_root()
+FIXTURE_DIR = os.path.join(REPO, "tests", "fixtures", "lint")
+
+#: fixture file -> pseudo path the analyzer sees (path-scoped rules)
+FIXTURES = {
+    "async_orphan_task.py": None,
+    "async_unawaited_coroutine.py": None,
+    "async_blocking_call.py": None,
+    "async_sync_lock_await.py": None,
+    "jax_host_sync.py": "ceph_tpu/ops/_fixture_host_sync.py",
+    "jax_gf_dtype_drift.py": "ceph_tpu/matrices/_fixture_dtype.py",
+    "jax_device_iteration.py": None,
+    "ceph_config_undeclared.py": None,
+    "ceph_encoding_version_pair.py": None,
+    "suppressions.py": None,
+}
+
+_ANNOT = re.compile(r"#\s*LINT:\s*([a-z0-9\-]+(?:\s*,\s*[a-z0-9\-]+)*)")
+
+
+def _expected(source: str):
+    out = set()
+    for i, line in enumerate(source.splitlines(), start=1):
+        m = _ANNOT.search(line)
+        if m:
+            for r in m.group(1).split(","):
+                out.add((r.strip(), i))
+    return out
+
+
+def _lint(pseudo_path: str, source: str):
+    """scan + inline suppressions (the runner's per-file pipeline,
+    without touching the baseline): returns (new, suppressed)."""
+    raw = scan_file(pseudo_path, source)
+    sup = suppress_mod.parse_suppressions(source)
+    new = [f for f in raw
+           if not suppress_mod.is_suppressed(sup, f.rule, f.line)]
+    suppressed = [f for f in raw
+                  if suppress_mod.is_suppressed(sup, f.rule, f.line)]
+    return new, suppressed
+
+
+@pytest.mark.parametrize("fixture", sorted(FIXTURES))
+def test_fixture_rules_fire_exactly_where_annotated(fixture):
+    with open(os.path.join(FIXTURE_DIR, fixture)) as fh:
+        source = fh.read()
+    pseudo = FIXTURES[fixture] or f"tests/fixtures/lint/{fixture}"
+    new, _sup = _lint(pseudo, source)
+    got = {(f.rule, f.line) for f in new}
+    want = _expected(source)
+    assert got == want, (
+        f"{fixture}: findings != annotations\n"
+        f"  unexpected: {sorted(got - want)}\n"
+        f"  missed:     {sorted(want - got)}"
+    )
+
+
+def test_every_rule_has_positive_and_negative_fixture_coverage():
+    """Each shipped rule must fire somewhere in the fixtures (positive)
+    and each fixture must contain unflagged code (negative coverage is
+    implied by the exact-match test above)."""
+    fired = set()
+    for fixture, pseudo in FIXTURES.items():
+        with open(os.path.join(FIXTURE_DIR, fixture)) as fh:
+            source = fh.read()
+        new, sup = _lint(pseudo or f"tests/fixtures/lint/{fixture}", source)
+        fired.update(f.rule for f in new + sup)
+    missing = {
+        name for name in all_rules() if name not in fired
+    }
+    assert not missing, f"rules with no positive fixture: {sorted(missing)}"
+
+
+def test_suppression_buckets():
+    with open(os.path.join(FIXTURE_DIR, "suppressions.py")) as fh:
+        source = fh.read()
+    new, suppressed = _lint("tests/fixtures/lint/suppressions.py", source)
+    # 2 disabled blocking-calls + 1 disabled orphan-task stay visible in
+    # the suppressed bucket (and the audit), not silently gone
+    assert len(suppressed) == 3
+    assert {f.rule for f in new} == {"async-blocking-call"}
+    audit = suppress_mod.audit("x.py", source)
+    assert len(audit) == 4  # 3 disable= + 1 disable-next-line=
+
+
+def test_pr1_wedge_pattern_is_caught():
+    """The exact shape that cost PR 1 a round: a messenger tick loop
+    spawned with create_task and the task object dropped."""
+    src = textwrap.dedent(
+        """
+        import asyncio
+
+        class Messenger:
+            def start_tick(self, interval):
+                async def tick():
+                    while True:
+                        await asyncio.sleep(interval)
+                        await self._lease_probe()
+
+                asyncio.get_event_loop().create_task(tick())
+        """
+    )
+    new, _ = _lint("ceph_tpu/osd/_fixture_wedge.py", src)
+    assert any(f.rule == "async-orphan-task" for f in new), \
+        "the PR-1 dropped-tick-loop pattern must be flagged"
+
+
+def test_repo_wide_gate_zero_new_findings():
+    """THE gate: the analyzer over ceph_tpu/tools/tests with the
+    checked-in baseline reports zero new findings.  If this fails you
+    either fix the finding, add a justified inline disable, or (for
+    accepted legacy only) regenerate the baseline with
+    `python tools/cephlint.py --write-baseline` and review the diff."""
+    bl = os.path.join(REPO, "tools", "cephlint_baseline.json")
+    result = runner.run_paths(
+        ["ceph_tpu", "tools", "tests"], root=REPO,
+        baseline_path=bl if os.path.exists(bl) else None,
+    )
+    assert result.files_scanned > 150  # the scan actually covered the tree
+    msgs = "\n".join(f.format() for f in result.new)
+    assert not result.new, f"new cephlint findings:\n{msgs}"
+
+
+def test_baseline_roundtrip(tmp_path):
+    """--write-baseline accepts current findings; a rerun is clean; a
+    NEW instance of the same rule still fails."""
+    src = "import time\nasync def f():\n    time.sleep(1)\n"
+    f1 = tmp_path / "mod.py"
+    f1.write_text(src)
+    res = runner.run_paths([str(f1)], root=str(tmp_path))
+    assert len(res.new) == 1
+    bl = tmp_path / "baseline.json"
+    baseline_mod.write(str(bl), res.new, res.file_lines,
+                       res.suppression_audit)
+    res2 = runner.run_paths([str(f1)], root=str(tmp_path),
+                            baseline_path=str(bl))
+    assert not res2.new and len(res2.baselined) == 1
+    # a second, new blocking call is NOT covered by the baseline entry
+    f1.write_text(src + "    time.sleep(2)\n")
+    res3 = runner.run_paths([str(f1)], root=str(tmp_path),
+                            baseline_path=str(bl))
+    assert len(res3.new) == 1 and len(res3.baselined) == 1
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    bad = tmp_path / "broken.py"
+    bad.write_text("def f(:\n")
+    res = runner.run_paths([str(bad)], root=str(tmp_path))
+    assert [f.rule for f in res.new] == ["parse-error"]
+
+
+def test_cli_json_format_and_exit_codes(tmp_path):
+    """tools/cephlint.py --format json: machine-readable output (the
+    bench.py lint_findings_total trend source) and exit-code contract."""
+    clean = tmp_path / "clean.py"
+    clean.write_text("import asyncio\n\nasync def f():\n"
+                     "    await asyncio.sleep(0)\n")
+    dirty = tmp_path / "dirty.py"
+    dirty.write_text("import time\n\nasync def f():\n    time.sleep(1)\n")
+    cli = os.path.join(REPO, "tools", "cephlint.py")
+    env = dict(os.environ, PYTHONPATH=REPO)
+    ok = subprocess.run(
+        [sys.executable, cli, "--format", "json", str(clean)],
+        capture_output=True, text=True, env=env)
+    assert ok.returncode == 0
+    data = json.loads(ok.stdout)
+    assert data["lint_findings_total"] == 0
+    bad = subprocess.run(
+        [sys.executable, cli, "--format", "json", str(dirty)],
+        capture_output=True, text=True, env=env)
+    assert bad.returncode == 1
+    data = json.loads(bad.stdout)
+    assert data["lint_findings_total"] == 1
+    assert data["findings"][0]["rule"] == "async-blocking-call"
+    assert data["counts_by_rule"] == {"async-blocking-call": 1}
+
+
+def test_config_registry_extraction_matches_runtime():
+    """The rule parses OPTIONS from the AST; it must agree with the
+    imported registry (drift here would silently blind the rule)."""
+    from ceph_tpu.analysis.rules_config import declared_options
+    from ceph_tpu.utils.config import OPTIONS
+
+    assert set(declared_options()) == set(OPTIONS)

@@ -347,8 +347,13 @@ def test_mon_integrated_boot_heartbeat_markdown(tmp_path):
         from ceph_tpu.mon.monitor import MonClient
         from ceph_tpu.msg.tcp import TCPMessenger
 
-        with open(os.path.join(run_dir, "addr_map.json")) as f:
-            addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+        from ceph_tpu.utils import aio
+
+        addr_map = {
+            k: tuple(v) for k, v in
+            (await aio.read_json(
+                os.path.join(run_dir, "addr_map.json"))).items()
+        }
         ms = TCPMessenger("client", addr_map)
         await ms.start()
         monc = MonClient(ms, 3, "client")

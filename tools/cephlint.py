@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""cephlint CLI: AST-based static analysis over the ceph_tpu tree.
+
+  python tools/cephlint.py ceph_tpu tools tests
+  python tools/cephlint.py --format json ceph_tpu | jq .lint_findings_total
+  python tools/cephlint.py --write-baseline ceph_tpu tools tests
+  python tools/cephlint.py --list-rules
+
+Exit code 0 means zero NEW findings (inline-suppressed and baselined
+findings don't count); the tier-1 gate (tests/test_cephlint.py) runs
+exactly this over the repo.  See docs/cephlint.md for the rule catalog,
+suppression syntax and the baseline workflow.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from ceph_tpu.analysis import baseline as baseline_mod  # noqa: E402
+from ceph_tpu.analysis import runner  # noqa: E402
+from ceph_tpu.analysis.core import all_rules  # noqa: E402
+
+DEFAULT_BASELINE = os.path.join("tools", "cephlint_baseline.json")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/directories to scan "
+                         "(default: ceph_tpu tools tests)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--baseline", default=None,
+                    help=f"baseline file (default {DEFAULT_BASELINE} "
+                         "when it exists)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline (report accepted legacy "
+                         "findings too)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept the current findings: regenerate the "
+                         "baseline file (plus the inline-disable audit) "
+                         "and exit 0")
+    ap.add_argument("--include-fixtures", action="store_true",
+                    help="also scan tests/fixtures/lint (the deliberate "
+                         "positive examples; excluded by default)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for r in sorted(all_rules().values(), key=lambda r: (r.pack, r.name)):
+            print(f"{r.name}  [{r.pack}/{r.severity}]\n    {r.description}")
+        return 0
+
+    root = runner.repo_root()
+    paths = args.paths or ["ceph_tpu", "tools", "tests"]
+    excludes = () if args.include_fixtures else runner.DEFAULT_EXCLUDES
+
+    baseline_path = args.baseline
+    if baseline_path is None:
+        cand = os.path.join(root, DEFAULT_BASELINE)
+        baseline_path = cand if os.path.exists(cand) else None
+    if args.no_baseline:
+        baseline_path = None
+
+    if args.write_baseline:
+        result = runner.run_paths(paths, root=root, baseline_path=None,
+                                  excludes=excludes)
+        out_path = args.baseline or os.path.join(root, DEFAULT_BASELINE)
+        # inline-suppressed findings stay OUT of the baseline (their
+        # acceptance lives next to the code); everything else in
+        baseline_mod.write(out_path, result.new, result.file_lines,
+                           result.suppression_audit)
+        print(f"cephlint: wrote {len(result.new)} accepted finding(s) and "
+              f"{len(result.suppression_audit)} inline-disable audit "
+              f"entries to {os.path.relpath(out_path, root)}")
+        return 0
+
+    code, out = runner.run(paths, fmt=args.format,
+                           baseline_path=baseline_path, root=root,
+                           excludes=excludes)
+    print(out)
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

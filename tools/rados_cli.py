@@ -32,11 +32,11 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from ceph_tpu.daemon.client import RemoteClient  # noqa: E402
 from ceph_tpu.utils.admin_socket import admin_command  # noqa: E402
+from ceph_tpu.utils import aio  # noqa: E402
 
 
 async def _connect(run_dir: str) -> RemoteClient:
-    with open(os.path.join(run_dir, "cluster.json")) as f:
-        conf = json.load(f)
+    conf = await aio.read_json(os.path.join(run_dir, "cluster.json"))
     keyring = None
     kr_path = os.path.join(run_dir, "keyring")
     if conf.get("auth") and os.path.exists(kr_path):
@@ -79,15 +79,13 @@ async def _run(args) -> int:
     c = await _connect(args.dir)
     try:
         if args.cmd == "put":
-            with open(args.args[1], "rb") as f:
-                data = f.read()
+            data = await aio.read_bytes(args.args[1])
             await c.write(args.args[0], data)
             print(f"wrote {len(data)} bytes to {args.args[0]}")
         elif args.cmd == "get":
             data = await c.read(args.args[0])
             if len(args.args) > 1 and args.args[1] != "-":
-                with open(args.args[1], "wb") as f:
-                    f.write(data)
+                await aio.write_bytes(args.args[1], data)
                 print(f"read {len(data)} bytes from {args.args[0]}")
             else:
                 sys.stdout.buffer.write(data)

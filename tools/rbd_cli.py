@@ -21,6 +21,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from ceph_tpu.osd.cluster import ECCluster  # noqa: E402
 from ceph_tpu.rbd import RBD, Image  # noqa: E402
+from ceph_tpu.utils import aio  # noqa: E402
 
 
 def _cluster(args):
@@ -54,8 +55,7 @@ async def _run(args) -> int:
             await rbd.remove(args.image)
             print(f"removed {args.image}")
         elif args.cmd == "import":
-            with open(args.src, "rb") as f:
-                data = f.read()
+            data = await aio.read_bytes(args.src)
             await rbd.create(args.image, len(data), order=args.order)
             img = await Image.open(c.backend, args.image)
             await img.write(0, data)
@@ -63,8 +63,7 @@ async def _run(args) -> int:
         elif args.cmd == "export":
             img = await Image.open(c.backend, args.image)
             data = await img.read(0, img.size)
-            with open(args.dst, "wb") as f:
-                f.write(data)
+            await aio.write_bytes(args.dst, data)
             print(f"exported {args.image} -> {args.dst} ({len(data)} bytes)")
         elif args.cmd == "snap":
             if args.snap_cmd == "ls":

@@ -26,11 +26,11 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 from ceph_tpu.daemon.client import RemoteClient  # noqa: E402
 from ceph_tpu.rbd.nbd import NBDServer  # noqa: E402
+from ceph_tpu.utils import aio  # noqa: E402
 
 
 async def serve(args) -> None:
-    with open(os.path.join(args.dir, "cluster.json")) as f:
-        conf = json.load(f)
+    conf = await aio.read_json(os.path.join(args.dir, "cluster.json"))
     keyring = os.path.join(args.dir, "keyring")
     c = await RemoteClient.connect(
         os.path.join(args.dir, "addr_map.json"), dict(conf["profile"]),

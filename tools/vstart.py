@@ -197,8 +197,13 @@ async def _bootstrap_pools(run_dir, n_osds, profile, pool="ecpool",
     from ceph_tpu.mon.monitor import MonClient
     from ceph_tpu.msg.tcp import TCPMessenger
 
-    with open(os.path.join(run_dir, "addr_map.json")) as f:
-        addr_map = {k: tuple(v) for k, v in json.load(f).items()}
+    from ceph_tpu.utils import aio
+
+    addr_map = {
+        k: tuple(v) for k, v in
+        (await aio.read_json(os.path.join(run_dir,
+                                          "addr_map.json"))).items()
+    }
     n_mons = sum(1 for k in addr_map if k.startswith("mon."))
     keyring = None
     if auth:
@@ -379,9 +384,9 @@ def stop_cluster(run_dir):
 
 async def _client(run_dir):
     from ceph_tpu.daemon.client import RemoteClient
+    from ceph_tpu.utils import aio
 
-    with open(os.path.join(run_dir, "cluster.json")) as f:
-        conf = json.load(f)
+    conf = await aio.read_json(os.path.join(run_dir, "cluster.json"))
     keyring = (
         os.path.join(run_dir, "keyring") if conf.get("auth") else None
     )
