@@ -488,6 +488,24 @@ def main() -> int:
 
     sp_host = _secondary(_storage_path_host)
 
+    def _cluster_path_host():
+        """Round-8 tentpole metric: the DISTRIBUTED storage path over
+        real localhost TCP sockets -- multi-daemon OSDShards + a client
+        Objecter, per-message wire vs corked/zero-copy wire (v4
+        piggybacked-ack protocol), bit-exactness gated before timing,
+        plus a messenger-level wire stage (same fan-out shape, codec
+        and OSD costs excluded) and the wire-shape counters: frames per
+        syscall-burst, bytes per drain, piggybacked-ack ratio
+        (ceph_tpu/msg/cluster_bench.py).  The jerasure codec keeps this
+        stage device-independent -- no relay in the loop."""
+        from ceph_tpu.msg.cluster_bench import run_cluster_path_bench
+
+        return run_cluster_path_bench(
+            cpu_ec, n_objects=64, obj_bytes=16 << 10, writers=8, iters=2
+        )
+
+    cp_host = _secondary(_cluster_path_host)
+
     def _lint_findings_total():
         """Static-health trend metric: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json).
@@ -533,6 +551,24 @@ def main() -> int:
         "storage_path_host_read_speedup": (
             sp_host["read_speedup"] if sp_host else None),
         "storage_path_host": sp_host,
+        "cluster_path_host_write_speedup": (
+            cp_host["write_speedup"] if cp_host else None),
+        "cluster_path_host_read_speedup": (
+            cp_host["read_speedup"] if cp_host else None),
+        "cluster_path_host_wire_speedup": (
+            cp_host["wire_write_speedup"] if cp_host else None),
+        "cluster_path_host_corked_write_MiBs": (
+            cp_host["corked"]["write_MiBs"] if cp_host else None),
+        "cluster_path_host_frames_per_burst": (
+            cp_host["wire_corked"]["counters"]["frames_per_burst"]
+            if cp_host else None),
+        "cluster_path_host_bytes_per_drain": (
+            cp_host["wire_corked"]["counters"]["bytes_per_drain"]
+            if cp_host else None),
+        "cluster_path_host_ack_piggyback_ratio": (
+            cp_host["wire_corked"]["counters"]["ack_piggyback_ratio"]
+            if cp_host else None),
+        "cluster_path_host": cp_host,
         "lint_findings_total": lint_total,
         "platform": jax.devices()[0].platform + (
             "-fallback"
@@ -552,7 +588,10 @@ def main() -> int:
         f"{cpu_combined:.3f}; tunnel h2d {h2d:.3f} d2h {d2h:.3f} -> encode "
         f"ceiling {ceiling:.3f}; device-resident {dev} GiB/s, "
         f"storage-path {storage} GiB/s, host storage-path coalesced "
-        f"{sp_host['write_speedup'] if sp_host else '?'}x per-op on "
+        f"{sp_host['write_speedup'] if sp_host else '?'}x per-op, "
+        f"cluster-path corked {cp_host['write_speedup'] if cp_host else '?'}"
+        f"x full-stack / {cp_host['wire_write_speedup'] if cp_host else '?'}"
+        f"x wire vs per-message on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

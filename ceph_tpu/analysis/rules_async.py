@@ -151,6 +151,59 @@ def check_blocking_call(ctx: FileContext) -> Iterator[Finding]:
             )
 
 
+@rule(
+    "async-drain-per-item", "async", SEV_WARNING,
+    "`await writer.drain()` inside a per-item loop that also writes: "
+    "one flush (and its coroutine round) per message is the classic "
+    "small-message wire overhead -- batch the writes (writelines) and "
+    "drain once per burst, or drain on a byte threshold (flow control), "
+    "the round-8 corked-messenger discipline",
+)
+def check_drain_per_item(ctx: FileContext) -> Iterator[Finding]:
+    from ceph_tpu.analysis.core import enclosing_functions
+
+    parents = ctx.parent_map()
+
+    def innermost_loop(node, holder):
+        """Nearest enclosing loop of ``node`` within the same function
+        (a nested def's body does not run under the outer loop)."""
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return cur
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not (isinstance(node, ast.Await) and
+                isinstance(node.value, ast.Call) and
+                call_attr(node.value) == "drain"):
+            continue
+        loop = innermost_loop(node, None)
+        if loop is None:
+            continue
+        holder = enclosing_functions(ctx, node)
+        # per-ITEM: the same innermost loop body performs a unit
+        # `.write(...)` -- a loop that only writelines per burst, or
+        # whose writes happen in a nested (inner) loop with the drain
+        # outside it, is the per-burst shape and stays clean
+        for inner in ast.walk(loop):
+            if isinstance(inner, ast.Call) and \
+                    call_attr(inner) == "write" and \
+                    innermost_loop(inner, None) is loop and \
+                    enclosing_functions(ctx, inner) == holder:
+                yield ctx.finding(
+                    "async-drain-per-item", node,
+                    "await drain() and a per-item write share this loop "
+                    "body; cork the writes (writer.writelines once per "
+                    "burst) and drain per burst or on a byte threshold",
+                )
+                break
+        # one finding per drain site is enough
+
+
 def _mentions_lock(node: ast.expr) -> bool:
     """Context-manager expression names a lock: `lock`, `self._lock`,
     `self._conn_lock(node)` ...  The lockdep convention (utils/lockdep)

@@ -1,0 +1,116 @@
+"""Messenger fault injection (ms_inject_* analogue).
+
+Reference: the ms_inject_socket_failures / ms_inject_delay knobs in
+src/common/options.cc:735-756 drive the messenger layer directly.  This
+module lives in ``ceph_tpu.msg`` because the TRANSPORT owns failure
+injection; it predates the TCP messenger and used to live in
+``ceph_tpu.osd.messenger`` (an osd -> msg layering inversion fixed in
+round 8 -- the OSD layer re-exports it for compatibility).
+
+Besides per-message drop/delay, the injector can kill a CONNECTION
+mid-burst (``schedule_conn_kill``): the corked send path asks
+``conn_kill_split`` how many frames of the next burst may be written
+before the transport must be torn down, which is how the lossless-replay
+tests manufacture a torn burst deterministically.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import random
+from typing import Optional
+
+
+class FaultInjector:
+    """ms_inject_* analogue; probabilities in [0, 1]."""
+
+    def __init__(self, drop_probability: float = 0.0,
+                 delay_probability: float = 0.0,
+                 max_delay: float = 0.0, seed: int = 0):
+        self.drop_probability = drop_probability
+        self.delay_probability = delay_probability
+        self.max_delay = max_delay
+        self._rng = random.Random(seed)
+        self.dropped = 0
+        #: one-shot connection kill: abort the wire after this many more
+        #: frames have been written (None = disarmed)
+        self._conn_kill_countdown: Optional[int] = None
+        self.conn_kills = 0
+
+    @classmethod
+    def from_config(cls) -> "FaultInjector":
+        """Build from the ms_inject_* options AND track runtime changes
+        through a config observer (reference: the injection knobs in
+        src/common/options.cc drive the messenger directly and respond
+        to injectargs; qa suites just set the config, before OR after
+        the daemons boot)."""
+        import weakref
+
+        from ceph_tpu.utils.config import get_config
+
+        cfg = get_config()
+        inj = cls()
+
+        def _sync(target):
+            n = int(cfg.get_val("ms_inject_socket_failures") or 0)
+            delay_p = float(cfg.get_val("ms_inject_internal_delays")
+                            or 0.0)
+            target.drop_probability = (1.0 / n) if n > 0 else 0.0
+            target.delay_probability = delay_p
+            target.max_delay = 0.05 if delay_p else 0.0
+
+        _sync(inj)
+        # the observer must not keep the injector (and its messenger)
+        # alive forever: hold it weakly and self-remove once the owner
+        # is gone, or a harness that churns clusters would grow the
+        # global observer list without bound
+        ref = weakref.ref(inj)
+
+        def _obs(changed):
+            target = ref()
+            if target is None:
+                try:
+                    cfg._observers.remove(_obs)
+                except ValueError:
+                    pass
+                return
+            if changed & {"ms_inject_socket_failures",
+                          "ms_inject_internal_delays"}:
+                _sync(target)
+
+        cfg.add_observer(_obs)
+        return inj
+
+    def maybe_drop(self) -> bool:
+        if self.drop_probability and \
+                self._rng.random() < self.drop_probability:
+            self.dropped += 1
+            return True
+        return False
+
+    async def maybe_delay(self) -> None:
+        if self.delay_probability and \
+                self._rng.random() < self.delay_probability:
+            await asyncio.sleep(self._rng.random() * self.max_delay)
+
+    # -- connection-level injection (torn-burst manufacture) ---------------
+
+    def schedule_conn_kill(self, after_frames: int) -> None:
+        """Arm a one-shot kill: the connection carrying the Nth next
+        frame is aborted BEFORE that frame is written (a burst is torn
+        mid-flight, the replay tests' worst case)."""
+        self._conn_kill_countdown = max(0, after_frames)
+
+    def conn_kill_split(self, nframes: int) -> int:
+        """How many of the next ``nframes`` frames may be written before
+        an armed kill fires; -1 when no kill is due within the burst.
+        Firing disarms the injector (one-shot) and counts the kill."""
+        if self._conn_kill_countdown is None:
+            return -1
+        if self._conn_kill_countdown >= nframes:
+            self._conn_kill_countdown -= nframes
+            return -1
+        split = self._conn_kill_countdown
+        self._conn_kill_countdown = None
+        self.conn_kills += 1
+        return split

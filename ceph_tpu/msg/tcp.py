@@ -31,10 +31,31 @@ this node short-circuit delivery in process (the reference's local
 fast-dispatch for self-sends, ECBackend.cc:2025-2032).
 
 Frames on the socket are ``encoding.frame`` records (magic+len+crc32c);
-payloads start with a kind byte: MSG (src|dst|seq|body), ACK (seq), or
-SESSION (the reconnect watermark exchange).  The first frame on every
-outgoing connection is a banner naming the sender node, protocol
-version, and instance id (Pipe.cc banner exchange).
+payloads start with a kind byte: MSG (src|dst|seq|body[|ack]), ACK
+(cumulative seq), or SESSION (the reconnect watermark exchange).  The
+first frame on every outgoing connection is a banner naming the sender
+node, protocol version, and instance id (Pipe.cc banner exchange).
+
+Corked zero-copy send path (round 8, protocol v4; full protocol notes
+in docs/messenger.md): outgoing frames queue per peer and flush at
+end-of-tick (queue-drain, the ``osd/coalescer.py`` discipline) or past
+a byte threshold, as ONE ``writer.writelines`` scatter-gather burst --
+synchronously, straight into the transport buffer: no per-message task,
+no per-message ``drain()``.  ``drain()`` becomes what it actually is,
+flow control, awaited only once ``osd_msgr_cork_bytes`` have been
+written since the last drain.  Message payloads are part lists
+(``Encoder.parts``); large bodies are referenced, never joined, and
+each payload's crc32c is computed once and only EXTENDED over the
+per-transmission tail (piggyback ack + signature) on (re)transmit --
+crc32c chains, see ``encoding.crc32c_parts``.  Delivery acks piggyback
+as a trailing cumulative varint on outgoing MSG frames (v3 receivers
+ignore trailing bytes); with no reverse traffic a receiver writes one
+cumulative ACK frame per burst window instead of one frame + drain per
+message.  The receive side parses every frame already buffered in one
+wakeup (``_FrameReader``) instead of two ``readexactly`` awaits per
+frame.  A flush failure falls back to the lossless reconnect/replay
+machinery unchanged -- coalescing never weakens the delivery guarantee,
+it only changes the syscall shape.
 """
 
 from __future__ import annotations
@@ -43,13 +64,21 @@ import asyncio
 import os
 import struct
 from collections import deque
-from typing import Awaitable, Callable, Dict, Optional, Tuple
+from typing import Awaitable, Callable, Dict, Iterable, List, Optional, \
+    Tuple
 
-from ceph_tpu.msg.wire import decode_message, encode_message
-from ceph_tpu.osd.messenger import FaultInjector
-from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
+from ceph_tpu.msg.fault import FaultInjector
+from ceph_tpu.msg.wire import decode_message, message_encoder
+from ceph_tpu.native.gf_native import crc32c
+from ceph_tpu.utils.encoding import Decoder, Encoder, crc32c_parts, \
+    frame, frame_parts, unframe
 
-_PROTOCOL_VERSION = 3
+#: v4 adds the trailing piggyback-ack varint on MSG frames and corked
+#: multi-frame bursts; acceptors take any version in
+#: [_MIN_PROTOCOL_VERSION, _PROTOCOL_VERSION] (banner negotiation --
+#: v3 peers interop, see docs/messenger.md)
+_PROTOCOL_VERSION = 4
+_MIN_PROTOCOL_VERSION = 3
 _BANNER = "ceph-tpu-msgr"
 _SIG_LEN = 16
 
@@ -57,6 +86,47 @@ _SIG_LEN = 16
 _K_MSG = 0
 _K_ACK = 1
 _K_SESSION = 2
+
+#: seconds a receiver waits before writing a standalone cumulative ACK
+#: frame: long enough for same-op REPLY traffic to piggyback the
+#: watermark on its own data frames (acks gate nothing but unacked-queue
+#: pruning, so the latency is free), short enough to bound sender memory
+_ACK_DELAY = 0.025
+
+#: message payloads smaller than this are joined into one buffer at
+#: enqueue (a short memcpy beats per-part crc/digest bookkeeping);
+#: larger payloads stay scatter-gather so big blobs cross by reference
+_JOIN_BELOW = 4096
+
+
+def _varint_bytes(v: int) -> bytes:
+    """LEB128 unsigned varint as standalone bytes (the piggyback-ack
+    tail appended to queued MSG payloads at transmit time)."""
+    out = bytearray()
+    while True:
+        b = v & 0x7F
+        v >>= 7
+        if v:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+class _QueuedMsg:
+    """One unsealed MSG payload: a scatter-gather part list plus its
+    payload crc32c, computed once on first transmit and cached.  Signing
+    and the piggyback-ack tail are per-transmission (fresh session key
+    per connection), so frames seal at (re)transmit time by EXTENDING
+    the cached crc over the tail instead of re-digesting the payload."""
+
+    __slots__ = ("seq", "parts", "crc", "nbytes")
+
+    def __init__(self, seq: int, parts: List):
+        self.seq = seq
+        self.parts = parts
+        self.crc: Optional[int] = None
+        self.nbytes = sum(len(p) for p in parts)
 
 
 class _SendSession:
@@ -67,33 +137,113 @@ class _SendSession:
     def __init__(self):
         self.out_seq = 0
         self.acked = 0
-        #: unacked (seq, payload-bytes) oldest first; payloads are kept
-        #: UNSEALED -- signing is per-connection (fresh session key on
-        #: every reconnect), so frames seal at (re)transmit time
+        #: unacked _QueuedMsg oldest first; payloads are kept UNSEALED --
+        #: signing is per-connection (fresh session key on every
+        #: reconnect), so frames seal at (re)transmit time
         self.sent: deque = deque()
         self.sent_bytes = 0
         self.reconnecting = False
 
     def prune(self, acked_seq: int) -> None:
         self.acked = max(self.acked, acked_seq)
-        while self.sent and self.sent[0][0] <= self.acked:
-            _seq, payload = self.sent.popleft()
-            self.sent_bytes -= len(payload)
+        while self.sent and self.sent[0].seq <= self.acked:
+            entry = self.sent.popleft()
+            self.sent_bytes -= entry.nbytes
 
 
-async def _read_frame(reader: asyncio.StreamReader) -> Optional[bytes]:
-    """Read one framed record off the stream; None on EOF/corruption."""
-    try:
-        header = await reader.readexactly(12)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        return None
-    magic, length, crc = struct.unpack("<III", header)
-    try:
-        payload = await reader.readexactly(length)
-    except (asyncio.IncompleteReadError, ConnectionError):
-        return None
-    rec, pos = unframe(header + payload, 0)
-    return rec  # None if magic/crc check failed
+class _CorkQueue:
+    """Per-peer-node outgoing frame queue (cork/flush state)."""
+
+    __slots__ = ("entries", "nbytes", "flushing", "scheduled",
+                 "since_drain", "draining")
+
+    def __init__(self):
+        self.entries: List[_QueuedMsg] = []
+        self.nbytes = 0
+        self.flushing = False   # an async (slow-path) flusher owns the queue
+        self.scheduled = False  # an end-of-tick flush callback is pending
+        self.since_drain = 0    # bytes written since the last flow-control drain
+        self.draining = False
+
+
+class _AckBatch:
+    """Per-inbound-connection cumulative-ack batching state."""
+
+    __slots__ = ("flushed", "scheduled")
+
+    def __init__(self):
+        self.flushed = 0
+        self.scheduled = False
+
+
+class _FrameReader:
+    """Buffered frame parser: one ``read()`` wakeup drains every frame
+    already buffered on the socket (a corked burst arrives as one TCP
+    segment run), instead of two ``readexactly`` awaits per frame.
+
+    ``buffered=False`` reproduces the pre-round-8 receive shape (one
+    header ``readexactly`` + one payload ``readexactly`` per frame) --
+    the other half of the ``osd_msgr_cork`` baseline toggle, so the
+    cluster-path bench A/Bs the whole wire architecture, not just the
+    send side."""
+
+    __slots__ = ("_reader", "_buf", "_pos", "_buffered")
+
+    def __init__(self, reader: asyncio.StreamReader, buffered: bool = True):
+        self._reader = reader
+        self._buf = b""
+        self._pos = 0
+        self._buffered = buffered
+
+    async def _next_frame_per_message(self) -> Optional[bytes]:
+        try:
+            header = await self._reader.readexactly(12)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        _magic, length, _crc = struct.unpack("<III", header)
+        try:
+            payload = await self._reader.readexactly(length)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        rec, _pos = unframe(header + payload, 0)
+        return rec  # None if magic/crc check failed
+
+    async def next_frame(self) -> Optional[bytes]:
+        """The next framed record; None on EOF or a corrupt frame (the
+        caller drops the connection either way)."""
+        if not self._buffered:
+            return await self._next_frame_per_message()
+        while True:
+            buf, pos = self._buf, self._pos
+            if len(buf) - pos >= 12:
+                _magic, length, _crc = struct.unpack_from("<III", buf, pos)
+                if len(buf) - pos >= 12 + length:
+                    rec, _next = unframe(buf, pos)  # magic+crc validated
+                    if rec is None:
+                        return None  # corrupt/forged: drop the connection
+                    pos += 12 + length
+                    if pos >= len(buf):
+                        self._buf, self._pos = b"", 0
+                    else:
+                        self._pos = pos
+                    return rec
+            try:
+                chunk = await self._reader.read(1 << 16)
+            except (ConnectionError, OSError):
+                return None
+            if not chunk:
+                return None
+            self._buf = buf[pos:] + chunk if pos < len(buf) else chunk
+            self._pos = 0
+
+
+async def _read_frame(framer) -> Optional[bytes]:
+    """Read one framed record; None on EOF/corruption.  Accepts a
+    :class:`_FrameReader` (the messenger's connections) or a bare
+    StreamReader (compat for direct callers)."""
+    if isinstance(framer, asyncio.StreamReader):
+        framer = _FrameReader(framer)
+    return await framer.next_frame()
 
 
 class TCPMessenger:
@@ -106,6 +256,7 @@ class TCPMessenger:
         addr_map: Dict[str, Tuple[str, int]],
         fault: Optional[FaultInjector] = None,
         keyring=None,
+        cork: Optional[bool] = None,
     ):
         #: this process's node name; must appear in addr_map for serving
         self.node = node
@@ -119,7 +270,8 @@ class TCPMessenger:
         self._local_queues: Dict[str, asyncio.Queue] = {}
         self._dispatchers: Dict[str, Callable] = {}
         self._tasks: Dict[str, asyncio.Task] = {}
-        #: cached outgoing connections per peer node: (reader, writer, lock)
+        #: cached outgoing connections per peer node:
+        #: (framer, writer, lock, session_key)
         self._conns: Dict[str, Tuple] = {}
         self._server: Optional[asyncio.AbstractServer] = None
         #: administratively dead entities (mark_down -- the thrasher hook)
@@ -144,11 +296,35 @@ class TCPMessenger:
         from ceph_tpu.utils.config import get_config
         from ceph_tpu.utils.throttle import Throttle
 
+        cfg = get_config()
         try:
-            cap = int(get_config().get_val("osd_client_message_size_cap"))
+            cap = int(cfg.get_val("osd_client_message_size_cap"))
         except (KeyError, ValueError, TypeError):
             cap = 500 * 1024 * 1024
         self.dispatch_throttle = Throttle(f"{node}.msgr-dispatch", cap)
+        #: corked send path (osd_msgr_cork): queue outgoing frames per
+        #: connection, flush as one writelines burst; off = one
+        #: write/drain per message (the per-message baseline)
+        self.cork = bool(cfg.get_val("osd_msgr_cork")) if cork is None \
+            else bool(cork)
+        self.cork_bytes = int(cfg.get_val("osd_msgr_cork_bytes"))
+        self._cork_queues: Dict[str, _CorkQueue] = {}
+        self._cork_seq = 0
+        #: (src entity, dst entity) -> encoded kind|src|dst MSG head
+        self._head_cache: Dict[tuple, bytes] = {}
+        #: highest reverse-stream watermark piggybacked to each peer node
+        #: on our own data frames (lets the inbound-side ack batcher skip
+        #: standalone ACK frames the peer has already seen)
+        self._piggy_acked: Dict[str, int] = {}
+        #: wire-shape counters (the cluster-path bench trend metrics):
+        #: frames per burst = frames_sent/bursts, bytes per drain =
+        #: bytes_sent/max(drains,1), piggyback ratio =
+        #: piggybacked/(piggybacked+standalone)
+        self.counters: Dict[str, int] = {
+            "msgs_sent": 0, "frames_sent": 0, "bursts": 0, "drains": 0,
+            "bytes_sent": 0, "acks_piggybacked": 0, "acks_standalone": 0,
+            "acks_elided": 0, "acks_piggybacked_recv": 0,
+        }
         #: per-process instance id (the Pipe connect nonce): receive
         #: state is keyed by it, so a restarted peer's fresh stream
         #: never collides with its predecessor's sequence watermark
@@ -242,8 +418,22 @@ class TCPMessenger:
         queue = self._local_queues[name]
         while True:
             item = await queue.get()
-            src, msg = item[0], item[1]
-            cost = item[2] if len(item) > 2 else 0
+            more = True
+            while more:
+                await self._dispatch_one(name, item)
+                # drain everything already buffered without paying an
+                # await round per item (a corked burst delivers as one)
+                if queue.empty():
+                    more = False
+                else:
+                    item = queue.get_nowait()
+
+    async def _dispatch_one(self, name: str, item) -> None:
+        src, msg = item[0], item[1]
+        cost = item[2] if len(item) > 2 else 0
+        release = None
+        claimed = [False]
+        if cost:
             released = [False]
 
             def release(released=released, cost=cost):
@@ -251,8 +441,7 @@ class TCPMessenger:
                     released[0] = True
                     self.dispatch_throttle.put(cost)
 
-            claimed = [False]
-            if cost and isinstance(msg, dict) and "op" in msg:
+            if isinstance(msg, dict) and "op" in msg:
                 # budget hand-off: a dispatcher that only ENQUEUES the
                 # op (OSDShard's QoS queue) may claim the budget and
                 # release it when the op actually executes -- that is
@@ -263,9 +452,8 @@ class TCPMessenger:
                 msg["_budget_release"] = release
                 msg["_budget_claim"] = (
                     lambda claimed=claimed: claimed.__setitem__(0, True))
-            try:
-                if name in self._marked_down:
-                    continue
+        try:
+            if name not in self._marked_down:
                 try:
                     await self._dispatchers[name](src, msg)
                 except asyncio.CancelledError:
@@ -276,13 +464,13 @@ class TCPMessenger:
                     import traceback
 
                     traceback.print_exc(file=sys.stderr)
-            finally:
+        finally:
+            if isinstance(msg, dict):
+                msg.pop("_budget_claim", None)
+            if cost and not claimed[0]:
                 if isinstance(msg, dict):
-                    msg.pop("_budget_claim", None)
-                if cost and not claimed[0]:
-                    if isinstance(msg, dict):
-                        msg.pop("_budget_release", None)
-                    release()
+                    msg.pop("_budget_release", None)
+                release()
 
     # -- server side -------------------------------------------------------
 
@@ -293,6 +481,8 @@ class TCPMessenger:
         self._serve_tasks.add(task)
         try:
             await self._serve_connection_inner(reader, writer)
+        except (ConnectionError, OSError):
+            pass  # peer vanished mid-serve (restart/teardown): normal
         finally:
             self._serve_tasks.discard(task)
             writer.close()
@@ -300,13 +490,22 @@ class TCPMessenger:
     async def _serve_connection_inner(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        banner = await _read_frame(reader)
+        framer = _FrameReader(reader, buffered=self.cork)
+        banner = await framer.next_frame()
         if banner is None:
             writer.close()
             return
         dec = Decoder(banner)
-        if dec.string() != _BANNER or dec.varint() != _PROTOCOL_VERSION:
-            writer.close()  # protocol mismatch: refuse (reference -EXDEV)
+        if dec.string() != _BANNER:
+            writer.close()
+            return
+        # banner negotiation: accept any peer whose dialect we can parse
+        # (>= _MIN).  Old kinds keep their layout; the v4 additions are
+        # a TRAILING field old receivers never read and a cumulative ACK
+        # frame old senders already handle (prune() is cumulative), so a
+        # v3 peer interops without a feature exchange.
+        if not (_MIN_PROTOCOL_VERSION <= dec.varint() <= _PROTOCOL_VERSION):
+            writer.close()  # unparseable dialect: refuse (reference -EXDEV)
             return
         peer_node = dec.string()
         client_nonce = dec.blob()
@@ -314,7 +513,7 @@ class TCPMessenger:
         session_key = None
         if self.keyring is not None:
             session_key = await self._auth_accept(
-                reader, writer, peer_node, client_nonce
+                framer, writer, peer_node, client_nonce
             )
             if session_key is None:
                 writer.close()  # failed handshake: refuse (-EACCES)
@@ -343,31 +542,50 @@ class TCPMessenger:
                         if k[0] == peer_node and k[1] != peer_instance]:
                 del self._in_seqs[key]
         in_key = (peer_node, peer_instance)
+        acks = _AckBatch()
+        #: known kind|src|dst frame heads on this connection: the prefix
+        #: is byte-identical for every message of one (src, dst) stream,
+        #: so after the first frame the envelope parse is one startswith
+        heads: List[tuple] = []
         while True:
-            rec = await _read_frame(reader)
+            rec = await framer.next_frame()
             if rec is None:
                 break
-            try:
-                rec = self._unseal(rec, session_key)
-            except OSError:
-                break  # short/forged/tampered frame: drop the connection
-            dec = Decoder(rec)
-            kind = dec.u8()
+            if session_key is not None:
+                try:
+                    rec = self._unseal(rec, session_key)
+                except OSError:
+                    break  # short/forged/tampered frame: drop the conn
+            if not rec:
+                break
+            kind = rec[0]
             if kind == _K_SESSION:
-                # reconnect watermark exchange (Pipe.cc connect reply):
-                # tell the peer what we have DELIVERED from this
-                # instance, so it replays everything after
-                reply = Encoder().u8(_K_SESSION).varint(
-                    self._in_seqs.get(in_key, 0)).bytes()
-                writer.write(frame(self._seal(reply, session_key)))
-                await writer.drain()
+                await self._reply_session(writer, session_key, in_key)
                 continue
             if kind != _K_MSG:
                 continue  # ACK frames never arrive on an inbound socket
-            src = dec.string()
-            dst = dec.string()
+            for head, hsrc, hdst in heads:
+                if rec.startswith(head):
+                    src, dst = hsrc, hdst
+                    dec = Decoder(rec, len(head))
+                    break
+            else:
+                dec = Decoder(rec, 1)
+                src = dec.string()
+                dst = dec.string()
+                heads.append((rec[:dec._pos], src, dst))
             seq = dec.varint()
             body = dec.blob()
+            # v4 piggyback: a trailing cumulative ack for OUR reverse
+            # stream to this peer rides the data frame (v3 senders never
+            # append it; v3 receivers never read this far)
+            if dec.remaining():
+                back_ack = dec.varint()
+                if back_ack:
+                    sess = self._sessions.get(peer_node)
+                    if sess is not None:
+                        sess.prune(back_ack)
+                    self.counters["acks_piggybacked_recv"] += 1
             if seq:
                 # lossless stream (in order per TCP connection).  A dst
                 # we do not host YET (the boot window between
@@ -378,11 +596,23 @@ class TCPMessenger:
                 if dst not in self._local_queues and \
                         dst not in self._marked_down:
                     break
-                ack = Encoder().u8(_K_ACK).varint(seq).bytes()
-                writer.write(frame(self._seal(ack, session_key)))
-                await writer.drain()
+                if self.cork:
+                    # batched cumulative ack: at most one ACK frame per
+                    # _ACK_DELAY window, elided entirely when our own
+                    # outgoing data frames piggyback the watermark first
+                    if not acks.scheduled:
+                        acks.scheduled = True
+                        asyncio.get_event_loop().call_later(
+                            _ACK_DELAY, self._ack_tick, acks, writer,
+                            session_key, peer_node, in_key)
+                else:
+                    await self._ack_now(writer, session_key, seq)
                 if seq <= self._in_seqs.get(in_key, 0):
                     continue  # duplicate from a replay: already delivered
+                # the watermark advances only AFTER every await that can
+                # tear this connection down (the per-message ack drain
+                # above): a watermark past an undelivered message would
+                # make the reconnect replay skip it -- silent loss
                 self._in_seqs[in_key] = seq
             msg = decode_message(body)
             queue = self._local_queues.get(dst)
@@ -396,12 +626,55 @@ class TCPMessenger:
                     # distributed deadlock
                     cost = len(rec)
                     await self.dispatch_throttle.get(cost)
-                    await queue.put((src, msg, cost))
+                    queue.put_nowait((src, msg, cost))
                 else:
-                    await queue.put((src, msg))
+                    # unbounded queue: put() never blocks, put_nowait
+                    # skips one coroutine round per delivered message
+                    queue.put_nowait((src, msg))
         writer.close()
 
-    async def _auth_accept(self, reader, writer, peer_node: str,
+    async def _reply_session(self, writer, session_key, in_key) -> None:
+        """Answer a reconnect watermark exchange (Pipe.cc connect reply):
+        tell the peer what we have DELIVERED from this instance, so it
+        replays everything after.  Once per (re)connect, never per
+        message -- hence its own drain."""
+        reply = Encoder().u8(_K_SESSION).varint(
+            self._in_seqs.get(in_key, 0)).bytes()
+        writer.write(frame(self._seal(reply, session_key)))
+        await writer.drain()
+
+    async def _ack_now(self, writer, session_key, seq: int) -> None:
+        """Per-message ack write+drain (the uncorked / pre-v4 shape)."""
+        ack = Encoder().u8(_K_ACK).varint(seq).bytes()
+        writer.write(frame(self._seal(ack, session_key)))
+        await writer.drain()
+        self.counters["acks_standalone"] += 1
+
+    def _ack_tick(self, acks: _AckBatch, writer, session_key,
+                  peer_node: str, in_key: tuple) -> None:
+        """Deferred cumulative ack (sync timer callback): skipped when a
+        piggybacked watermark on our own data frames already covered it;
+        otherwise one small ACK frame, written without a drain (acks
+        gate nothing but sender-side queue pruning)."""
+        acks.scheduled = False
+        seq = self._in_seqs.get(in_key, 0)
+        if seq <= acks.flushed:
+            return
+        if self._piggy_acked.get(peer_node, 0) >= seq:
+            acks.flushed = seq  # rode one of our outgoing data frames
+            self.counters["acks_elided"] += 1
+            return
+        acks.flushed = seq
+        if self._closing or writer.is_closing():
+            return  # sender reconnects and re-handshakes
+        ack = Encoder().u8(_K_ACK).varint(seq).bytes()
+        try:
+            writer.write(frame(self._seal(ack, session_key)))
+        except (ConnectionError, OSError, RuntimeError):
+            return
+        self.counters["acks_standalone"] += 1
+
+    async def _auth_accept(self, framer, writer, peer_node: str,
                            client_nonce: bytes):
         """Acceptor half of the cephx-style handshake; returns the
         session key, or None to refuse."""
@@ -415,7 +688,7 @@ class TCPMessenger:
             Encoder().blob(hs.server_nonce).blob(hs.server_proof()).bytes()
         ))
         await writer.drain()
-        reply = await _read_frame(reader)
+        reply = await framer.next_frame()
         if reply is None:
             return None
         if not hs.verify_client(Decoder(reply).blob()):
@@ -434,6 +707,7 @@ class TCPMessenger:
 
         host, port = self.addr_map[node]
         reader, writer = await asyncio.open_connection(host, port)
+        framer = _FrameReader(reader, buffered=self.cork)
         nonce = AuthHandshake.new_nonce() if self.keyring is not None else b""
         banner = (
             Encoder().string(_BANNER).varint(_PROTOCOL_VERSION)
@@ -450,7 +724,7 @@ class TCPMessenger:
             try:
                 # a no-auth peer never answers the handshake: time out
                 # with a clear error instead of hanging every send
-                reply = await asyncio.wait_for(_read_frame(reader), 3.0)
+                reply = await asyncio.wait_for(framer.next_frame(), 3.0)
             except asyncio.TimeoutError:
                 writer.close()
                 raise OSError(
@@ -469,7 +743,7 @@ class TCPMessenger:
             writer.write(frame(Encoder().blob(hs.client_proof()).bytes()))
             await writer.drain()
             session_key = hs.session_key()
-        return reader, writer, asyncio.Lock(), session_key
+        return framer, writer, asyncio.Lock(), session_key
 
     def _drop_conn(self, node: str) -> None:
         """Pop + close the cached conn to ``node``; if unacked lossless
@@ -479,6 +753,11 @@ class TCPMessenger:
         conn = self._conns.pop(node, None)
         if conn is not None:
             conn[1].close()
+        # piggybacked acks recorded against the dead conn may never have
+        # arrived: forget them so the ack batcher sends a standalone
+        # cumulative ack on the next inbound traffic instead of assuming
+        # coverage (the peer's unacked queue must not pin entries)
+        self._piggy_acked.pop(node, None)
         sess = self._sessions.get(node)
         if sess is not None and sess.sent and not self._closing \
                 and node not in self._marked_down:
@@ -525,13 +804,15 @@ class TCPMessenger:
 
     async def _session_handshake(self, node: str, conn) -> None:
         """Exchange delivered-watermarks with the peer and retransmit
-        everything it has not delivered (Pipe.cc connect/replay)."""
-        reader, writer, lock, skey = conn
+        everything it has not delivered (Pipe.cc connect/replay).  The
+        whole replay burst goes out as one scatter-gather writelines
+        with a single drain per snapshot round."""
+        framer, writer, lock, skey = conn
         sess = self._sessions.setdefault(node, _SendSession())
         writer.write(frame(self._seal(
             Encoder().u8(_K_SESSION).bytes(), skey)))
         await writer.drain()
-        rec = await asyncio.wait_for(_read_frame(reader), 3.0)
+        rec = await asyncio.wait_for(framer.next_frame(), 3.0)
         if rec is None:
             raise OSError(f"{node}: session handshake EOF")
         dec = Decoder(self._unseal(rec, skey))
@@ -544,12 +825,14 @@ class TCPMessenger:
             # caught by the next iteration (review r5 finding)
             sent_upto = 0
             while True:
-                pending = [(s, p) for s, p in sess.sent if s > sent_upto]
+                pending = [e for e in sess.sent if e.seq > sent_upto]
                 if not pending:
                     break
-                for s, payload in pending:
-                    writer.write(frame(self._seal(payload, skey)))
-                    sent_upto = s
+                bufs: List = []
+                for entry in pending:
+                    bufs.extend(self._entry_frames(entry, skey, 0))
+                    sent_upto = entry.seq
+                writer.writelines(bufs)
                 await writer.drain()
 
     def _spawn_ack_reader(self, node: str, conn) -> None:
@@ -558,9 +841,9 @@ class TCPMessenger:
         traffic is pending, start the reconnect loop."""
 
         async def ack_loop():
-            reader, skey = conn[0], conn[3]
+            framer, skey = conn[0], conn[3]
             while True:
-                rec = await _read_frame(reader)
+                rec = await framer.next_frame()
                 if rec is None:
                     break
                 try:
@@ -611,6 +894,259 @@ class TCPMessenger:
             asyncio.get_event_loop().create_task(reconnect_loop()),
         )
 
+    # -- frame assembly (zero-copy seal/frame at transmit time) ------------
+
+    def _msg_entry(self, src: str, dst: str, seq: int, msg: object
+                   ) -> _QueuedMsg:
+        """Encode one MSG payload as a part list: the wire body's parts
+        nest into the transport envelope by reference (a large blob --
+        EC shard bytes -- is never joined or copied; sub-4 KiB payloads
+        collapse into one buffer, where a short memcpy beats per-part
+        bookkeeping)."""
+        # the kind|src|dst head is byte-identical for every message on
+        # one (src, dst) stream: encode it once and reuse (entity names
+        # are a small fixed set per daemon)
+        head = self._head_cache.get((src, dst))
+        if head is None:
+            head = self._head_cache[(src, dst)] = (
+                Encoder().u8(_K_MSG).string(src).string(dst).bytes())
+        body_parts = message_encoder(msg)._parts
+        body_len = sum(map(len, body_parts))
+        pre = head + _varint_bytes(seq) + _varint_bytes(body_len)
+        if len(pre) + body_len <= _JOIN_BELOW:
+            return _QueuedMsg(seq, [b"".join([pre, *body_parts])])
+        enc = Encoder()
+        enc._parts = [pre] + body_parts
+        return _QueuedMsg(seq, enc.parts(_JOIN_BELOW))
+
+    def _entry_frames(self, entry: _QueuedMsg, session_key,
+                      ack: int) -> List:
+        """On-wire buffer list for one queued message: cached payload
+        parts + per-transmission tail (piggyback ack, signature), with
+        the frame crc EXTENDED over the tail instead of recomputed over
+        the payload (the double-crc audit: each digest runs once per
+        burst element, retransmits included)."""
+        crc = entry.crc
+        if crc is None:
+            crc = entry.crc = crc32c_parts(entry.parts)
+        parts = entry.parts
+        if ack:
+            tail = _varint_bytes(ack)
+            parts = parts + [tail]
+            crc = crc32c(tail, crc)
+        if session_key is not None:
+            from ceph_tpu.auth.cephx import sign_parts
+
+            sig = sign_parts(session_key, parts)
+            parts = parts + [sig]
+            crc = crc32c(sig, crc)
+        return frame_parts(parts, crc)
+
+    def _piggy_ack_value(self, node: str) -> int:
+        """Cumulative delivered watermark of the reverse stream from
+        ``node`` (what a data frame to it may piggyback)."""
+        inst = self._peer_instances.get(node)
+        if inst is None:
+            return 0
+        return self._in_seqs.get((node, inst), 0)
+
+    # -- corked send queue (cork/flush; the wire-level coalescer) ----------
+
+    def _enqueue_cork(self, node: str, entry: _QueuedMsg) -> None:
+        """Queue one frame for ``node``; flush fires at end-of-tick
+        (queue-drain: every already-runnable sender joins the burst) or
+        immediately past the byte threshold -- the osd/coalescer.py
+        flush discipline applied to the wire.  Deadlock-free for the
+        same reason: a flush depends only on the event loop running,
+        never on another message's completion."""
+        q = self._cork_queues.get(node)
+        if q is None:
+            q = self._cork_queues[node] = _CorkQueue()
+        q.entries.append(entry)
+        q.nbytes += entry.nbytes
+        self.counters["msgs_sent"] += 1
+        if q.flushing:
+            return  # the slow-path flusher re-checks after its drain
+        if q.nbytes >= self.cork_bytes:
+            self._flush_now(node, q)
+        elif not q.scheduled:
+            q.scheduled = True
+            asyncio.get_event_loop().call_soon(self._cork_tick, node)
+
+    def _cork_tick(self, node: str) -> None:
+        q = self._cork_queues.get(node)
+        if q is None:
+            return
+        q.scheduled = False
+        if q.entries and not q.flushing:
+            self._flush_now(node, q)
+
+    def _flush_now(self, node: str, q: _CorkQueue) -> None:
+        """Synchronous fast path: seal + ``writelines`` the whole queue
+        straight into the transport buffer -- no task, no lock, no
+        drain.  ``drain()`` is flow control and runs (as a task) only
+        once ``cork_bytes`` have been written since the last one.  Falls
+        back to the async flusher when the connection is missing, mid-
+        handshake (lock held: a replay is writing -- interleaving fresh
+        seqs into a replay would break the receiver's dedup watermark),
+        or already closing."""
+        if self._closing:
+            q.entries.clear()
+            q.nbytes = 0
+            return
+        conn = self._conns.get(node)
+        if conn is None or self._conn_lock(node).locked() or \
+                conn[2].locked() or conn[1].is_closing():
+            self._spawn_cork_flush(node)
+            return
+        batch, q.entries = q.entries, []
+        q.nbytes = 0
+        _framer, writer, _lock, skey = conn
+        lossless = self._lossless(node)
+        ack = self._piggy_ack_value(node) if lossless else 0
+        last = len(batch) - 1
+        bufs: List = []
+        split = self.fault.conn_kill_split(len(batch))
+        if split >= 0:
+            # injected mid-burst kill: a prefix of the burst reaches the
+            # wire, then the transport dies under the sender
+            for entry in batch[:split]:
+                bufs.extend(self._entry_frames(entry, skey, 0))
+            if bufs:
+                writer.writelines(bufs)
+            writer.transport.abort()
+            self._conn_failed(node, writer, lossless)
+            return
+        for i, entry in enumerate(batch):
+            # the cumulative piggyback rides the LAST frame of the
+            # burst; the receiver processes in order, one watermark
+            # covers every earlier frame too
+            bufs.extend(self._entry_frames(
+                entry, skey, ack if i == last else 0))
+        try:
+            writer.writelines(bufs)
+        except (ConnectionError, OSError, RuntimeError):
+            self._conn_failed(node, writer, lossless)
+            return
+        nbytes = sum(len(b) for b in bufs)
+        self.counters["bursts"] += 1
+        self.counters["frames_sent"] += len(batch)
+        self.counters["bytes_sent"] += nbytes
+        if ack:
+            self._piggy_acked[node] = max(
+                self._piggy_acked.get(node, 0), ack)
+            self.counters["acks_piggybacked"] += 1
+        q.since_drain += nbytes
+        if q.since_drain >= self.cork_bytes and not q.draining:
+            q.draining = True
+            self._cork_seq += 1
+            task = asyncio.get_event_loop().create_task(
+                self._drain_conn(node, q, conn))
+            self.adopt_task(f"drain.{node}.{self._cork_seq}", task)
+
+    def _conn_failed(self, node: str, writer, lossless: bool) -> None:
+        """Shared dead-connection handling for the sync send path."""
+        self._conns.pop(node, None)
+        writer.close()
+        self._piggy_acked.pop(node, None)
+        self._unreachable[node] = asyncio.get_event_loop().time()
+        if lossless:
+            # unacked entries live on sess.sent: replay redelivers
+            self._spawn_reconnect(node)
+
+    async def _drain_conn(self, node: str, q: _CorkQueue, conn) -> None:
+        """Flow-control drain: awaited once per ``cork_bytes`` written,
+        not once per message."""
+        try:
+            await conn[1].drain()
+            self.counters["drains"] += 1
+            q.since_drain = 0
+        except (ConnectionError, OSError):
+            if self._conns.get(node) is conn:
+                self._conn_failed(node, conn[1], self._lossless(node))
+        finally:
+            q.draining = False
+
+    def _spawn_cork_flush(self, node: str) -> None:
+        self._cork_seq += 1
+        task = asyncio.get_event_loop().create_task(self._cork_flush(node))
+        self.adopt_task(f"cork.{node}.{self._cork_seq}", task)
+
+    async def _cork_flush(self, node: str) -> None:
+        """Slow-path flusher (first contact, contended lock): drains the
+        cork queue under the connection lock with a drain per pass;
+        messages enqueued while a pass awaits are picked up by the next
+        pass."""
+        q = self._cork_queues.get(node)
+        if q is None or q.flushing:
+            return
+        q.flushing = True
+        lossless = self._lossless(node)
+        attempts = 0
+        try:
+            while q.entries and not self._closing:
+                conn = self._conns.get(node)
+                if conn is None:
+                    conn = await self._try_establish(node)
+                if conn is None:
+                    # peer down: lossy frames drop (lossy policy);
+                    # lossless ones already sit on sess.sent -- the
+                    # reconnect loop replays them
+                    q.entries.clear()
+                    q.nbytes = 0
+                    if lossless:
+                        self._spawn_reconnect(node)
+                    return
+                batch, q.entries = q.entries, []
+                q.nbytes = 0
+                _framer, writer, lock, skey = conn
+                ack = self._piggy_ack_value(node) if lossless else 0
+                last = len(batch) - 1
+                try:
+                    async with lock:
+                        split = self.fault.conn_kill_split(len(batch))
+                        if split >= 0:
+                            prefix: List = []
+                            for entry in batch[:split]:
+                                prefix.extend(
+                                    self._entry_frames(entry, skey, 0))
+                            if prefix:
+                                writer.writelines(prefix)
+                            writer.transport.abort()
+                            raise ConnectionResetError(
+                                "injected mid-burst connection kill")
+                        bufs: List = []
+                        for i, entry in enumerate(batch):
+                            bufs.extend(self._entry_frames(
+                                entry, skey, ack if i == last else 0))
+                        writer.writelines(bufs)
+                        await writer.drain()
+                except (ConnectionError, OSError, RuntimeError):
+                    self._conn_failed(node, writer, lossless)
+                    if lossless:
+                        q.entries.clear()
+                        q.nbytes = 0
+                        return  # replay machinery owns redelivery
+                    attempts += 1
+                    if attempts > 1:
+                        return  # lossy: one reconnect retry, then drop
+                    q.entries = batch + q.entries
+                    q.nbytes = sum(e.nbytes for e in q.entries)
+                    continue
+                self._unreachable.pop(node, None)
+                self.counters["bursts"] += 1
+                self.counters["drains"] += 1
+                self.counters["frames_sent"] += len(batch)
+                self.counters["bytes_sent"] += sum(len(b) for b in bufs)
+                if ack:
+                    self._piggy_acked[node] = max(
+                        self._piggy_acked.get(node, 0), ack)
+                    self.counters["acks_piggybacked"] += 1
+        finally:
+            q.flushing = False
+
+    # -- send surface ------------------------------------------------------
+
     async def send_message(self, src: str, dst: str, msg: object) -> None:
         if src in self._marked_down or dst in self._marked_down:
             return
@@ -619,24 +1155,66 @@ class TCPMessenger:
         if queue is not None:
             if self.fault.maybe_drop():
                 return
-            await self.fault.maybe_delay()
-            await queue.put((src, msg))
+            if self.fault.delay_probability:
+                await self.fault.maybe_delay()
+            queue.put_nowait((src, msg))
             return
         node = self._node_of(dst)
         if node is None:
             return  # unknown peer: lossy
         if self.fault.maybe_drop():
             return
-        await self.fault.maybe_delay()
-        body = encode_message(msg)
-        if self._lossless(node):
-            await self._send_lossless(src, dst, node, body)
+        if self.fault.delay_probability:
+            await self.fault.maybe_delay()
+        lossless = self._lossless(node)
+        if not self.cork:
+            # per-message baseline: join, seal, frame, write, drain --
+            # one write + one drain per message (the pre-v4 shape)
+            if lossless:
+                await self._send_lossless(src, dst, node, msg)
+            else:
+                entry = self._msg_entry(src, dst, 0, msg)
+                await self._send_lossy(node, self._join_entry(entry))
             return
-        payload = (
-            Encoder().u8(_K_MSG).string(src).string(dst).varint(0)
-            .blob(body).bytes()
-        )
-        await self._send_lossy(node, payload)
+        if lossless:
+            sess = self._sessions.setdefault(node, _SendSession())
+            if sess.sent_bytes >= self.lossless_max_backlog:
+                return  # honest bound: beyond the backlog, drop
+            sess.out_seq += 1
+            entry = self._msg_entry(src, dst, sess.out_seq, msg)
+            sess.sent.append(entry)
+            sess.sent_bytes += entry.nbytes
+        else:
+            entry = self._msg_entry(src, dst, 0, msg)
+        if self._conns.get(node) is None:
+            # first contact (or a dropped conn): establish NOW so a down
+            # peer is discovered -- and marked unreachable -- by the
+            # send that hit it, exactly like the per-message path
+            if await self._try_establish(node) is None:
+                if lossless:
+                    self._spawn_reconnect(node)  # queued; keep dialing
+                return
+            # the establishing handshake may already have replayed a
+            # lossless entry (it was queued first); the receiver's
+            # watermark swallows the duplicate -- double-send is safe,
+            # silent loss is not
+        self._enqueue_cork(node, entry)
+
+    async def send_messages(
+        self, src: str, pairs: Iterable[Tuple[str, object]]
+    ) -> None:
+        """Multi-destination submit: publish a whole fan-out (every EC
+        sub-op of one client write) in one call.  Sequential enqueues
+        stay within one event-loop tick once connections exist, so each
+        peer's cork queue gathers its share of the fan-out into a single
+        burst."""
+        for dst, msg in pairs:
+            await self.send_message(src, dst, msg)
+
+    @staticmethod
+    def _join_entry(entry: _QueuedMsg) -> bytes:
+        return b"".join(
+            p if type(p) is bytes else bytes(p) for p in entry.parts)
 
     async def _send_lossy(self, node: str, payload: bytes) -> None:
         conn = self._conns.get(node)
@@ -650,6 +1228,7 @@ class TCPMessenger:
             try:
                 writer.write(rec)
                 await writer.drain()
+                self._count_single(len(rec))
                 self._unreachable.pop(node, None)
             except (ConnectionError, OSError):
                 self._conns.pop(node, None)
@@ -662,6 +1241,7 @@ class TCPMessenger:
                     rec = frame(self._seal(payload, conn[3]))
                     conn[1].write(rec)
                     await conn[1].drain()
+                    self._count_single(len(rec))
                 except (ConnectionError, OSError):
                     self._conns.pop(node, None)
                     conn[1].close()
@@ -669,18 +1249,17 @@ class TCPMessenger:
                         asyncio.get_event_loop().time()
 
     async def _send_lossless(self, src: str, dst: str, node: str,
-                             body: bytes) -> None:
-        """Queue-then-send with replay-on-reconnect (lossless peer)."""
+                             msg: object) -> None:
+        """Queue-then-send with replay-on-reconnect (lossless peer);
+        per-message write+drain -- the uncorked baseline path."""
         sess = self._sessions.setdefault(node, _SendSession())
         if sess.sent_bytes >= self.lossless_max_backlog:
             return  # honest bound: beyond the backlog, drop like lossy
         sess.out_seq += 1
-        payload = (
-            Encoder().u8(_K_MSG).string(src).string(dst)
-            .varint(sess.out_seq).blob(body).bytes()
-        )
-        sess.sent.append((sess.out_seq, payload))
-        sess.sent_bytes += len(payload)
+        entry = self._msg_entry(src, dst, sess.out_seq, msg)
+        sess.sent.append(entry)
+        sess.sent_bytes += entry.nbytes
+        payload = self._join_entry(entry)
         conn = self._conns.get(node)
         if conn is None:
             conn = await self._try_establish(node)
@@ -695,14 +1274,27 @@ class TCPMessenger:
         _, writer, lock, skey = conn
         async with lock:
             try:
-                writer.write(frame(self._seal(payload, skey)))
+                if self.fault.conn_kill_split(1) == 0:
+                    writer.transport.abort()
+                    raise ConnectionResetError("injected connection kill")
+                rec = frame(self._seal(payload, skey))
+                writer.write(rec)
                 await writer.drain()
+                self._count_single(len(rec))
                 self._unreachable.pop(node, None)
             except (ConnectionError, OSError):
                 self._conns.pop(node, None)
                 writer.close()
                 self._unreachable[node] = asyncio.get_event_loop().time()
                 self._spawn_reconnect(node)
+
+    def _count_single(self, nbytes: int) -> None:
+        """Counter update for a one-frame write+drain (baseline path)."""
+        self.counters["msgs_sent"] += 1
+        self.counters["frames_sent"] += 1
+        self.counters["bursts"] += 1
+        self.counters["drains"] += 1
+        self.counters["bytes_sent"] += nbytes
 
     @staticmethod
     def _seal(payload: bytes, session_key) -> bytes:

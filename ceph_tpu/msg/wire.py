@@ -67,7 +67,12 @@ def _decode_log_entry(dec: Decoder) -> LogEntry:
     )
 
 
-def encode_message(msg: object) -> bytes:
+def message_encoder(msg: object) -> Encoder:
+    """Encode ``msg`` into an :class:`Encoder` WITHOUT joining it: the
+    transport nests ``enc.parts()`` straight into its frame part list
+    (``Encoder.blob_parts``), so large payload blobs -- EC shard bytes
+    inside a sub-write transaction -- cross the messenger by reference
+    instead of being copied at every layer."""
     enc = Encoder()
     if isinstance(msg, ECSubWrite):
         enc.u8(_MSG_EC_SUB_WRITE)
@@ -107,7 +112,11 @@ def encode_message(msg: object) -> bytes:
     else:
         enc.u8(_MSG_VALUE)
         enc.value(msg)
-    return enc.bytes()
+    return enc
+
+
+def encode_message(msg: object) -> bytes:
+    return message_encoder(msg).bytes()
 
 
 def decode_message(data: bytes) -> object:

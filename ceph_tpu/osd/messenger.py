@@ -15,74 +15,11 @@ numbers preserved per connection (lossless-peer ordering).
 from __future__ import annotations
 
 import asyncio
-import random
-from typing import Awaitable, Callable, Dict, Optional
+from typing import Awaitable, Callable, Dict, Iterable, Optional, Tuple
 
-
-class FaultInjector:
-    """ms_inject_* analogue; probabilities in [0, 1]."""
-
-    def __init__(self, drop_probability: float = 0.0, delay_probability: float = 0.0,
-                 max_delay: float = 0.0, seed: int = 0):
-        self.drop_probability = drop_probability
-        self.delay_probability = delay_probability
-        self.max_delay = max_delay
-        self._rng = random.Random(seed)
-        self.dropped = 0
-
-    @classmethod
-    def from_config(cls) -> "FaultInjector":
-        """Build from the ms_inject_* options AND track runtime changes
-        through a config observer (reference: the injection knobs in
-        src/common/options.cc drive the messenger directly and respond
-        to injectargs; qa suites just set the config, before OR after
-        the daemons boot)."""
-        import weakref
-
-        from ceph_tpu.utils.config import get_config
-
-        cfg = get_config()
-        inj = cls()
-
-        def _sync(target):
-            n = int(cfg.get_val("ms_inject_socket_failures") or 0)
-            delay_p = float(cfg.get_val("ms_inject_internal_delays")
-                            or 0.0)
-            target.drop_probability = (1.0 / n) if n > 0 else 0.0
-            target.delay_probability = delay_p
-            target.max_delay = 0.05 if delay_p else 0.0
-
-        _sync(inj)
-        # the observer must not keep the injector (and its messenger)
-        # alive forever: hold it weakly and self-remove once the owner
-        # is gone, or a harness that churns clusters would grow the
-        # global observer list without bound
-        ref = weakref.ref(inj)
-
-        def _obs(changed):
-            target = ref()
-            if target is None:
-                try:
-                    cfg._observers.remove(_obs)
-                except ValueError:
-                    pass
-                return
-            if changed & {"ms_inject_socket_failures",
-                          "ms_inject_internal_delays"}:
-                _sync(target)
-
-        cfg.add_observer(_obs)
-        return inj
-
-    def maybe_drop(self) -> bool:
-        if self.drop_probability and self._rng.random() < self.drop_probability:
-            self.dropped += 1
-            return True
-        return False
-
-    async def maybe_delay(self) -> None:
-        if self.delay_probability and self._rng.random() < self.delay_probability:
-            await asyncio.sleep(self._rng.random() * self.max_delay)
+# FaultInjector moved to the transport layer in round 8 (the msg -> osd
+# layering inversion fix); re-exported here for compatibility.
+from ceph_tpu.msg.fault import FaultInjector  # noqa: F401
 
 
 class Messenger:
@@ -132,6 +69,17 @@ class Messenger:
         await self.fault.maybe_delay()
         self._seq += 1
         await self._queues[dst].put((src, msg))
+
+    async def send_messages(
+        self, src: str, pairs: Iterable[Tuple[str, object]]
+    ) -> None:
+        """Multi-destination submit: publish a whole fan-out (e.g. every
+        EC sub-op of one client write) in one call.  On the in-process
+        bus this is a plain loop; the TCP messenger uses the single
+        submission to cork per-peer frame bursts (one writev + one drain
+        per peer instead of one per message)."""
+        for dst, msg in pairs:
+            await self.send_message(src, dst, msg)
 
     def adopt_task(self, name: str, task: "asyncio.Task") -> None:
         """Track an auxiliary task (e.g. a daemon's tick loop) so shutdown

@@ -560,8 +560,11 @@ class PG:
             "expected": set(expected),
             "done": done,
         }
-        for target, sub in subs:
-            await self.messenger.send_message(self.name, target, sub)
+        # one multi-destination submit for the whole k+m fan-out: the
+        # TCP messenger's per-peer cork queues gather each peer's share
+        # into a single scatter-gather burst (one writev + one drain per
+        # peer instead of one per sub-op)
+        await self.messenger.send_messages(self.name, subs)
         await self._await_commits(oid, tid, done, min_acks=min_acks)
 
     # -- shard read plumbing -----------------------------------------------
@@ -582,17 +585,18 @@ class PG:
             "outstanding": set(shards),
             "done": done,
         }
-        for s in shards:
-            sub = ECSubRead(
+        # multi-destination submit: the sub-read fan-out corks per peer
+        # exactly like the write fan-out
+        await self.messenger.send_messages(self.name, [
+            (f"osd.{acting[s]}", ECSubRead(
                 from_shard=s,
                 tid=tid,
                 to_read={oid: list(extents) if extents else [(0, -1)]},
                 attrs_to_read=[oid],
                 op_class=op_class,
-            )
-            await self.messenger.send_message(
-                self.name, f"osd.{acting[s]}", sub
-            )
+            ))
+            for s in shards
+        ])
         try:
             # config-driven (osd_op_thread_timeout role): give revived
             # stragglers the headroom the client op budget already allows

@@ -68,14 +68,25 @@ class CrushPlacement:
         # equivalent is OSDMapMapping's precomputed pg->osds cache).
         self._cache: Dict[int, List[Optional[int]]] = {}
         self._cache_epoch = self.epoch
+        # oid -> pg is pure hashing, independent of the epoch; the data
+        # path asks for the same object's acting set dozens of times per
+        # op (_shard_up loops), so the hash must not re-run each time.
+        # Bounded: cleared wholesale when it grows past ~64k names.
+        self._pg_cache: Dict[str, int] = {}
 
     def pg_of(self, oid: str) -> int:
-        h = crush_hash32(
-            int.from_bytes(
-                hashlib.blake2b(oid.encode(), digest_size=4).digest(), "big"
+        pg = self._pg_cache.get(oid)
+        if pg is None:
+            if len(self._pg_cache) >= (1 << 16):
+                self._pg_cache.clear()
+            h = crush_hash32(
+                int.from_bytes(
+                    hashlib.blake2b(oid.encode(),
+                                    digest_size=4).digest(), "big"
+                )
             )
-        )
-        return int(h) % self.pg_num
+            pg = self._pg_cache[oid] = int(h) % self.pg_num
+        return pg
 
     def acting_for_pg(self, pg: int) -> List[Optional[int]]:
         """km entries; ``None`` marks an unmappable position (hole).

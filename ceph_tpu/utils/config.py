@@ -97,6 +97,18 @@ OPTIONS: Dict[str, Option] = {
              "marks the target down (reference "
              "mon_osd_min_down_reporters, src/mon/OSDMonitor.cc "
              "check_failure)"),
+        _opt("osd_msgr_cork", bool, True, LEVEL_ADVANCED,
+             "coalesce outgoing messenger frames per connection into "
+             "scatter-gather bursts (one writelines + one drain per "
+             "burst) and piggyback/batch acks instead of one ack frame "
+             "+ drain per message; off = one write/drain per message "
+             "(the pre-round-8 wire behavior, kept as the bench "
+             "baseline)"),
+        _opt("osd_msgr_cork_bytes", int, 256 * 1024, LEVEL_ADVANCED,
+             "corked send queue byte threshold: a queue reaching this "
+             "many pending frame bytes flushes immediately instead of "
+             "waiting for the end-of-tick flush",
+             see_also=("osd_msgr_cork",)),
         _opt("ms_inject_socket_failures", int, 0, LEVEL_DEV,
              "inject a message drop roughly every N messages"),
         _opt("ms_inject_internal_delays", float, 0.0, LEVEL_DEV,

@@ -1,0 +1,51 @@
+"""Fixture: async-drain-per-item (the pattern round 8 removed from the
+messenger send path -- kept out mechanically from here on)."""
+
+import asyncio  # noqa: F401
+
+
+async def per_item_for(writer, frames):
+    for f in frames:
+        writer.write(f)
+        await writer.drain()  # LINT: async-drain-per-item
+
+
+async def per_item_while(reader, writer):
+    # the serve-loop shape: one ack frame + one drain per received message
+    while True:
+        msg = await reader.readexactly(16)
+        writer.write(msg)
+        await writer.drain()  # LINT: async-drain-per-item
+
+
+async def corked(writer, frames):
+    # one scatter-gather burst, one drain: the replacement shape
+    writer.writelines(frames)
+    await writer.drain()
+
+
+async def per_burst(writer, bursts):
+    # drain per BURST (writelines is not a unit write): clean
+    for frames in bursts:
+        writer.writelines(frames)
+        await writer.drain()
+
+
+async def inner_writes_outer_drain(writer, batches):
+    # unit writes confined to an inner loop, drain once per batch: clean
+    while batches:
+        for piece in batches.pop():
+            writer.write(piece)
+        await writer.drain()
+
+
+async def drain_only_loop(writer, ticks):
+    # a periodic flow-control drain with no writes in the loop: clean
+    for _ in ticks:
+        await writer.drain()
+
+
+def sync_write_loop(fh, rows):
+    # sync file I/O loop: no drain, not this rule's business
+    for row in rows:
+        fh.write(row)

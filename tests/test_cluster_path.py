@@ -1,0 +1,85 @@
+"""Cluster-path (real localhost TCP) smoke gates.
+
+The round-8 analogue of tests/test_storage_path.py for the WIRE: tiny
+shapes through the REAL cluster-path bench harness
+(ceph_tpu/msg/cluster_bench.py) -- multi-daemon OSDShards on their own
+TCPMessengers, a client Objecter, every byte over real sockets.
+
+Gates:
+* bit-exactness (read-back + shard bytes across modes) runs INSIDE the
+  harness, before any timing;
+* the corked wire must not lose to the per-message baseline on the
+  full-stack walls (within a noise tolerance -- the full stack is
+  dominated by mode-independent codec/OSD work);
+* the messenger-level wire stage must show a real corking win and sane
+  wire-shape counters (multi-frame bursts, piggybacked acks) -- the
+  loud regression gate for the corked send path itself.
+"""
+
+import pytest
+
+from ceph_tpu.plugins import registry as registry_mod
+
+#: full-stack walls are noisy at smoke shapes (tens of ms): the corked
+#: mode must be within this factor of per-message, not strictly faster
+_TOLERANCE = 1.35
+
+#: wire-stage floor: measured ~1.8-2x on an idle machine; gate well
+#: below that so CI noise cannot flake the suite while a real
+#: regression (corking silently disabled / per-message fallback) fails
+_WIRE_FLOOR = 1.15
+
+
+@pytest.fixture(scope="module")
+def result():
+    from ceph_tpu.msg.cluster_bench import run_cluster_path_bench
+
+    ec = registry_mod.instance().factory(
+        "jerasure", {"k": "2", "m": "1", "technique": "reed_sol_van"}
+    )
+    return run_cluster_path_bench(
+        ec, n_objects=12, obj_bytes=2 << 10, writers=4, iters=1
+    )
+
+
+def test_cluster_path_bit_exact(result):
+    # read-back equality is gated inside every cycle and shard bytes are
+    # compared across modes before this flag can be True
+    assert result["bit_exact"]
+    assert result["k"] == 2 and result["m"] == 1
+
+
+def test_cluster_path_corked_not_slower(result):
+    assert result["corked"]["wall_write_s"] <= \
+        result["per_message"]["wall_write_s"] * _TOLERANCE, result
+    assert result["corked"]["wall_read_s"] <= \
+        result["per_message"]["wall_read_s"] * _TOLERANCE, result
+
+
+def test_cluster_path_wire_stage_corking_wins(result):
+    assert result["wire_write_speedup"] is not None
+    assert result["wire_write_speedup"] >= _WIRE_FLOOR, result
+
+
+def test_cluster_path_wire_counters_shape(result):
+    """The corked wire must actually cork: multi-frame bursts, acks
+    overwhelmingly piggybacked/elided, and far fewer drains than
+    frames.  The per-message baseline must show the opposite shape
+    (one burst and one drain per frame, zero piggybacks)."""
+    corked = result["wire_corked"]["counters"]
+    base = result["wire_per_message"]["counters"]
+    assert corked["frames_per_burst"] > 1.5, corked
+    assert corked["drains"] < corked["frames_sent"] / 4, corked
+    assert corked["acks_piggybacked"] > 0, corked
+    assert corked["ack_piggyback_ratio"] > 0.3, corked
+    assert base["frames_per_burst"] == 1.0, base
+    assert base["drains"] == base["frames_sent"], base
+    assert base["acks_piggybacked"] == 0, base
+
+
+def test_cluster_path_full_stack_counters_recorded(result):
+    for mode in ("per_message", "corked"):
+        c = result[mode]["counters"]
+        for key in ("frames_sent", "bursts", "bytes_sent",
+                    "frames_per_burst", "ack_piggyback_ratio"):
+            assert key in c, (mode, c)

@@ -269,16 +269,12 @@ def test_lossless_receiver_dedups_replayed_duplicates():
         sess = a._sessions["osd.1"]
         import collections
 
-        from ceph_tpu.utils.encoding import Encoder
         sess.acked = 0
         sess.sent = collections.deque(
-            (seq, Encoder().u8(0).string("osd.0").string("osd.1")
-             .varint(seq).blob(
-                 __import__("ceph_tpu.msg.wire", fromlist=["x"])
-                 .encode_message(f"d{seq - 1}")).bytes())
+            a._msg_entry("osd.0", "osd.1", seq, f"d{seq - 1}")
             for seq in range(1, 5)
         )
-        sess.sent_bytes = sum(len(p) for _s, p in sess.sent)
+        sess.sent_bytes = sum(e.nbytes for e in sess.sent)
         conn = a._conns.pop("osd.1", None)
         if conn is not None:
             conn[1].close()

@@ -37,7 +37,8 @@ def parse_args(argv):
     p.add_argument("-p", "--plugin", default="jerasure",
                    help="erasure code plugin name")
     p.add_argument("-w", "--workload", default="encode",
-                   choices=["encode", "decode", "storage-path"])
+                   choices=["encode", "decode", "storage-path",
+                            "cluster-path"])
     p.add_argument("-e", "--erasures", type=int, default=1,
                    help="number of erasures when decoding")
     p.add_argument("--erased", type=int, action="append", default=[],
@@ -168,6 +169,35 @@ def main(argv=None) -> int:
             f"GiB/s ({result['write_speedup']}x per-op), read "
             f"{result['coalesced']['read_GiBs']:.4f} GiB/s "
             f"({result['read_speedup']}x)", file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "cluster-path":
+        # Distributed storage-path stage (round 8): client Objecter ->
+        # primary OSD -> k+m sub-op fan-out over REAL localhost TCP,
+        # per-message wire vs corked/zero-copy wire (piggybacked acks),
+        # bit-exactness gated before timing, plus the messenger-level
+        # wire stage and wire-shape counters.  Prints one JSON line
+        # (the shape bench.py records as cluster_path_host_*).
+        import json
+
+        from ceph_tpu.msg.cluster_bench import run_cluster_path_bench
+
+        result = run_cluster_path_bench(
+            ec, n_objects=args.objects, obj_bytes=args.size,
+            writers=args.writers, iters=max(1, args.iterations),
+        )
+        print(json.dumps(result))
+        wc = result["wire_corked"]["counters"]
+        print(
+            f"cluster-path k={result['k']} m={result['m']} "
+            f"{args.objects}x{args.size}B x{args.writers} writers over "
+            f"TCP: corked write {result['corked']['write_MiBs']:.3f} "
+            f"MiB/s ({result['write_speedup']}x per-message), wire "
+            f"stage {result['wire_write_speedup']}x "
+            f"({wc['frames_per_burst']} frames/burst, "
+            f"{wc['ack_piggyback_ratio']} acks piggybacked)",
+            file=sys.stderr,
         )
         return 0
 
