@@ -506,6 +506,21 @@ def main() -> int:
 
     cp_host = _secondary(_cluster_path_host)
 
+    def _tier_path_host():
+        """Round-9 tentpole metric: hot device-resident tier read (one
+        D2H + transpose, no fan-out, no decode) vs the cold miss path
+        (frombuffer ingest + degraded codec decode), bit-exactness
+        gated before timing (ceph_tpu/tier/tier_bench.py).  The
+        jerasure codec keeps the cold side device-independent; the hot
+        side exercises the real DeviceTierStore residency."""
+        from ceph_tpu.tier.tier_bench import run_tier_path_bench
+
+        return run_tier_path_bench(
+            cpu_ec, n_objects=64, obj_bytes=1 << 16, iters=2
+        )
+
+    tp_host = _secondary(_tier_path_host)
+
     def _lint_findings_total():
         """Static-health trend metric: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json).
@@ -569,6 +584,13 @@ def main() -> int:
             cp_host["wire_corked"]["counters"]["ack_piggyback_ratio"]
             if cp_host else None),
         "cluster_path_host": cp_host,
+        "tier_path_host_read_GiBs": _r3(
+            tp_host["hot_read_GiBs"]) if tp_host else None,
+        "tier_path_host_cold_GiBs": _r3(
+            tp_host["cold_read_GiBs"]) if tp_host else None,
+        "tier_path_host_read_speedup": (
+            tp_host["read_speedup"] if tp_host else None),
+        "tier_path_host": tp_host,
         "lint_findings_total": lint_total,
         "platform": jax.devices()[0].platform + (
             "-fallback"
@@ -591,7 +613,8 @@ def main() -> int:
         f"{sp_host['write_speedup'] if sp_host else '?'}x per-op, "
         f"cluster-path corked {cp_host['write_speedup'] if cp_host else '?'}"
         f"x full-stack / {cp_host['wire_write_speedup'] if cp_host else '?'}"
-        f"x wire vs per-message on "
+        f"x wire vs per-message, tier-path hot read "
+        f"{tp_host['read_speedup'] if tp_host else '?'}x cold decode on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

@@ -167,6 +167,68 @@ def check_dtype_drift(ctx: FileContext) -> Iterator[Finding]:
                 )
 
 
+#: the two accounting seams allowed to RETAIN device arrays: every
+#: other module must route residency through them so the
+#: osd_tier_hbm_bytes ledger (tier/device_tier.py DeviceByteAccount)
+#: stays exact and eviction can always reclaim the bytes
+DEVICE_BYTES_ACCOUNTING_FILES = (
+    "ceph_tpu/tier/device_tier.py",
+    "ceph_tpu/ops/pipeline.py",
+)
+
+_DEVICE_PUT_CALLS = {
+    "jax.device_put", "jax.device_put_sharded", "jax.device_put_replicated",
+}
+
+
+@rule(
+    "jax-device-bytes-unaccounted", "jax", SEV_WARNING,
+    "device-resident array retention (a jax.device_put result stored on "
+    "an attribute or container) outside the tier/pipeline accounting "
+    "helpers: HBM held this way is invisible to the osd_tier_hbm_bytes "
+    "ledger and can never be evicted under budget pressure -- route it "
+    "through DeviceTierStore or the pipeline's H2D cache",
+)
+def check_device_bytes_unaccounted(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.path.startswith("ceph_tpu/"):
+        return  # tools/tests/bench hold device arrays transiently by design
+    if ctx.path in DEVICE_BYTES_ACCOUNTING_FILES:
+        return
+    if not ctx.imports_module("jax"):
+        return
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        # names bound to device_put results in this function (simple
+        # local flow, the same depth check_device_iteration uses)
+        put_names = set()
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call) and \
+                    call_name(node.value) in _DEVICE_PUT_CALLS:
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        put_names.add(tgt.id)
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Assign):
+                continue
+            if not any(isinstance(t, (ast.Attribute, ast.Subscript))
+                       for t in node.targets):
+                continue  # plain local bind: transient, fine
+            v = node.value
+            direct = isinstance(v, ast.Call) and \
+                call_name(v) in _DEVICE_PUT_CALLS
+            via_name = isinstance(v, ast.Name) and v.id in put_names
+            if direct or via_name:
+                yield ctx.finding(
+                    "jax-device-bytes-unaccounted", node,
+                    "device_put result retained on an attribute/container "
+                    "outside the accounting seams (tier/device_tier.py, "
+                    "ops/pipeline.py): these bytes bypass the "
+                    "osd_tier_hbm_bytes ledger",
+                )
+
+
 @rule(
     "jax-device-array-iteration", "jax", SEV_WARNING,
     "Python for-loop directly over a device array: every element is a "

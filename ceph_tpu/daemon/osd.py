@@ -72,6 +72,9 @@ async def _mon_integrate(args, shard, messenger, addr_map,
 
         for pname, p in m.get("pools", {}).items():
             if pname in shard.pools:
+                # the cache-tier mode flows with every map epoch
+                # (`osd tier cache-mode` commits -> broadcast -> here)
+                shard.pools[pname].tier_mode = p.get("cache_mode", "none")
                 continue
             if p.get("pool_type") == "replicated":
                 ec, km = None, int(p["size"])
@@ -89,9 +92,12 @@ async def _mon_integrate(args, shard, messenger, addr_map,
             placement = CrushPlacement(n_osds, km, hosts=p.get("hosts"))
             for osd_s, w in m["weights"].items():
                 placement.weights[int(osd_s)] = w
-            shard.host_pool(pname, ec, n_osds, placement,
-                            pool_type=p.get("pool_type", "erasure"),
-                            size=km, min_size=p.get("min_size") or None)
+            hosted = shard.host_pool(
+                pname, ec, n_osds, placement,
+                pool_type=p.get("pool_type", "erasure"),
+                size=km, min_size=p.get("min_size") or None,
+            )
+            hosted.tier_mode = p.get("cache_mode", "none")
         shard.request_peering()  # re-peer on every map epoch
 
     async def mon_hook(src, msg):
@@ -297,6 +303,10 @@ async def serve(args) -> None:
             "pools": sorted(shard.pools),
         })
         asok.register("list_objects", lambda cmd: sorted(_live_objects()))
+        asok.register("tier status", lambda cmd: dict(
+            shard.tier.status(), name=name,
+            modes={p: b.tier_mode for p, b in shard.pools.items()},
+        ))
         asok.register("hit_set ls", lambda cmd: shard.hitsets.dump())
         asok.register("hit_set temperature", lambda cmd: {
             "oid": cmd.get("oid", ""),

@@ -23,6 +23,7 @@ class ClusterState:
                     used += store.stat(oid)
                 except FileNotFoundError:
                     pass
+            tier = getattr(osd, "tier", None)
             out[osd.name] = {
                 "up": not self.cluster.messenger.is_down(osd.name),
                 "num_shards": len(objects),
@@ -30,6 +31,9 @@ class ClusterState:
                 "perf": osd.perf.snapshot(),
                 "ops_in_flight":
                     osd.optracker.dump_ops_in_flight()["num_ops"],
+                # device cache-tier residency (budget + hit/miss ride
+                # along so /metrics can expose them as gauges)
+                "tier": tier.status() if tier is not None else None,
             }
         return out
 
@@ -139,6 +143,24 @@ def prometheus_text(state: dict) -> str:
     for name, s in sorted(state["osd_stats"].items()):
         lines.append(f'ceph_osd_num_shards{{ceph_daemon="{name}"}} '
                      f"{s['num_shards']}")
+    lines += ["# HELP ceph_osd_tier_resident_bytes device-resident "
+              "cache-tier bytes per OSD",
+              "# TYPE ceph_osd_tier_resident_bytes gauge"]
+    for name, s in sorted(state["osd_stats"].items()):
+        tier = s.get("tier")
+        if tier is not None:
+            lines.append(
+                f'ceph_osd_tier_resident_bytes{{ceph_daemon="{name}"}} '
+                f"{tier['resident_bytes']}")
+    lines += ["# HELP ceph_osd_tier_hbm_budget_bytes device byte budget "
+              "(osd_tier_hbm_bytes)",
+              "# TYPE ceph_osd_tier_hbm_budget_bytes gauge"]
+    for name, s in sorted(state["osd_stats"].items()):
+        tier = s.get("tier")
+        if tier is not None:
+            lines.append(
+                f'ceph_osd_tier_hbm_budget_bytes{{ceph_daemon="{name}"}} '
+                f"{tier['budget']}")
     lines += ["# HELP ceph_pool_objects logical objects in the pool",
               "# TYPE ceph_pool_objects gauge",
               f"ceph_pool_objects {state['pools']['num_objects']}",

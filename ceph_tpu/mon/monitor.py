@@ -588,6 +588,34 @@ class Monitor:
             }
             ok = await self._propose({"op": "pool_create", "pool": pool})
             return (0, pool) if ok else (-11, "no quorum")
+        # -- cache tiering (OSDMonitor `osd tier` subset re-targeted at
+        # device residency: the cache device is HBM, so the commands set
+        # the pool's mode rather than overlay a second pool) -----------
+        if prefix == "osd tier cache-mode":
+            from ceph_tpu.tier import CACHE_MODES
+
+            name, mode = cmd["pool"], cmd["mode"]
+            if name not in self.osdmap.pools:
+                return -2, f"no pool {name}"
+            if mode not in CACHE_MODES:
+                return -22, (f"bad cache mode {mode!r} (want one of "
+                             f"{'/'.join(CACHE_MODES)})")
+            ok = await self._propose(
+                {"op": "pool_tier", "name": name, "cache_mode": mode}
+            )
+            return (0, {"pool": name, "cache_mode": mode}) if ok \
+                else (-11, "no quorum")
+        if prefix == "osd tier status":
+            from ceph_tpu.utils.config import get_config as _gc
+
+            return 0, {
+                "hbm_budget_bytes": int(_gc().get_val(
+                    "osd_tier_hbm_bytes")),
+                "pools": {
+                    name: {"cache_mode": p.cache_mode}
+                    for name, p in sorted(self.osdmap.pools.items())
+                },
+            }
         # -- ConfigKeyService (src/mon/ConfigKeyService.cc) ----------------
         if prefix == "config-key set":
             ok = await self._propose(

@@ -29,6 +29,10 @@ class PoolInfo:
     pg_num: int = 128
     # crush failure-domain spec: None -> flat over osds
     hosts: Optional[List[List[int]]] = None
+    # device cache-tier mode (pg_pool_t cache_mode role, re-targeted at
+    # HBM residency): "writeback" | "readproxy" | "none"; flows to the
+    # daemons with every map broadcast (`osd tier cache-mode`)
+    cache_mode: str = "none"
 
 
 def apply_map_view(m: dict, state: dict, messenger=None, placements=(),
@@ -91,6 +95,7 @@ class OSDMap:
                     "min_size": p.min_size,
                     "pg_num": p.pg_num,
                     "hosts": p.hosts,
+                    "cache_mode": p.cache_mode,
                 }
                 for name, p in self.pools.items()
             },
@@ -137,6 +142,12 @@ class OSDMap:
             self.pools[p["name"]] = PoolInfo(**p)
         elif op == "pool_rm":
             self.pools.pop(inc["name"], None)
+        elif op == "pool_tier":
+            # cache-tier mode change (OSDMonitor `osd tier cache-mode`)
+            pool = self.pools.get(inc["name"])
+            if pool is None:
+                raise ValueError(f"pool_tier for unknown pool {inc['name']}")
+            pool.cache_mode = inc["cache_mode"]
         else:
             raise ValueError(f"unknown incremental op {op}")
         self.epoch += 1

@@ -26,6 +26,11 @@ calls encode() once per iteration; driving a device at that surface requires:
   full crc32 of the granule bytes, never by object identity alone; disable
   with CEPH_TPU_NO_H2D_CACHE=1.  Compute and parity D2H still happen every
   call -- only the *input upload* of byte-identical content is elided.
+  Retained device bytes are charged to the shared HBM ledger
+  (ceph_tpu/tier/device_tier.py DeviceByteAccount) and the cache evicts
+  LRU-first to its osd_tier_h2d_cache_bytes sub-allocation of the
+  osd_tier_hbm_bytes budget -- the cache-tier store yields to this
+  working set, so both can never jointly exceed the device budget.
 
 Decode reconstruction is fused to ONE device matmul per erasure signature:
 every erased chunk (data or parity) is expressed as a GF-linear combination
@@ -70,6 +75,23 @@ def _h2d_cache_enabled() -> bool:
     return not os.environ.get("CEPH_TPU_NO_H2D_CACHE")
 
 
+def _release_h2d_entries(cache: "OrderedDict") -> None:
+    """Return a stream's cached upload bytes to the shared HBM ledger
+    and drop the device references.  Runs on explicit stream retirement
+    (the decode-stream LRU dropping a signature) and as a GC finalizer
+    backstop -- a collected stream must not leave its bytes charged
+    forever.  Takes the cache dict, not the stream, so the finalizer
+    holds no reference that would keep the stream alive."""
+    if not cache:
+        return
+    from ceph_tpu.tier.device_tier import device_byte_account
+
+    acct = device_byte_account()
+    for _d, nbytes in cache.values():
+        acct.release("h2d", nbytes)
+    cache.clear()
+
+
 class DeviceStream:
     """One uploaded GF(2) matrix + the jitted program(s) that apply it.
 
@@ -91,7 +113,12 @@ class DeviceStream:
         self.packetsize = packetsize
         self._tpu = _backend_is_tpu()
         self._lock = threading.Lock()
-        self._h2d_cache: OrderedDict[Tuple, object] = OrderedDict()
+        #: content key -> (device array, nbytes); bytes charged to the
+        #: shared ledger, released on eviction / retirement / GC
+        self._h2d_cache: OrderedDict[Tuple, Tuple] = OrderedDict()
+        import weakref
+
+        weakref.finalize(self, _release_h2d_entries, self._h2d_cache)
 
         if kind == "matrix":
             if self._tpu and w == 8:
@@ -187,14 +214,29 @@ class DeviceStream:
             key = (packed.shape,
                    hashlib.blake2b(packed, digest_size=16).digest())
         with self._lock:
-            d = self._h2d_cache.get(key) if key is not None else None
+            hit = self._h2d_cache.get(key) if key is not None else None
+            if hit is not None:
+                self._h2d_cache.move_to_end(key)
+        d = hit[0] if hit is not None else None
         if d is None:
             d = jax.device_put(packed)
             if key is not None:
+                # retention is byte-budgeted against the shared HBM
+                # ledger: LRU entries fall out once the cache's
+                # sub-allocation (osd_tier_h2d_cache_bytes, itself
+                # capped by osd_tier_hbm_bytes) is exceeded across all
+                # streams of this process
+                from ceph_tpu.tier.device_tier import (DeviceByteAccount,
+                                                       device_byte_account)
+
+                acct = device_byte_account()
+                budget = DeviceByteAccount.h2d_budget()
                 with self._lock:
-                    self._h2d_cache[key] = d
-                    while len(self._h2d_cache) > 4:
-                        self._h2d_cache.popitem(last=False)
+                    self._h2d_cache[key] = (d, packed.nbytes)
+                    acct.charge("h2d", packed.nbytes)
+                    while self._h2d_cache and acct.used("h2d") > budget:
+                        _k, (_old, nb) = self._h2d_cache.popitem(last=False)
+                        acct.release("h2d", nb)
 
         n4 = packed.shape[1]
         if self._mode == "pallas8":
@@ -219,6 +261,11 @@ class DeviceStream:
         from ceph_tpu.ops.xla_gf import _encode_packets_kernel
 
         return _encode_packets_kernel(self._B, d)
+
+    def release_h2d(self) -> None:
+        """Retire this stream's upload cache (ledger-settling)."""
+        with self._lock:
+            _release_h2d_entries(self._h2d_cache)
 
     @staticmethod
     def start_d2h(out) -> None:
@@ -494,7 +541,11 @@ class DeviceCodec:
         with self._lock:
             self._decode_streams[sig] = (sel, stream)
             while len(self._decode_streams) > self.DECODE_LRU:
-                self._decode_streams.popitem(last=False)
+                # retire the dropped signature's stream NOW: its cached
+                # uploads must return to the HBM ledger deterministically,
+                # not whenever GC gets around to the finalizer
+                _sig, (_sel, old) = self._decode_streams.popitem(last=False)
+                old.release_h2d()
         return sel, stream
 
     # -- one-shot conveniences (the sync plugin contract) -------------------

@@ -119,6 +119,20 @@ class ECCluster:
             oid_prefix=f"{name}/",
         )
 
+    def set_tier_mode(self, mode: str, pool: Optional[str] = None) -> None:
+        """Configure a pool's device cache-tier mode on every hosted
+        engine -- the in-process analogue of the mon's
+        ``osd tier cache-mode`` (writeback | readproxy | none)."""
+        from ceph_tpu.tier import CACHE_MODES
+
+        if mode not in CACHE_MODES:
+            raise ValueError(f"bad cache mode {mode!r}")
+        pool = pool or self.pool
+        for osd in self.osds:
+            backend = osd.pools.get(pool)
+            if backend is not None:
+                backend.tier_mode = mode
+
     def new_client(self, name: str) -> Objecter:
         """A second client handle on the same cluster (librados: another
         Rados instance)."""
@@ -373,6 +387,9 @@ class ECCluster:
     async def shutdown(self) -> None:
         await self.messenger.shutdown()
         for osd in self.osds:
+            # settle the shared HBM ledger: a dead daemon's resident
+            # tier bytes must not stay charged against live ones
+            osd.tier.clear()
             umount = getattr(osd.store, "umount", None)
             if umount is not None:
                 umount()

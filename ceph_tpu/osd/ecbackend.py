@@ -144,6 +144,76 @@ class ECBackend(PG):
         nbytes = sum(c.nbytes for c in chunks.values())
         return await self._dec_coalescer.submit(chunks, nbytes)
 
+    # -- device cache tier (ceph_tpu/tier/) --------------------------------
+
+    def _tier_read(self, oid: str) -> Optional[bytes]:
+        """Hit path: serve the logical bytes straight from the resident
+        shard-major device block -- one D2H of the data rows + the
+        logical transpose; no sub-read fan-out, no frombuffer ingest,
+        and no decode even when the acting set is degraded (all km
+        positions are resident).  None = miss / tier off / stale."""
+        tier = self._tier
+        if tier is None or self.tier_mode not in ("writeback", "readproxy"):
+            return None
+        ent = tier.lookup(self.pool_name, oid)
+        if ent is None:
+            return None
+        known = self._versions.get(oid)
+        if known is not None and ent.version[0] < known:
+            # this primary already assigned/learned a newer version:
+            # the resident block predates it
+            tier.invalidate(self.pool_name, oid)
+            self.perf.inc("tier_stale_drop")
+            return None
+        pos = ecutil.data_positions(self.ec)
+        if pos == list(range(self.k)):
+            # the common layout: data rows lead -- D2H only those
+            rows = np.asarray(ent.block[:self.k])
+        else:
+            host = np.asarray(ent.block)  # remapped chunks: whole block
+            rows = np.stack([host[p] for p in pos])
+        from ceph_tpu.tier.device_tier import reassemble_data_rows
+
+        data = reassemble_data_rows(rows, self.sinfo.chunk_size)
+        self.perf.inc("tier_hit_read")
+        return data[:ent.logical_size]
+
+    def _tier_hot(self, oid: str) -> bool:
+        if self._hitset_temp is None:
+            return False
+        from ceph_tpu.utils.config import get_config
+
+        return self._hitset_temp(oid) >= float(
+            get_config().get_val("osd_tier_promote_temp")
+        )
+
+    def _tier_write_update(self, oid: str, encoded, version,
+                           logical: int) -> bool:
+        """Write-through tier update: in writeback mode a hot (or
+        already-resident) object's freshly encoded block -- the very
+        arrays the coalescer's batched dispatch just produced -- replaces
+        the resident copy, marked DIRTY until the fan-out commits
+        (promote-on-write, no extra gather or transfer beyond the
+        eventual device_put).  Any other resident copy is invalidated
+        (readproxy/cold writes must not serve pre-write bytes)."""
+        tier = self._tier
+        if tier is None or self.tier_mode == "none":
+            return False
+        resident = tier.contains(self.pool_name, oid)
+        if self.tier_mode == "writeback" and logical and (
+            resident or self._tier_hot(oid)
+        ):
+            block = np.stack([
+                np.asarray(encoded[s], dtype=np.uint8)
+                for s in range(self.km)
+            ])
+            tier.put(self.pool_name, oid, block, version, logical,
+                     dirty=True)
+            return True
+        if resident:
+            tier.invalidate(self.pool_name, oid)
+        return False
+
     # -- write path --------------------------------------------------------
 
     async def _write_pinned(self, oid: str, data: bytes,
@@ -215,6 +285,9 @@ class ECBackend(PG):
                 log_entries=[entry],
             )))
         self.perf.inc("write")
+        # write-through tier update BEFORE the fan-out: the block rides
+        # dirty (unreadable) until the commit below confirms it
+        tier_put = self._tier_write_update(oid, encoded, version, logical)
         try:
             await self._fanout_commit(
                 oid, tid, subs, {f"osd.{acting[s]}" for s in up},
@@ -222,13 +295,31 @@ class ECBackend(PG):
             )
             span.event("all_commit")
             self._snap_committed(oid, snapset, logical)
+            if tier_put:
+                self._tier.mark_clean(self.pool_name, oid, version)
+        except BaseException:
+            if tier_put:
+                # the fan-out failed: the device copy is unconfirmed
+                self._tier.invalidate(self.pool_name, oid)
+            raise
         finally:
             span.finish()
 
     # -- read path ---------------------------------------------------------
 
     async def read(self, oid: str) -> bytes:
-        """objects_read_and_reconstruct: minimum shards, degraded fallback."""
+        """objects_read_and_reconstruct: minimum shards, degraded
+        fallback -- after consulting the device tier (a hit costs one
+        D2H + transpose, no fan-out and no decode)."""
+        if self._hitset_record is not None:
+            # reads heat the hit sets too (the tier agent's temperature
+            # source; write-only recording would never promote a
+            # read-hot object)
+            self._hitset_record(oid)
+        cached = self._tier_read(oid)
+        if cached is not None:
+            self.perf.inc("read")
+            return cached
         acting = self.acting_set(oid)
         up_shards = [
             s
@@ -260,6 +351,14 @@ class ECBackend(PG):
         """Read only the stripes covering [offset, offset+length)
         (reference: get_write_plan stripe algebra + sub-chunk reads,
         ECBackend.cc:1021-1037 fragmented shard reads)."""
+        if self._hitset_record is not None:
+            self._hitset_record(oid)
+        cached = self._tier_read(oid)
+        if cached is not None:
+            # whole-object residency serves any extent without a stat
+            # round-trip (logical_size already bounds the slice)
+            self.perf.inc("read_range")
+            return cached[offset:offset + length]
         size, _ = await self._stat(oid)
         if offset >= size:
             return b""
@@ -345,6 +444,10 @@ class ECBackend(PG):
             )
 
         version = self._next_version(oid)
+        # an RMW rewrites only the covered stripes: the resident block
+        # cannot be refreshed in place, so drop it (reads fall back to
+        # the shards; the agent re-promotes if the object stays hot)
+        self._tier_invalidate(oid)
         acting = self.acting_set(oid)
         up = await self._up_for_write(oid, acting, self.min_size)
         tid = self._new_tid()

@@ -217,6 +217,18 @@ class PG:
         #: per-object SnapSet cache learned via _stat:
         #: {"seq", "clones", "exists", "size"}
         self._snapsets: Dict[str, dict] = {}
+        # -- device cache tier hookup (ceph_tpu/tier/) ---------------------
+        #: the hosting OSD's DeviceTierStore (OSDShard.host_pool wires
+        #: it; a standalone engine keeps the tier off)
+        self._tier = None
+        #: per-pool cache mode: "writeback" | "readproxy" | "none"
+        #: (flows from the mon's `osd tier cache-mode` via the osdmap,
+        #: or ECCluster.set_tier_mode in-process)
+        self.tier_mode = "none"
+        #: hit-set feeds (late-bound to the hosting OSD's tracker so a
+        #: test swapping shard.hitsets is picked up)
+        self._hitset_record = None
+        self._hitset_temp = None
 
     # -- placement (CRUSH-lite) --------------------------------------------
 
@@ -254,6 +266,13 @@ class PG:
         Untagged objects (legacy / standalone writes) and un-pooled
         engines accept everything -- the single-pool behavior."""
         return tag is None or self.pool_name is None or tag == self.pool_name
+
+    def _tier_invalidate(self, oid: str) -> None:
+        """Drop any device-resident copy of ``oid`` (called by every
+        mutation path the tier cannot refresh in place: RMW extents,
+        removals, snapset restamps).  No-op without a tier."""
+        if self._tier is not None:
+            self._tier.invalidate(self.pool_name, oid)
 
     def _shard_up(self, acting, s: int) -> bool:
         """A shard position is usable iff it mapped (no CRUSH hole) and its
@@ -852,6 +871,7 @@ class PG:
             )
             self._snap_committed(oid, snapset, 0, exists=False)
             self.extent_cache.invalidate(oid)
+            self._tier_invalidate(oid)
             return
         self._snapsets.pop(oid, None)
         # tombstone the meta twin BEFORE destroying data: if the
@@ -863,6 +883,7 @@ class PG:
         await self._meta_remove(oid)
         await self._destroy_object(oid, up, acting)
         self.extent_cache.invalidate(oid)
+        self._tier_invalidate(oid)
 
     # -- metadata plane: replicated omap / CAS / watch-notify / cls --------
     #
@@ -1222,6 +1243,9 @@ class PG:
         if ent is not None:
             ent["seq"] = snapset["seq"]
             ent["clones"] = list(snapset["clones"])
+        # the bytes are unchanged but the version moved: a resident tier
+        # copy would read as stale forever, so drop it now
+        self._tier_invalidate(oid)
 
     # -- scrub -------------------------------------------------------------
 

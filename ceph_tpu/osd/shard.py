@@ -107,6 +107,18 @@ class OSDShard:
         from ceph_tpu.osd.hitset import HitSetTracker
 
         self.hitsets = HitSetTracker()
+        # device-resident cache tier (ceph_tpu/tier/): hot objects'
+        # encoded shards stay in device memory, byte-budgeted against
+        # the process-wide HBM ledger; the agent (tier_tick) promotes /
+        # flushes / evicts by hit-set temperature.  temp_fn late-binds
+        # through self so a swapped tracker is picked up.
+        from ceph_tpu.tier.device_tier import DeviceTierStore
+
+        self.tier = DeviceTierStore(
+            perf=self.perf,
+            temp_fn=lambda pool, oid: self.hitsets.temperature(oid),
+        )
+        self.tier_agent = None  # built lazily on the first active tick
         self.op_queue_type = op_queue
         if op_queue == "mclock":
             self.opq = MClockQueue(dict(MCLOCK_DEFAULTS))
@@ -171,6 +183,13 @@ class OSDShard:
                 min_size=min_size,
             )
         backend.pool_name = pool
+        # cache-tier hookup: the engine serves tier hits / write-through
+        # updates against this OSD's store, and feeds the hit sets the
+        # agent ranks temperature from (late-bound lambdas: replacing
+        # self.hitsets mid-test must redirect the feeds too)
+        backend._tier = self.tier
+        backend._hitset_record = lambda oid: self.hitsets.record(oid)
+        backend._hitset_temp = lambda oid: self.hitsets.temperature(oid)
         self.pools[pool] = backend
         return backend
 
@@ -241,6 +260,7 @@ class OSDShard:
         for backend in self.pools.values():
             total += await backend.peering_pass()
         total += await self.scrub_tick()
+        total += await self.tier_tick()
         return total
 
     def _scrub_base_list(self):
@@ -327,6 +347,32 @@ class OSDShard:
                     repaired += await backend.scrub_repair(base, report)
                 break
         return repaired
+
+    async def tier_tick(self) -> int:
+        """Cache-tier agent slice (peer of scrub_tick; the reference's
+        agent_work runs on the same background cadence): flush abandoned
+        dirty entries, promote hot objects this OSD leads in one batched
+        device transfer, evict back under osd_tier_hbm_bytes.  No-op
+        until some hosted pool's cache mode is writeback/readproxy.
+        Returns objects promoted (the tick's action count)."""
+        if not any(
+            getattr(b, "tier_mode", "none") != "none"
+            for b in self.pools.values()
+        ):
+            return 0
+        if self.tier_agent is None:
+            from ceph_tpu.tier.agent import TierAgent
+
+            self.tier_agent = TierAgent(self)
+        try:
+            stats = await self.tier_agent.tick()
+        except asyncio.CancelledError:
+            raise
+        except Exception:  # noqa: BLE001 -- a failed agent round must
+            # not kill the tick loop; the next tick retries
+            self.perf.inc("tier_agent_failed")
+            return 0
+        return int(stats.get("promoted", 0))
 
     def _op_cost(self, msg) -> int:
         if isinstance(msg, ECSubWrite):
@@ -469,6 +515,10 @@ class OSDShard:
             ok = self.pglog.rollback_object_to(
                 target_soid, to_version, self.store
             )
+            # a rolled-back shard invalidates any resident copy of its
+            # base object (the device block was built pre-rollback)
+            base = target_soid.rpartition("@")[0] or target_soid
+            self.tier.invalidate_oid(base)
             if ok:
                 try:
                     self.store.stat(target_soid)
@@ -921,6 +971,11 @@ class OSDShard:
             await self.messenger.send_message(self.name, src, reply)
             return
         self._applied_version[soid] = new_vt
+        # device-tier coherence: an applied sub-write proves any resident
+        # copy stale UNLESS it belongs to this very write (the primary's
+        # own write-through put carries the same version and survives;
+        # a racing primary's write carries a different one and evicts)
+        self.tier.invalidate_oid(msg.oid, keep_version=new_vt)
         # log_operation before queue_transactions (reference order,
         # ECBackend.cc:922): snapshot the pre-apply state so a torn write
         # can be rolled back locally (divergent-entry rollback) and give

@@ -1,0 +1,392 @@
+"""Byte-budgeted device-resident shard store + the process HBM ledger.
+
+The tier keeps hot objects' encoded shards as ONE shard-major device
+array per object ([km, shard_len] uint8, row s = shard s), so a read
+hit costs a single D2H of the data rows plus the logical transpose --
+no per-shard messenger round-trips, no ``np.frombuffer`` ingest, and a
+degraded acting set never forces a decode (every position is resident).
+
+Accounting is exact and shared: every device byte the storage layer
+retains -- tier entries here, the content-addressed H2D stripe cache in
+``ops/pipeline.py`` -- is charged to one :class:`DeviceByteAccount`
+ledger bounded by ``osd_tier_hbm_bytes``.  The pipeline cache evicts to
+its own sub-allocation (``osd_tier_h2d_cache_bytes``); the tier evicts
+to keep the TOTAL under budget, i.e. the tier yields device memory to
+the codec's working set, never the other way around.  cephlint's
+``jax-device-bytes-unaccounted`` rule keeps retention outside these two
+seams from creeping in.
+
+Eviction is LRU + temperature: the coldest (hit-set temperature, then
+least-recently-used) CLEAN entries go first; dirty entries (a
+write-through put whose fan-out has not committed yet) are never
+evicted -- the agent flushes them instead (`TierAgent.tick`).
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+
+def _to_device(arr: np.ndarray):
+    """One H2D transfer; falls back to the host array when no jax
+    backend is importable (the tier then degrades to a host cache with
+    identical semantics -- tests and codec-only tools keep working)."""
+    try:
+        import jax
+
+        return jax.device_put(arr)
+    except Exception:  # noqa: BLE001 -- no backend: host residency
+        return np.ascontiguousarray(arr)
+
+
+class DeviceByteAccount:
+    """Ledger of device (HBM) bytes the storage layer holds, partitioned
+    by owner ("tier" shard blocks, "h2d" pipeline stripe cache).  The
+    total budget is ``osd_tier_hbm_bytes``; consumers charge/release on
+    every retention change so the sum is exact, never estimated."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._used: Dict[str, int] = {}
+
+    def charge(self, owner: str, nbytes: int) -> None:
+        with self._lock:
+            self._used[owner] = self._used.get(owner, 0) + int(nbytes)
+
+    def release(self, owner: str, nbytes: int) -> None:
+        with self._lock:
+            self._used[owner] = max(0, self._used.get(owner, 0) - int(nbytes))
+
+    def used(self, owner: Optional[str] = None) -> int:
+        with self._lock:
+            if owner is not None:
+                return self._used.get(owner, 0)
+            return sum(self._used.values())
+
+    @staticmethod
+    def budget() -> int:
+        """Total device-byte budget (osd_tier_hbm_bytes)."""
+        from ceph_tpu.utils.config import get_config
+
+        return int(get_config().get_val("osd_tier_hbm_bytes"))
+
+    @staticmethod
+    def h2d_budget() -> int:
+        """The pipeline H2D stripe cache's sub-allocation: capped by the
+        total budget (a sub-allocation cannot exceed the whole)."""
+        from ceph_tpu.utils.config import get_config
+
+        cfg = get_config()
+        return min(int(cfg.get_val("osd_tier_h2d_cache_bytes")),
+                   int(cfg.get_val("osd_tier_hbm_bytes")))
+
+
+_account: Optional[DeviceByteAccount] = None
+_account_lock = threading.Lock()
+
+
+def device_byte_account() -> DeviceByteAccount:
+    """The process-wide ledger (all OSD shards in one process share the
+    one device, so they share the one budget)."""
+    global _account
+    with _account_lock:
+        if _account is None:
+            _account = DeviceByteAccount()
+        return _account
+
+
+class TierEntry:
+    """One resident object: the shard-major device block + metadata."""
+
+    __slots__ = ("pool", "oid", "block", "version", "logical_size",
+                 "dirty", "nbytes", "last_access")
+
+    def __init__(self, pool: str, oid: str, block, version: tuple,
+                 logical_size: int, dirty: bool, nbytes: int):
+        self.pool = pool
+        self.oid = oid
+        self.block = block          # device array [km, shard_len] u8
+        self.version = version      # (counter, writer) vt tuple
+        self.logical_size = logical_size
+        self.dirty = dirty
+        self.nbytes = nbytes
+        self.last_access = 0        # store-sequence LRU stamp
+
+
+class DeviceTierStore:
+    """Per-OSD device-resident cache keyed by (pool, oid).
+
+    ``temp_fn(pool, oid) -> float`` supplies hit-set temperature for
+    eviction ordering (late-bound so a replaced HitSetTracker is picked
+    up); ``budget`` overrides the config-driven global budget (bench
+    isolation).  Thread-safe; device transfers happen outside no lock
+    longer than necessary.
+    """
+
+    OWNER = "tier"
+
+    def __init__(self, perf=None,
+                 temp_fn: Optional[Callable[[str, str], float]] = None,
+                 account: Optional[DeviceByteAccount] = None,
+                 budget: Optional[int] = None):
+        self.perf = perf
+        self._temp_fn = temp_fn
+        self._account = account if account is not None \
+            else device_byte_account()
+        self._budget = budget
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple[str, str], TierEntry]" = \
+            OrderedDict()
+        self._seq = 0
+        self._resident_bytes = 0
+        self.hits = 0
+        self.misses = 0
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def resident_bytes(self) -> int:
+        return self._resident_bytes
+
+    def budget(self) -> int:
+        if self._budget is not None:
+            return self._budget
+        return self._account.budget()
+
+    def _over_budget(self) -> bool:
+        if self._budget is not None:
+            return self._resident_bytes > self._budget
+        # global invariant: EVERY retained device byte (all tier stores
+        # + the pipeline H2D cache) stays under osd_tier_hbm_bytes
+        return self._account.used() > self._account.budget()
+
+    def contains(self, pool: Optional[str], oid: str) -> bool:
+        with self._lock:
+            return (pool, oid) in self._entries
+
+    def status(self) -> dict:
+        with self._lock:
+            return {
+                "resident_bytes": self._resident_bytes,
+                "budget": self.budget(),
+                "entries": len(self._entries),
+                "dirty": sum(1 for e in self._entries.values() if e.dirty),
+                "hit": self.hits,
+                "miss": self.misses,
+                "objects": [
+                    {"pool": e.pool, "oid": e.oid, "bytes": e.nbytes,
+                     "dirty": e.dirty, "version": list(e.version)}
+                    for e in self._entries.values()
+                ],
+            }
+
+    # -- lookup ------------------------------------------------------------
+
+    def lookup(self, pool: Optional[str], oid: str) -> Optional[TierEntry]:
+        """Resident entry or None.  Dirty entries read as misses: their
+        bytes are not commit-confirmed yet, and a cache must never serve
+        data the shards could still refuse (read-after-ack)."""
+        with self._lock:
+            ent = self._entries.get((pool, oid))
+            if ent is None or ent.dirty:
+                self.misses += 1
+                if self.perf is not None:
+                    self.perf.inc("tier_miss")
+                return None
+            self._seq += 1
+            ent.last_access = self._seq
+            self._entries.move_to_end((pool, oid))
+            self.hits += 1
+        if self.perf is not None:
+            self.perf.inc("tier_hit")
+        return ent
+
+    # -- insertion / promotion ---------------------------------------------
+
+    def put(self, pool: Optional[str], oid: str, block, version: tuple,
+            logical_size: int, dirty: bool = False) -> TierEntry:
+        """Insert/replace one object's shard-major block (host blocks are
+        transferred; device arrays from ``put_many`` slicing are taken
+        as-is), then evict to budget."""
+        if isinstance(block, np.ndarray):
+            block = _to_device(block)
+        ent = self._insert(pool, oid, block, version, logical_size, dirty)
+        self.evict_to_budget()
+        return ent
+
+    def put_many(self, items: List[tuple]) -> int:
+        """Batched promotion: ``items`` = [(pool, oid, host_block,
+        version, logical_size), ...].  Blocks with the same shard count
+        are concatenated along the byte axis and shipped as ONE device
+        transfer (the tick's single H2D), then split back into
+        per-object device slices."""
+        groups: Dict[int, List[tuple]] = {}
+        for it in items:
+            blk = it[2]
+            if blk is None or blk.size == 0:
+                continue
+            groups.setdefault(blk.shape[0], []).append(it)
+        n = 0
+        for grp in groups.values():
+            big = np.concatenate(
+                [np.asarray(it[2], dtype=np.uint8) for it in grp], axis=1
+            )
+            dev = _to_device(big)
+            col = 0
+            for pool, oid, blk, version, logical_size in grp:
+                width = blk.shape[1]
+                self._insert(pool, oid, dev[:, col:col + width],
+                             version, logical_size, dirty=False,
+                             promoted=True)
+                col += width
+                n += 1
+        if n:
+            self.evict_to_budget()
+        return n
+
+    def _insert(self, pool, oid, block, version, logical_size,
+                dirty, promoted: bool = False) -> TierEntry:
+        nbytes = int(block.shape[0]) * int(block.shape[1])
+        with self._lock:
+            old = self._entries.pop((pool, oid), None)
+            if old is not None:
+                self._resident_bytes -= old.nbytes
+                self._account.release(self.OWNER, old.nbytes)
+            ent = TierEntry(pool, oid, block, tuple(version),
+                            logical_size, dirty, nbytes)
+            self._seq += 1
+            ent.last_access = self._seq
+            self._entries[(pool, oid)] = ent
+            self._resident_bytes += nbytes
+            self._account.charge(self.OWNER, nbytes)
+            hw = self._resident_bytes
+        if self.perf is not None:
+            if promoted:
+                self.perf.inc("tier_promote_ops")
+                self.perf.inc("tier_promote_bytes", nbytes)
+            self.perf.hwm("tier_resident_bytes_hwm", hw)
+        return ent
+
+    # -- dirty lifecycle ---------------------------------------------------
+
+    def mark_clean(self, pool: Optional[str], oid: str,
+                   version: Optional[tuple] = None) -> bool:
+        """Commit confirmation for a write-through put; version-checked
+        so a racing newer put's state is never mislabeled."""
+        with self._lock:
+            ent = self._entries.get((pool, oid))
+            if ent is None:
+                return False
+            if version is not None and ent.version != tuple(version):
+                return False
+            ent.dirty = False
+        return True
+
+    def flush_dirty(self) -> int:
+        """Drop every dirty entry (the agent's flush): a put left dirty
+        past its write's lifetime belongs to a failed/abandoned fan-out,
+        and the authoritative bytes live on the shards -- reads fall
+        back there.  Returns entries flushed."""
+        with self._lock:
+            stale = [key for key, e in self._entries.items() if e.dirty]
+            for key in stale:
+                ent = self._entries.pop(key)
+                self._resident_bytes -= ent.nbytes
+                self._account.release(self.OWNER, ent.nbytes)
+        if stale and self.perf is not None:
+            self.perf.inc("tier_flush_ops", len(stale))
+        return len(stale)
+
+    # -- invalidation ------------------------------------------------------
+
+    def invalidate(self, pool: Optional[str], oid: str) -> bool:
+        with self._lock:
+            ent = self._entries.pop((pool, oid), None)
+            if ent is None:
+                return False
+            self._resident_bytes -= ent.nbytes
+            self._account.release(self.OWNER, ent.nbytes)
+        if self.perf is not None:
+            self.perf.inc("tier_invalidate")
+        return True
+
+    def invalidate_oid(self, oid: str,
+                       keep_version: Optional[tuple] = None) -> int:
+        """Drop ``oid`` across every pool unless the resident version
+        matches ``keep_version`` -- the sub-write coherence hook: the
+        primary's own write-through put (same versioned write) survives,
+        any other applied write proves the copy stale."""
+        dropped = 0
+        with self._lock:
+            for key in [k for k in self._entries if k[1] == oid]:
+                ent = self._entries[key]
+                if keep_version is not None and \
+                        ent.version == tuple(keep_version):
+                    continue
+                del self._entries[key]
+                self._resident_bytes -= ent.nbytes
+                self._account.release(self.OWNER, ent.nbytes)
+                dropped += 1
+        if dropped and self.perf is not None:
+            self.perf.inc("tier_invalidate", dropped)
+        return dropped
+
+    # -- eviction ----------------------------------------------------------
+
+    def evict_to_budget(self) -> int:
+        """Evict coldest-first until under budget; returns bytes freed.
+        Ordering: (hit-set temperature, LRU stamp) ascending -- the
+        reference agent's evict_mode ranking reduced to the two signals
+        we have.  Dirty entries are skipped (flush owns them)."""
+        freed = 0
+        evicted = 0
+        while self._over_budget():
+            with self._lock:
+                cands = [(key, ent) for key, ent in self._entries.items()
+                         if not ent.dirty]
+                if not cands:
+                    break
+                if self._temp_fn is not None:
+                    key, ent = min(
+                        cands,
+                        key=lambda kv: (self._temp_fn(kv[1].pool,
+                                                      kv[1].oid),
+                                        kv[1].last_access),
+                    )
+                else:
+                    key, ent = min(cands,
+                                   key=lambda kv: kv[1].last_access)
+                del self._entries[key]
+                self._resident_bytes -= ent.nbytes
+                self._account.release(self.OWNER, ent.nbytes)
+                freed += ent.nbytes
+                evicted += 1
+        if evicted and self.perf is not None:
+            self.perf.inc("tier_evict_ops", evicted)
+            self.perf.inc("tier_evict_bytes", freed)
+        return freed
+
+    def clear(self) -> None:
+        """Drop everything and settle the ledger (process restart
+        semantics: device memory does not survive the daemon, so a
+        revived OSD always cold-starts -- tests simulate restarts with
+        this, and the ledger must read zero afterwards)."""
+        with self._lock:
+            for ent in self._entries.values():
+                self._account.release(self.OWNER, ent.nbytes)
+            self._entries.clear()
+            self._resident_bytes = 0
+
+
+def reassemble_data_rows(data_rows: np.ndarray, chunk_size: int) -> bytes:
+    """[k, shard_len] host data rows -> logical bytes (the one transpose
+    of the hit path; mirrors ecutil._reassemble without the dict)."""
+    k, shard_len = data_rows.shape
+    n_stripes = shard_len // chunk_size
+    return data_rows.reshape(k, n_stripes, chunk_size).transpose(
+        1, 0, 2
+    ).tobytes()

@@ -97,6 +97,28 @@ OPTIONS: Dict[str, Option] = {
              "marks the target down (reference "
              "mon_osd_min_down_reporters, src/mon/OSDMonitor.cc "
              "check_failure)"),
+        _opt("osd_tier_hbm_bytes", int, 256 << 20, LEVEL_ADVANCED,
+             "device (HBM) byte budget for the storage layer's resident "
+             "state: cache-tier shard blocks plus the batching "
+             "pipeline's content-addressed H2D stripe cache.  The tier "
+             "agent evicts coldest-first to stay under it "
+             "(ceph_tpu/tier/device_tier.py DeviceByteAccount)"),
+        _opt("osd_tier_h2d_cache_bytes", int, 64 << 20, LEVEL_ADVANCED,
+             "sub-allocation of osd_tier_hbm_bytes reserved for the "
+             "pipeline's content-addressed H2D stripe cache "
+             "(ops/pipeline.py; replaces the old hard-coded 4-entry "
+             "LRU).  The tier yields to this working set, never the "
+             "other way around",
+             see_also=("osd_tier_hbm_bytes", "no_h2d_cache")),
+        _opt("osd_tier_promote_temp", float, 0.25, LEVEL_ADVANCED,
+             "hit-set temperature at or above which the tier agent "
+             "promotes an object's shards into device memory (and "
+             "writeback-mode writes refresh the resident copy, "
+             "promote-on-write)"),
+        _opt("osd_tier_promote_max_per_tick", int, 8, LEVEL_ADVANCED,
+             "max objects promoted per tier-agent tick; the whole set "
+             "rides one batched gather + device transfer",
+             see_also=("osd_tier_promote_temp",)),
         _opt("osd_msgr_cork", bool, True, LEVEL_ADVANCED,
              "coalesce outgoing messenger frames per connection into "
              "scatter-gather bursts (one writelines + one drain per "

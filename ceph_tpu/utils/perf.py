@@ -33,6 +33,14 @@ class PerfCounters:
         with self._lock:
             self._counters[key] += amount
 
+    def hwm(self, key: str, value: int) -> None:
+        """High-water-mark counter: keeps the max ever reported (the
+        reference's PERFCOUNTER_U64 gauges used as peaks, e.g. resident
+        cache-tier bytes)."""
+        with self._lock:
+            if value > self._counters[key]:
+                self._counters[key] = value
+
     def tinc(self, key: str, seconds: float) -> None:
         """Time/average counter (latency style)."""
         with self._lock:

@@ -1,0 +1,32 @@
+"""jax-device-bytes-unaccounted fixture (presented under a pseudo
+ceph_tpu/ path): retaining a jax.device_put result on an attribute or
+container bypasses the osd_tier_hbm_bytes ledger; transient local use
+and retention inside the accounting seams are fine."""
+
+import jax
+import numpy as np
+
+
+class UnaccountedCache:
+    def __init__(self):
+        self._resident = {}
+        self._pinned = None
+
+    def retain_attr(self, arr):
+        self._pinned = jax.device_put(arr)  # LINT: jax-device-bytes-unaccounted
+
+    def retain_subscript(self, key, arr):
+        self._resident[key] = jax.device_put(arr)  # LINT: jax-device-bytes-unaccounted
+
+    def retain_via_local_name(self, key, arr):
+        d = jax.device_put(arr)
+        self._resident[key] = d  # LINT: jax-device-bytes-unaccounted
+
+    def transient_ok(self, arr):
+        # local-only binding: the array dies with the call frame
+        d = jax.device_put(arr)
+        return np.asarray(d)
+
+    def host_retention_ok(self, key, arr):
+        # retaining HOST bytes is not device residency
+        self._resident[key] = np.ascontiguousarray(arr)

@@ -39,6 +39,7 @@ FIXTURES = {
     "jax_host_sync.py": "ceph_tpu/ops/_fixture_host_sync.py",
     "jax_gf_dtype_drift.py": "ceph_tpu/matrices/_fixture_dtype.py",
     "jax_device_iteration.py": None,
+    "jax_device_bytes_unaccounted.py": "ceph_tpu/osd/_fixture_device_bytes.py",
     "ceph_config_undeclared.py": None,
     "ceph_encoding_version_pair.py": None,
     "suppressions.py": None,

@@ -38,7 +38,7 @@ def parse_args(argv):
                    help="erasure code plugin name")
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
-                            "cluster-path"])
+                            "cluster-path", "tier-path"])
     p.add_argument("-e", "--erasures", type=int, default=1,
                    help="number of erasures when decoding")
     p.add_argument("--erased", type=int, action="append", default=[],
@@ -197,6 +197,32 @@ def main(argv=None) -> int:
             f"stage {result['wire_write_speedup']}x "
             f"({wc['frames_per_burst']} frames/burst, "
             f"{wc['ack_piggyback_ratio']} acks piggybacked)",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "tier-path":
+        # Device cache-tier stage (round 9): hot tier-resident read (one
+        # D2H + transpose from the shard-major device block) vs the cold
+        # miss path (frombuffer ingest + degraded decode), bit-exactness
+        # gated before timing.  Prints one JSON line (the shape bench.py
+        # records as tier_path_host_*).
+        import json
+
+        from ceph_tpu.tier.tier_bench import run_tier_path_bench
+
+        result = run_tier_path_bench(
+            ec, n_objects=args.objects, obj_bytes=args.size,
+            iters=max(1, args.iterations), erasures=args.erasures,
+        )
+        print(json.dumps(result))
+        print(
+            f"tier-path k={result['k']} m={result['m']} "
+            f"{args.objects}x{args.size}B: hot read "
+            f"{result['hot_read_GiBs']:.4f} GiB/s vs cold decode "
+            f"{result['cold_read_GiBs']:.4f} GiB/s "
+            f"({result['read_speedup']}x), "
+            f"{result['resident_bytes']} bytes resident",
             file=sys.stderr,
         )
         return 0

@@ -15,6 +15,7 @@ Usage:
   rados_cli.py --dir RUN stat <obj>
   rados_cli.py --dir RUN ls
   rados_cli.py --dir RUN df
+  rados_cli.py --dir RUN tier status
   rados_cli.py --dir RUN setomapval <obj> <key> <value>
   rados_cli.py --dir RUN listomapvals <obj>
 """
@@ -74,6 +75,23 @@ async def _run(args) -> int:
             print(f"{st['name']}\t{st['objects']} stored objects")
             total += st["objects"]
         print(f"total\t{total}")
+        return 0
+    if args.cmd == "tier" or args.cmd == "tier-status":
+        # device cache-tier residency per daemon (admin-socket backed,
+        # like ls/df: works against a live cluster without a client)
+        found = False
+        for sock in _asoks(args.dir):
+            st = await admin_command(sock, "tier status")
+            if "error" in st:
+                continue
+            found = True
+            print(f"{st['name']}\t{st['resident_bytes']}/{st['budget']} "
+                  f"bytes resident\t{st['entries']} objects "
+                  f"({st['dirty']} dirty)\thit {st['hit']} "
+                  f"miss {st['miss']}\tmodes {json.dumps(st['modes'])}")
+        if not found:
+            print("no daemons with a tier admin socket", file=sys.stderr)
+            return 1
         return 0
 
     c = await _connect(args.dir)
