@@ -521,6 +521,23 @@ def main() -> int:
 
     tp_host = _secondary(_tier_path_host)
 
+    def _failover_path_host():
+        """Round-10 robustness metric: client-visible failover cost on
+        the in-process cluster -- steady op latency vs time-to-first-
+        success after a primary is killed in the apply/reply window
+        (probe discovery + jittered backoff + resend answered from the
+        PG-log reqid dups) and the p99 op tail during kill/revive
+        churn.  Correctness-gated: the stage raises unless every killed
+        op completed exactly once with dup hits observed
+        (ceph_tpu/osd/failover_bench.py)."""
+        from ceph_tpu.osd.failover_bench import run_failover_bench
+
+        return run_failover_bench(
+            n_osds=8, n_objects=16, obj_bytes=16 << 10, kills=5
+        )
+
+    fo_host = _secondary(_failover_path_host)
+
     def _lint_findings_total():
         """Static-health trend metric: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json).
@@ -591,6 +608,13 @@ def main() -> int:
         "tier_path_host_read_speedup": (
             tp_host["read_speedup"] if tp_host else None),
         "tier_path_host": tp_host,
+        "failover_path_host_ttfs_mean_ms": (
+            fo_host["ttfs_mean_ms"] if fo_host else None),
+        "failover_path_host_thrash_p99_ms": (
+            fo_host["thrash_p99_ms"] if fo_host else None),
+        "failover_path_host_steady_p99_ms": (
+            fo_host["steady_p99_ms"] if fo_host else None),
+        "failover_path_host": fo_host,
         "lint_findings_total": lint_total,
         "platform": jax.devices()[0].platform + (
             "-fallback"
@@ -614,7 +638,10 @@ def main() -> int:
         f"cluster-path corked {cp_host['write_speedup'] if cp_host else '?'}"
         f"x full-stack / {cp_host['wire_write_speedup'] if cp_host else '?'}"
         f"x wire vs per-message, tier-path hot read "
-        f"{tp_host['read_speedup'] if tp_host else '?'}x cold decode on "
+        f"{tp_host['read_speedup'] if tp_host else '?'}x cold decode, "
+        f"failover ttfs "
+        f"{fo_host['ttfs_mean_ms'] if fo_host else '?'}ms / thrash p99 "
+        f"{fo_host['thrash_p99_ms'] if fo_host else '?'}ms on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

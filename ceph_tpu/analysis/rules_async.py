@@ -204,6 +204,103 @@ def check_drain_per_item(ctx: FileContext) -> Iterator[Finding]:
         # one finding per drain site is enough
 
 
+#: awaited call targets that pace a retry loop (sleep/backoff, event or
+#: queue parks, deadline-capped waits) -- any one of them in the loop
+#: body means the loop is not a hot blind-retry spin
+_PACING_ATTRS = {"sleep", "wait", "wait_for", "get", "gather", "acquire"}
+
+
+def _names_deadline(node: ast.AST) -> bool:
+    """A comparison/name that consults a deadline: any identifier
+    containing 'deadline'/'timeout', or a ``.time()`` call (loop clock
+    reads exist only to be compared against a budget)."""
+    for inner in ast.walk(node):
+        if isinstance(inner, ast.Name) and (
+            "deadline" in inner.id.lower() or "timeout" in inner.id.lower()
+        ):
+            return True
+        if isinstance(inner, ast.Attribute) and (
+            "deadline" in inner.attr.lower() or "timeout" in inner.attr.lower()
+        ):
+            return True
+        if isinstance(inner, ast.Call) and call_attr(inner) == "time":
+            return True
+    return False
+
+
+@rule(
+    "async-unbounded-retry", "async", SEV_WARNING,
+    "`while True` retry loop (an except handler that `continue`s) with "
+    "no deadline check and no awaited backoff/park in the body: on a "
+    "persistent failure it spins the event loop forever and hammers "
+    "whatever it is retrying against -- the failure mode the Objecter's "
+    "deadline-aware jittered backoff exists to prevent",
+)
+def check_unbounded_retry(ctx: FileContext) -> Iterator[Finding]:
+    from ceph_tpu.analysis.core import enclosing_functions
+
+    parents = ctx.parent_map()
+
+    def innermost_loop(node):
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return None
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                return cur
+        return None
+
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.While):
+            continue
+        test = node.test
+        if not (isinstance(test, ast.Constant) and test.value in (True, 1)):
+            continue
+        if not in_async_context(ctx, node):
+            continue
+        holder = enclosing_functions(ctx, node)
+        # retry signature: an except handler in THIS loop whose body
+        # continues the loop (error -> try again)
+        retries = False
+        for t in ast.walk(node):
+            if not isinstance(t, ast.Try) or innermost_loop(t) is not node:
+                continue
+            for handler in t.handlers:
+                for inner in ast.walk(handler):
+                    if isinstance(inner, ast.Continue) and \
+                            innermost_loop(inner) is node and \
+                            enclosing_functions(ctx, inner) == holder:
+                        retries = True
+        if not retries:
+            continue
+        # pacing / deadline evidence anywhere in the loop body (same
+        # function): an awaited sleep/park, or a deadline consult
+        paced = False
+        for inner in ast.walk(node):
+            if enclosing_functions(ctx, inner) != holder:
+                continue
+            if isinstance(inner, ast.Await) and \
+                    isinstance(inner.value, ast.Call):
+                tail = call_attr(inner.value) or \
+                    call_name(inner.value).rsplit(".", 1)[-1]
+                if tail in _PACING_ATTRS:
+                    paced = True
+                    break
+            if isinstance(inner, (ast.If, ast.Compare)) and \
+                    _names_deadline(inner):
+                paced = True
+                break
+        if not paced:
+            yield ctx.finding(
+                "async-unbounded-retry", node,
+                "retry loop without a deadline or backoff: add a "
+                "deadline check (fail the op when the budget is spent) "
+                "and an awaited, ideally jittered-exponential, delay "
+                "between attempts",
+            )
+
+
 def _mentions_lock(node: ast.expr) -> bool:
     """Context-manager expression names a lock: `lock`, `self._lock`,
     `self._conn_lock(node)` ...  The lockdep convention (utils/lockdep)

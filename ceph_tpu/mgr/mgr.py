@@ -161,6 +161,23 @@ def prometheus_text(state: dict) -> str:
             lines.append(
                 f'ceph_osd_tier_hbm_budget_bytes{{ceph_daemon="{name}"}} '
                 f"{tier['budget']}")
+    # exactly-once / client-retry health (docs/resilience.md): replayed
+    # ops answered from the PG log, client resends, and PG backoffs --
+    # the triple that separates "failover is invisible" from "clients
+    # are lying or spinning"
+    lines += ["# HELP ceph_osd_dup_op_hit replayed client ops answered "
+              "from the PG log dup entries",
+              "# TYPE ceph_osd_dup_op_hit counter"]
+    for name, s in sorted(state["osd_stats"].items()):
+        lines.append(f'ceph_osd_dup_op_hit{{ceph_daemon="{name}"}} '
+                     f"{s['perf'].get('dup_op_hit', 0)}")
+    client_perf = state["pools"].get("client_perf", {})
+    for counter in ("op_resend", "backoff_received"):
+        lines += [f"# HELP ceph_client_{counter} client-side {counter} "
+                  "events (Objecter retry/backoff path)",
+                  f"# TYPE ceph_client_{counter} counter",
+                  f"ceph_client_{counter} "
+                  f"{client_perf.get(counter, 0)}"]
     lines += ["# HELP ceph_pool_objects logical objects in the pool",
               "# TYPE ceph_pool_objects gauge",
               f"ceph_pool_objects {state['pools']['num_objects']}",

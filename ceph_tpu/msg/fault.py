@@ -36,6 +36,11 @@ class FaultInjector:
         #: frames have been written (None = disarmed)
         self._conn_kill_countdown: Optional[int] = None
         self.conn_kills = 0
+        #: one-shot primary kill in the dup-detection window: the OSD
+        #: daemon consults this after a client op APPLIES but before the
+        #: reply frame is sent (None = disarmed, "*" = any op kind)
+        self._kill_after_apply: Optional[str] = None
+        self.apply_kills = 0
 
     @classmethod
     def from_config(cls) -> "FaultInjector":
@@ -92,6 +97,27 @@ class FaultInjector:
         if self.delay_probability and \
                 self._rng.random() < self.delay_probability:
             await asyncio.sleep(self._rng.random() * self.max_delay)
+
+    # -- apply/reply-window injection (dup-detection manufacture) ----------
+
+    def schedule_kill_after_apply(self, kind: Optional[str] = None) -> None:
+        """Arm a one-shot primary kill in the exactly-once window: the
+        next client op (of ``kind``, or any kind when None) executes and
+        APPLIES fully, then its primary OSD is marked down BEFORE the
+        reply frame goes out -- the deterministic reproducer for reqid
+        dup detection (the client must resend and receive the ORIGINAL
+        result from the PG log, never a second application)."""
+        self._kill_after_apply = kind if kind is not None else "*"
+
+    def kill_after_apply_fire(self, kind: str) -> bool:
+        """Consulted by the OSD between apply and reply; True exactly
+        once when armed for ``kind`` (firing disarms)."""
+        armed = self._kill_after_apply
+        if armed is None or (armed != "*" and armed != kind):
+            return False
+        self._kill_after_apply = None
+        self.apply_kills += 1
+        return True
 
     # -- connection-level injection (torn-burst manufacture) ---------------
 

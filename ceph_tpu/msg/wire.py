@@ -86,6 +86,8 @@ def message_encoder(msg: object) -> Encoder:
         enc.string(msg.op_class)
         enc.value(msg.rollback)
         enc.value(msg.prev_version)
+        enc.value(tuple(msg.reqid) if isinstance(
+            msg.reqid, (tuple, list)) else msg.reqid)
     elif isinstance(msg, ECSubWriteReply):
         enc.u8(_MSG_EC_SUB_WRITE_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -136,6 +138,8 @@ def decode_message(data: bytes) -> object:
             at_version=at_version, log_entries=entries,
             op_class=dec.string(), rollback=dec.value(),
             prev_version=dec.value(),
+            # trailing-field compat: pre-reqid senders end here
+            reqid=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_WRITE_REPLY:
         return ECSubWriteReply(

@@ -14,7 +14,11 @@ Reference: src/osd/ECBackend.{h,cc} reduced to the EC essentials:
   which the primary treats as a missing shard;
 * recovery reconstructs lost shards from the minimum available set and
   pushes them to the replacement OSD (continue_recovery_op,
-  ECBackend.cc:535-700).
+  ECBackend.cc:535-700);
+* client-class sub-writes carry the originating op's reqid (stamped by
+  the shared ``PG._fanout_commit``), so every applying shard records a
+  PG-log dup entry with the mutation itself -- the exactly-once replay
+  guard across primary failover (docs/resilience.md).
 
 Shard objects are stored as "<oid>@<shard>" in each OSD's store with the
 HashInfo + logical size as xattrs.

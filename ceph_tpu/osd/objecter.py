@@ -7,18 +7,32 @@ retries/redirects when the map changes or the primary dies.  The primary
 OSD hosts the EC engine (``OSDShard.host_pool`` -> ``ECBackend``) and
 fans out sub-ops to the acting set; this class never touches chunks.
 
-Failover: while waiting for a reply the Objecter probes the primary; an
-unreachable primary is marked down and the op is resent to the next up
-shard of the acting set (the reference's analogue: a new osdmap epoch
-promotes a new primary and the Objecter re-targets).  WriteConflict
-refusals -- possible only transiently around a failover, when an engine
-with a cold version view serves its first write -- are retried once (the
-refusal teaches the engine the winning version).
+Failover: while waiting for a reply the Objecter probes the primary
+(``client_probe_retries`` attempts of ``client_probe_grace`` each); an
+unreachable primary is marked down and the op is resent -- same reqid,
+exponential backoff with jitter between attempts -- to the next up shard
+of the acting set (the reference's analogue: a new osdmap epoch promotes
+a new primary and the Objecter re-targets).  Every op carries an
+``osd_reqid_t``-style reqid ``(client, incarnation, tid)``; the OSDs
+persist applied ops' reqids + results as PG-log dup entries, so a resend
+that races a completed-but-unacknowledged op is answered with the
+ORIGINAL result instead of re-executing -- exactly-once across primary
+failover, for non-idempotent ops (omap_cas, exec, snap_rollback)
+included.  A shard whose PG is peering answers ``backoff`` instead of
+queueing; the op parks until that OSD's ``backoff_release`` (or the op
+deadline) and then resends (the RADOS PG backoff protocol).
+WriteConflict refusals -- possible only transiently around a failover,
+when an engine with a cold version view serves its first write -- are
+retried once under a FRESH reqid (the refusal teaches the engine the
+winning version; the losing attempt's dups must not answer the retry).
+See docs/resilience.md.
 """
 
 from __future__ import annotations
 
 import asyncio
+import itertools
+import random
 from typing import Dict, List, Optional
 
 from ceph_tpu.osd.ecbackend import ObjectIncomplete
@@ -34,18 +48,11 @@ _EXCEPTIONS = {
     "PermissionError": PermissionError,  # OSDCap denial (-EACCES)
 }
 
-#: op kinds that must NOT be silently resent after a primary died with the
-#: op possibly executed: a CAS (or a cls method wrapping one) that applied
-#: on the dead primary would report a false failure when replayed against
-#: the new authority.  The reference dedups via reqids persisted in the pg
-#: log; until an equivalent exists these surface an indeterminate-outcome
-#: error instead of lying (librados analogue: ETIMEDOUT, caller re-checks).
-_NON_IDEMPOTENT = frozenset({"omap_cas", "exec", "snap_rollback"})
-
-
-class OpIndeterminate(IOError):
-    """The primary died after the op was sent; it may or may not have
-    executed.  The caller must re-check state before retrying."""
+#: per-process Objecter incarnation source: the reqid's middle field.
+#: Two Objecters sharing a name (client restart, parallel harnesses)
+#: must never mint colliding reqids -- the incarnation tie-breaks, the
+#: role of the client's global_id + inc in the reference osd_reqid_t.
+_INCARNATIONS = itertools.count(1)
 
 
 def deliver_notify_event(messenger, name: str, callbacks: Dict, src: str,
@@ -107,7 +114,17 @@ class Objecter:
         self.oid_prefix = oid_prefix
         self.perf = PerfCounters(name)
         self._tid = 0
+        #: reqid incarnation (osd_reqid_t role): (name, inc, tid)
+        #: identifies each logical op across any number of resends
+        self.incarnation = next(_INCARNATIONS)
         self._pending: Dict[int, asyncio.Future] = {}
+        #: tids whose primary this client demoted on failed probes; a
+        #: late reply arriving for one proves the demotion false
+        #: (observability: the false_demotion perf counter)
+        self._demoted: set = set()
+        #: per-OSD backoff gates: cleared when that OSD backs an op off,
+        #: set again by its backoff_release (ops park on the event)
+        self._backoff_gates: Dict[str, asyncio.Event] = {}
         #: oid -> callback for watch/notify events (events are sent by the
         #: watch authority OSD straight to this client)
         self._watch_callbacks: Dict[str, object] = {}
@@ -157,6 +174,30 @@ class Objecter:
             fut = self._pending.get(msg.get("tid"))
             if fut is not None and not fut.done():
                 fut.set_result(msg)
+            elif msg.get("tid") in self._demoted:
+                # the "dead" primary answered after all: the probe-driven
+                # demotion was false (host load, not death) -- count it
+                # so the grace/retry knobs can be tuned from telemetry
+                self._demoted.discard(msg.get("tid"))
+                self.perf.inc("false_demotion")
+            return
+        if op == "backoff":
+            # RADOS PG backoff: the PG is peering; park the op until the
+            # OSD's release.  clear-before-resolve ordering + per-conn
+            # FIFO delivery make the later release visible even if it
+            # is processed before the op task starts waiting.
+            gate = self._backoff_gates.setdefault(src, asyncio.Event())
+            gate.clear()
+            self.perf.inc("backoff_received")
+            fut = self._pending.get(msg.get("tid"))
+            if fut is not None and not fut.done():
+                fut.set_result(dict(msg, _backoff_from=src))
+            return
+        if op == "backoff_release":
+            gate = self._backoff_gates.get(src)
+            if gate is not None:
+                gate.set()
+            self.perf.inc("backoff_release_received")
             return
         if op == "notify_event":
             deliver_notify_event(
@@ -168,48 +209,99 @@ class Objecter:
 
     # -- op submission with primary failover -------------------------------
 
-    async def _probe(self, entity: str) -> bool:
+    async def _probe(self, entity: str, timeout: float = 1.0) -> bool:
         probe = getattr(self.messenger, "probe", None)
         if probe is not None:
             try:
-                return await probe(entity, timeout=1.0)
+                return await probe(entity, timeout=timeout)
             except TypeError:
                 return await probe(entity)
         return not self.messenger.is_down(entity)
 
+    def _new_reqid(self) -> tuple:
+        """Mint an osd_reqid_t: (client name, incarnation, tid).  One
+        per LOGICAL op -- failover resends reuse it, which is what lets
+        the OSDs' PG-log dup entries recognize the replay."""
+        self._tid += 1
+        return (self.name, self.incarnation, self._tid)
+
+    async def _backoff_wait(self, osd: str, deadline: float) -> None:
+        """Park until ``osd`` releases its PG backoff (or the deadline):
+        the op resends the moment the PG goes active instead of polling
+        probe slices against a peering primary."""
+        gate = self._backoff_gates.setdefault(osd, asyncio.Event())
+        remain = deadline - asyncio.get_event_loop().time()
+        if remain <= 0:
+            return
+        try:
+            # deadline-capped: a lost release (the OSD died while we
+            # were parked) degrades to the normal failover path
+            await asyncio.wait_for(gate.wait(), timeout=remain)
+        except asyncio.TimeoutError:
+            pass
+
     async def _submit(self, kind: str, oid: str, timeout: float = None,
                       **fields):
-        """Send one op to the primary; fail over to the next up shard if
-        the primary becomes unreachable before replying."""
+        """Send one op to the primary; fail over -- with exponential
+        backoff plus jitter, under the op deadline -- to the next up
+        shard if the primary becomes unreachable before replying.  Safe
+        for every op kind: resends carry the op's reqid and a primary
+        that already applied it answers from its PG log dups instead of
+        re-executing."""
+        from ceph_tpu.utils.config import get_config
+
         oid = self.oid_prefix + oid  # enter the pool's namespace
-        deadline = asyncio.get_event_loop().time() + (
+        loop = asyncio.get_event_loop()
+        deadline = loop.time() + (
             timeout if timeout is not None else self.op_timeout
         )
+        cfg = get_config()
+        backoff_base = float(cfg.get_val("client_backoff_base"))
+        backoff_max = float(cfg.get_val("client_backoff_max"))
         conflict_retries = 1
+        reqid = self._new_reqid()
+        resends = 0
         while True:
             self._tid += 1
             tid = self._tid
-            fut = asyncio.get_event_loop().create_future()
+            fut = loop.create_future()
             self._pending[tid] = fut
             msg = dict(fields, op="client_op", tid=tid, kind=kind, oid=oid,
-                       pool=self.pool)
+                       pool=self.pool, reqid=list(reqid))
             try:
                 primary = self._primary_abs(oid)
                 await self.messenger.send_message(self.name, primary, msg)
-                reply = await self._await_reply(fut, primary, deadline)
+                reply = await self._await_reply(fut, tid, primary, deadline)
             finally:
                 self._pending.pop(tid, None)
             if reply is None:
                 # primary unreachable: the messenger marked it down, so
-                # primary_of() now promotes the next up shard
+                # primary_of() now promotes the next up shard.  Resend
+                # the SAME reqid after a jittered exponential backoff --
+                # an instant blind retry would hammer a cluster that is
+                # mid-role-handoff (and every client would do it in
+                # lockstep), while an unbounded wait would blow the op
+                # deadline.
                 self.perf.inc("primary_failover")
-                if kind in _NON_IDEMPOTENT:
-                    raise OpIndeterminate(
-                        f"{kind} {oid}: primary {primary} died with the op "
-                        "in flight; it may have executed -- re-check state"
-                    )
-                if asyncio.get_event_loop().time() >= deadline:
+                remain = deadline - loop.time()
+                if remain <= 0:
                     raise IOError(f"{kind} {oid}: op timed out")
+                delay = min(backoff_max, backoff_base * (2 ** resends))
+                delay *= 0.5 + random.random() * 0.5  # jitter
+                await asyncio.sleep(min(delay, max(0.0, remain - 0.001)))
+                resends += 1
+                self.perf.inc("op_resend")
+                continue
+            if reply.get("op") == "backoff":
+                # the PG is peering: park until its release, then resend
+                # (same reqid) -- no probe slices, no blind retries
+                await self._backoff_wait(
+                    reply.get("_backoff_from", primary), deadline
+                )
+                if loop.time() >= deadline:
+                    raise IOError(f"{kind} {oid}: op timed out in backoff")
+                resends += 1
+                self.perf.inc("op_resend")
                 continue
             if reply["ok"]:
                 self.perf.inc(kind)
@@ -217,16 +309,32 @@ class Objecter:
             etype = reply.get("etype", "IOError")
             if etype == "WriteConflict" and conflict_retries > 0:
                 # the engine learned the winning version from the refusal;
-                # one replay lands on top of it (see ECBackend.write)
+                # one replay lands on top of it (see ECBackend.write).
+                # FRESH reqid: this is a new execution by design -- the
+                # refused attempt's dup entries (shards that applied
+                # before the conflict surfaced) must not answer it.
                 conflict_retries -= 1
+                reqid = self._new_reqid()
                 self.perf.inc("write_conflict_retry")
                 continue
             exc = _EXCEPTIONS.get(etype, IOError)
             raise exc(reply.get("error", f"{kind} {oid} failed"))
 
-    async def _await_reply(self, fut, primary: str, deadline: float):
+    async def _await_reply(self, fut, tid: int, primary: str,
+                           deadline: float):
         """Wait for the reply in probe-sized slices; None when the primary
-        is found dead (caller fails over)."""
+        is found dead (caller fails over).  Probe cadence is config-driven
+        (client_probe_grace seconds per slice/probe, client_probe_retries
+        consecutive failures to demote): one missed connect under host
+        load must not demote a live primary -- the reference needs
+        several missed heartbeats before an osd is reported failed
+        (OSD.cc handle_osd_ping grace).  Demotions are remembered so a
+        late reply increments the false_demotion counter."""
+        from ceph_tpu.utils.config import get_config
+
+        cfg = get_config()
+        grace = float(cfg.get_val("client_probe_grace"))
+        retries = max(1, int(cfg.get_val("client_probe_retries")))
         loop = asyncio.get_event_loop()
         while True:
             remain = deadline - loop.time()
@@ -234,19 +342,23 @@ class Objecter:
                 return None
             try:
                 return await asyncio.wait_for(
-                    asyncio.shield(fut), timeout=min(1.0, remain)
+                    asyncio.shield(fut), timeout=min(grace, remain)
                 )
             except asyncio.TimeoutError:
                 if self.messenger.is_down(primary):
                     return None
-                if not await self._probe(primary):
-                    # re-probe before failing over: one missed connect
-                    # under host load must not demote a live primary
-                    # (the reference needs several missed heartbeats
-                    # before an osd is reported failed, OSD.cc
-                    # handle_osd_ping grace)
-                    if not await self._probe(primary):
-                        return None
+                for _ in range(retries):
+                    if await self._probe(primary, timeout=grace):
+                        break
+                else:
+                    # every probe failed: demote.  Remember the tid so a
+                    # reply that still arrives is counted as a false
+                    # demotion (bounded: stale tids evicted FIFO-ish)
+                    self._demoted.add(tid)
+                    while len(self._demoted) > 256:
+                        self._demoted.pop()
+                    self.perf.inc("probe_demotion")
+                    return None
 
     # -- I/O surface (librados IoCtx ops, one round trip each) -------------
 

@@ -32,6 +32,7 @@ floor) and ``sinfo`` (stripe algebra; identity for replicated pools).
 from __future__ import annotations
 
 import asyncio
+import contextvars
 from contextlib import asynccontextmanager
 from typing import Dict, List, Optional, Tuple
 
@@ -102,6 +103,29 @@ meta_vt = vt
 
 #: osd_client_op_priority / osd_recovery_op_priority defaults
 OP_PRIORITY = {"client": 63, "recovery": 10, "scrub": 5}
+
+#: client-op kinds whose OWN fan-out (sub-writes / meta applies) carries
+#: the op's reqid, so every applying replica records the dup entry in
+#: the same step as the mutation -- a zero-width dup-detection window.
+#: These are exactly the kinds whose client-visible result is None or
+#: rides the fan-out itself (omap_cas piggybacks its result on the
+#: replication meta_apply).  ``exec`` and ``snap_trim`` compose several
+#: internal mutations with a result known only at the end; their dups
+#: are recorded by an explicit awaited ``dup_record`` fan-out instead
+#: (see OSDShard._run_client_op_inner), so their internals stay
+#: reqid-free -- an internal sub-op's dup must never masquerade as the
+#: composite op's result.
+REQID_FANOUT_KINDS = frozenset({
+    "write", "write_range", "remove", "snap_rollback",
+    "omap_set", "omap_rm", "omap_clear", "omap_cas",
+})
+
+#: the in-flight client op's reqid, visible to the fan-out helpers of
+#: THIS task only (client ops run as separate tasks; contextvars keep
+#: concurrent ops' reqids apart without threading a parameter through
+#: every strategy signature)
+_OP_REQID: "contextvars.ContextVar[Optional[tuple]]" = \
+    contextvars.ContextVar("ceph_tpu_op_reqid", default=None)
 
 #: mclock_opclass-style defaults: (reservation, weight, limit) items/sec;
 #: clients get a floor and most of the weight, background work is capped
@@ -206,6 +230,15 @@ class PG:
         #: last log sequence processed per peer OSD; a peer whose head
         #: equals its watermark contributes zero peering traffic
         self._peer_seq: Dict[str, int] = {}
+        #: last reqid-dup sequence fetched per peer OSD (dup sequences
+        #: are per-OSD, so the watermark is too); peers whose dup head
+        #: matches contribute zero dup traffic
+        self._peer_dup_seq: Dict[str, int] = {}
+        #: the hosting OSD's PGLog (OSDShard.host_pool wires it): where
+        #: peering-fetched dup entries are merged so THIS OSD, once
+        #: promoted primary, answers replayed ops from the log.  None
+        #: for standalone engines (no daemon, no replay surface).
+        self._host_pglog = None
         #: objects known to need attention (writes that missed shards,
         #: recoveries pending on down OSDs) -- the pg_missing_t analogue
         self._dirty: set = set()
@@ -326,7 +359,8 @@ class PG:
                       "omap_cas_reply", "watch_reply", "notify_reply",
                       "pg_list_reply", "pg_log_info_reply",
                       "pg_log_entries_reply", "pg_rollback_reply",
-                      "obj_versions_reply"):
+                      "obj_versions_reply", "dup_record_reply",
+                      "pg_dups_reply"):
                 state = self._pending.get(msg.get("tid"))
                 if state is not None:
                     state["replies"][src] = msg
@@ -573,6 +607,16 @@ class PG:
         wait out the commit quorum -- the one fan-out/ack sequence every
         mutation shares, so commit accounting cannot drift between the
         pool strategies (the round-5 review's dedup finding)."""
+        # exactly-once: stamp the in-flight client op's reqid onto its
+        # own client-class sub-writes so every applying shard records
+        # the dup entry in the same step as the mutation (recovery and
+        # scrub pushes, and internal ops of composite kinds, stay bare)
+        rid = _OP_REQID.get()
+        if rid is not None:
+            for _dst, sub in subs:
+                if getattr(sub, "op_class", "client") == "client" and \
+                        getattr(sub, "reqid", None) is None:
+                    sub.reqid = rid
         done = asyncio.get_event_loop().create_future()
         self._pending[tid] = {
             "committed": set(),
@@ -919,6 +963,14 @@ class PG:
             "meta_apply", "omap_cas"
         ):
             payload = dict(payload, pool=self.pool_name)
+        # exactly-once: metadata-plane mutations carry the client op's
+        # reqid so every applying replica records the dup entry with the
+        # mutation itself (see REQID_FANOUT_KINDS)
+        rid = _OP_REQID.get()
+        if rid is not None and payload.get("op") in (
+            "meta_apply", "omap_cas"
+        ) and "reqid" not in payload:
+            payload = dict(payload, reqid=list(rid))
         tid = self._new_tid()
         done = asyncio.get_event_loop().create_future()
         self._pending[tid] = {
@@ -1045,9 +1097,15 @@ class PG:
             self._meta_versions[oid] = r["version"]
             others = [t for t in self._meta_targets(oid) if t != primary]
             if others:
+                # the CAS outcome rides the replication fan-out as a
+                # dup result: any replica that may be promoted primary
+                # can then answer a replayed CAS with the ORIGINAL
+                # (success, current) instead of re-comparing against
+                # post-apply state (which would report a false failure)
                 await self._meta_roundtrip(others, {
                     "op": "meta_apply", "oid": oid,
                     "version": r["version"], "omap": r["omap"],
+                    "dup_result": [r["success"], r["current"]],
                 })
         return r["success"], r["current"]
 
@@ -1543,6 +1601,11 @@ class PG:
             up_osds, {"op": "pg_log_info"}, timeout=3.0
         )
         self.perf.inc("peering_info_poll")
+        # reqid-dup exchange rides GetInfo (both the delta and backfill
+        # flows pass through here): fetch peers' dup entries above our
+        # per-peer watermark so a just-promoted primary answers replayed
+        # client ops with their original results (pg_log_dup_t exchange)
+        await self._sync_dups(infos)
         candidates = set(self._dirty)
         meta_candidates = set(self._dirty_meta)
         pre_heads: Dict[str, int] = {}
@@ -1625,6 +1688,48 @@ class PG:
             have, meta, set(replies), max_active,
             tracked=candidates, tracked_meta=meta_candidates,
         )
+
+    async def _sync_dups(self, infos: Dict[str, dict]) -> int:
+        """Fetch and merge peers' reqid-dup entries newer than our
+        per-peer watermarks into the hosting OSD's PG log (the peering
+        dup exchange; reference: pg_log_dup_t travels with the log in
+        GetLog, src/osd/PGLog.cc merge_log).  Returns entries merged."""
+        if self._host_pglog is None:
+            return 0
+        fetches = [
+            (osd_name, self._peer_dup_seq.get(osd_name, 0))
+            for osd_name, info in infos.items()
+            if osd_name != self.name
+            and int(info.get("dup_head", 0)) >
+            self._peer_dup_seq.get(osd_name, 0)
+        ]
+        if not fetches:
+            return 0
+        results = await asyncio.gather(*(
+            self._meta_roundtrip(
+                [osd_name], {"op": "pg_dups", "from_seq": last},
+                timeout=3.0,
+            )
+            for osd_name, last in fetches
+        ))
+        merged = 0
+        for (osd_name, last), r in zip(fetches, results):
+            rep = r.get(osd_name)
+            if rep is None:
+                continue  # peer died mid-pass; the next event retries
+            maxseq = last
+            for seq, reqid, result, d_oid, version in rep["dups"]:
+                self._host_pglog.merge_dup(
+                    tuple(reqid), result, d_oid,
+                    tuple(version) if version is not None else None,
+                )
+                maxseq = max(maxseq, seq)
+                merged += 1
+            self._peer_dup_seq[osd_name] = max(
+                maxseq, int(rep.get("head", 0)))
+        if merged:
+            self.perf.inc("dup_entries_merged", merged)
+        return merged
 
     async def _peering_backfill(self, up_osds, max_active,
                                 pre_heads: Dict[str, int]) -> int:
@@ -1860,6 +1965,19 @@ class PG:
         Reference: PrimaryLogPG::do_op (src/osd/PrimaryLogPG.cc:1844) --
         the primary OSD owns the PG and executes the op, fanning sub-ops
         to the acting set.  Returns the op's wire-encodable result."""
+        kind = msg["kind"]
+        reqid = msg.get("reqid")
+        if reqid is not None and kind in REQID_FANOUT_KINDS:
+            # visible to this op's own fan-outs only (task-scoped);
+            # composite kinds (exec/snap_trim) run reqid-free internals
+            token = _OP_REQID.set(tuple(reqid))
+            try:
+                return await self._client_op_inner(msg)
+            finally:
+                _OP_REQID.reset(token)
+        return await self._client_op_inner(msg)
+
+    async def _client_op_inner(self, msg: dict):
         kind = msg["kind"]
         oid = msg.get("oid", "")
         snap = msg.get("snap")

@@ -20,11 +20,23 @@ trim_to/roll_forward_to ECMsgTypes.h:33-35).  Two roles:
   restoring attrs -- no network push needed.  Overwrite-style entries
   (bytes below the prior size modified) are marked non-rollbackable and
   fall back to a recovery push from the authoritative shards.
+
+* **Reqid dup detection** (the pg_log_dup_t role, src/osd/osd_types.h):
+  every applied client op records its reqid ``(client, incarnation,
+  tid)`` and client-visible result as a dup entry.  A resent op whose
+  reqid is already recorded is answered with the original result
+  instead of re-executing -- the exactly-once guarantee across primary
+  failover.  Dups live OUTSIDE the entry list: ``trim()`` never drops
+  them (the reference keeps a separate dups list past log trim,
+  bounded by ``osd_pg_log_dups_tracked``); divergent-entry rollback
+  prunes the dups of the rolled-back versions so a torn write's replay
+  re-executes instead of reporting a success that was undone.
 """
 
 from __future__ import annotations
 
 import dataclasses
+from collections import OrderedDict
 from typing import Dict, List, Optional
 
 from ceph_tpu.osd.types import Transaction
@@ -46,16 +58,37 @@ class PGLogEntry:
     rollbackable: bool = True
 
 
-class PGLog:
-    """Ordered per-OSD log with head/tail, delta queries, trim, and
-    per-object rollback."""
+@dataclasses.dataclass
+class PGLogDup:
+    """One replayed-op detection record (pg_log_dup_t role): the reqid
+    that stamped a client op, the client-visible result it produced, and
+    the object/version it landed on (for rollback pruning)."""
 
-    def __init__(self, trim_target: int = 1000):
+    seq: int  # per-OSD monotonic dup sequence (peering delta exchange)
+    reqid: tuple  # (client name, incarnation, tid)
+    result: object = None  # wire-encodable client_reply result
+    oid: str = ""  # base object id the op mutated
+    version: Optional[tuple] = None  # version tuple the op stamped
+
+
+class PGLog:
+    """Ordered per-OSD log with head/tail, delta queries, trim,
+    per-object rollback, and the reqid dup registry."""
+
+    def __init__(self, trim_target: int = 1000,
+                 dups_tracked: Optional[int] = None):
         self.entries: List[PGLogEntry] = []
         #: newest sequence dropped by trim (entries <= tail_seq are gone)
         self.tail_seq = 0
         self._next_seq = 0
         self.trim_target = trim_target
+        #: reqid -> PGLogDup, insertion-ordered for bounded eviction;
+        #: NOT touched by trim() (see module docstring)
+        self.dups: "OrderedDict[tuple, PGLogDup]" = OrderedDict()
+        self._dup_seq = 0
+        #: None = read osd_pg_log_dups_tracked per insert (runtime
+        #: changes honored); an explicit bound pins it (tests)
+        self._dups_tracked = dups_tracked
 
     @property
     def head_seq(self) -> int:
@@ -74,6 +107,57 @@ class PGLog:
         self.entries.append(e)
         return e
 
+    # -- reqid dup registry (pg_log_dup_t role) ---------------------------
+
+    def _dup_bound(self) -> int:
+        if self._dups_tracked is not None:
+            return self._dups_tracked
+        from ceph_tpu.utils.config import get_config
+
+        return int(get_config().get_val("osd_pg_log_dups_tracked"))
+
+    def record_dup(self, reqid, result=None, *, oid: str = "",
+                   version: Optional[tuple] = None) -> PGLogDup:
+        """Remember that the op identified by ``reqid`` applied here.
+        Idempotent: a reqid seen twice keeps its first record, except a
+        None result is upgraded once the full client-visible result is
+        known (the sub-op fan-out records before the primary learns the
+        final result of e.g. an exec)."""
+        reqid = tuple(reqid)
+        ent = self.dups.get(reqid)
+        if ent is not None:
+            if ent.result is None and result is not None:
+                ent.result = result
+            return ent
+        self._dup_seq += 1
+        ent = PGLogDup(
+            seq=self._dup_seq, reqid=reqid, result=result, oid=oid,
+            version=tuple(version) if version is not None else None,
+        )
+        self.dups[reqid] = ent
+        bound = self._dup_bound()
+        while len(self.dups) > max(1, bound):
+            self.dups.popitem(last=False)  # oldest first
+        return ent
+
+    def lookup_dup(self, reqid) -> Optional[PGLogDup]:
+        return self.dups.get(tuple(reqid))
+
+    @property
+    def dup_head_seq(self) -> int:
+        return self._dup_seq
+
+    def dups_after(self, seq: int) -> List[PGLogDup]:
+        """Dup entries newer than ``seq`` (peering delta exchange; the
+        whole registry is bounded, so seq 0 fetches everything)."""
+        return [d for d in self.dups.values() if d.seq > seq]
+
+    def merge_dup(self, reqid, result, oid: str,
+                  version: Optional[tuple]) -> None:
+        """Adopt a peer's dup entry (peering exchange).  The entry gets
+        a LOCAL seq -- dup sequences are per-OSD, never forwarded."""
+        self.record_dup(reqid, result, oid=oid, version=version)
+
     # -- delta peering queries --------------------------------------------
 
     def entries_after(self, seq: int) -> List[PGLogEntry]:
@@ -89,7 +173,10 @@ class PGLog:
     def trim(self, to_seq: int) -> None:
         """Drop entries <= to_seq (durable everywhere); trimmed entries
         can no longer be rolled back or delta-served
-        (reference ECSubWrite.trim_to)."""
+        (reference ECSubWrite.trim_to).  Dup entries are NOT trimmed:
+        replay detection must outlive the log window (a client may
+        resend long after the write became durable everywhere), so dups
+        ride their own osd_pg_log_dups_tracked bound instead."""
         keep = [e for e in self.entries if e.seq > to_seq]
         if len(keep) != len(self.entries):
             self.tail_seq = max(self.tail_seq, to_seq)
@@ -140,4 +227,16 @@ class PGLog:
             store.queue_transaction(txn)
         doomed_ids = {id(e) for e in doomed}
         self.entries = [e for e in self.entries if id(e) not in doomed_ids]
+        # the rolled-back versions' dup records must go with them: a
+        # replay of an op peering just proved torn has to RE-EXECUTE,
+        # not report a success that was undone (the reference prunes
+        # divergent entries' dups the same way, src/osd/PGLog.cc)
+        base = oid.rpartition("@")[0] or oid
+        dead = [
+            r for r, d in self.dups.items()
+            if d.oid == base and d.version is not None
+            and tuple(d.version) > to_version
+        ]
+        for r in dead:
+            del self.dups[r]
         return True

@@ -20,7 +20,14 @@ a bare delete: with k = 1 a single stale surviving copy would otherwise
 win the authority election and resurrect the object (the EC strategy
 caps survivors below k via its m+1 delete quorum; a replicated pool has
 no such arithmetic, so the tombstone IS the guard -- the role the
-reference's logged delete + PG-log replay plays, src/osd/PGLog.cc)."""
+reference's logged delete + PG-log replay plays, src/osd/PGLog.cc).
+
+Exactly-once replay protection is inherited whole from the shared PG
+engine: full-copy sub-writes and tombstone fan-outs are stamped with the
+client op's reqid by ``PG._fanout_commit`` exactly like EC sub-writes,
+so every applying replica records the PG-log dup entry with the
+mutation and a replayed op after primary failover is answered from the
+log on whichever replica is promoted (docs/resilience.md)."""
 
 from __future__ import annotations
 

@@ -39,6 +39,21 @@ from ceph_tpu.osd.types import (
 from ceph_tpu.native.gf_native import crc32c
 from ceph_tpu.utils.perf import PerfCounters
 
+#: client-op kinds subject to reqid dup detection: every kind that
+#: mutates state (re-executing a replay would double-apply or return a
+#: post-apply answer).  Reads and watch bookkeeping stay dedup-free,
+#: like the reference (only logged ops get pg_log_dup_t entries).
+MUTATING_KINDS = frozenset({
+    "write", "write_range", "remove", "snap_rollback", "snap_trim",
+    "omap_set", "omap_rm", "omap_clear", "omap_cas", "exec",
+})
+
+#: composite kinds whose result is only known at completion: their dup
+#: entries are pushed to the acting set by an explicit awaited
+#: ``dup_record`` fan-out before the reply (everything else records
+#: dups on the fan-out that performs the mutation -- zero extra RTT)
+_RESULT_FANOUT_KINDS = frozenset({"exec", "snap_trim"})
+
 
 class OSDShard:
     """One OSD daemon holding one shard position per object it stores.
@@ -135,6 +150,15 @@ class OSDShard:
         #: ECBackend engine (the PrimaryLogPG role; reference
         #: src/osd/PGBackend.cc:533 build_pg_backend per PG)
         self.pools: Dict[str, "ECBackend"] = {}
+        #: per-pool PG activity state ("active" | "peering"): while a
+        #: pool is peering after a liveness event, client ops get an
+        #: explicit ``backoff`` reply instead of queueing (the RADOS PG
+        #: backoff protocol, src/osd/osd_types.h Backoff); engaged only
+        #: while the background tick loop runs (see request_peering)
+        self.pg_states: Dict[str, str] = {}
+        #: pool -> client entities holding a backoff, released with one
+        #: ``backoff_release`` each when the pool reactivates
+        self._backoffs: Dict[str, set] = {}
         #: shared tid space across hosted backends so a forwarded reply
         #: matches exactly one engine's pending op
         self._host_tid = 0
@@ -183,6 +207,11 @@ class OSDShard:
                 min_size=min_size,
             )
         backend.pool_name = pool
+        # exactly-once hookup: the engine's peering pass merges peers'
+        # reqid-dup entries into THIS daemon's PG log, so a promotion
+        # to primary answers replayed client ops from the log
+        backend._host_pglog = self.pglog
+        self.pg_states[pool] = "active"
         # cache-tier hookup: the engine serves tier hits / write-through
         # updates against this OSD's store, and feeds the hit sets the
         # agent ranks temperature from (late-bound lambdas: replacing
@@ -223,9 +252,20 @@ class OSDShard:
         """Wake the peering loop NOW (event-driven peering: OSDMap epoch
         change, OSD up/down -- the reference re-peers on every map change,
         src/osd/PG.cc peering state machine, instead of waiting out a
-        timer).  No-op until start_tick has run."""
+        timer).  No-op until start_tick has run.
+
+        While the loop is running, a liveness event also flips every
+        hosted pool to "peering": client ops arriving before the next
+        pass completes get an explicit backoff instead of racing the
+        role handoff (the RADOS PG backoff protocol; a replayed op must
+        not be served until the dup exchange and divergent-entry
+        rollback of peering have run)."""
         ev = getattr(self, "_peer_event", None)
         if ev is not None:
+            for pool in self.pools:
+                if self.pg_states.get(pool) != "peering":
+                    self.pg_states[pool] = "peering"
+                    self.perf.inc("pg_peering")
             ev.set()
 
     async def _tick_loop(self) -> None:
@@ -257,11 +297,25 @@ class OSDShard:
         if self.frozen or self.messenger.is_down(self.name):
             return 0
         total = 0
-        for backend in self.pools.values():
+        for pool, backend in list(self.pools.items()):
             total += await backend.peering_pass()
+            # the pass completed (dup exchange + authority election +
+            # recovery kickoff): the pool is active again -- release
+            # every client parked on a backoff so their ops resend the
+            # moment the PG is serviceable (RADOS backoff_release)
+            if self.pg_states.get(pool) == "peering":
+                await self._activate_pool(pool)
         total += await self.scrub_tick()
         total += await self.tier_tick()
         return total
+
+    async def _activate_pool(self, pool: str) -> None:
+        self.pg_states[pool] = "active"
+        for client in sorted(self._backoffs.pop(pool, ())):
+            await self.messenger.send_message(self.name, client, {
+                "op": "backoff_release", "pool": pool, "from": self.name,
+            })
+            self.perf.inc("backoff_release_sent")
 
     def _scrub_base_list(self):
         """Base-oid list for the scrub cursor; rebuilt only when the
@@ -403,6 +457,25 @@ class OSDShard:
         if isinstance(msg, dict) and "op" in msg:
             op = msg["op"]
             if op == "client_op":
+                # RADOS PG backoff: while the pool is peering after a
+                # liveness event, answer with an explicit backoff frame
+                # instead of queueing -- the client parks the op and
+                # resends on our backoff_release, rather than burning
+                # probe slices against a PG mid-role-handoff (reference
+                # src/osd/PrimaryLogPG.cc maybe_add_backoff).  The
+                # dispatch-throttle budget is never claimed here, so the
+                # transport's own release path returns it.
+                pool = msg.get("pool") or ""
+                if pool not in self.pools and self.pools:
+                    pool = next(iter(self.pools))
+                if self.pg_states.get(pool) == "peering":
+                    self._backoffs.setdefault(pool, set()).add(src)
+                    self.perf.inc("backoff_sent")
+                    await self.messenger.send_message(self.name, src, {
+                        "op": "backoff", "tid": msg.get("tid"),
+                        "pool": pool, "from": self.name,
+                    })
+                    return
                 # a client op lands in the QoS queue like any other work
                 # (reference: ms_fast_dispatch -> enqueue_op, OSD.cc:6439)
                 claim = msg.pop("_budget_claim", None)
@@ -484,7 +557,39 @@ class OSDShard:
                 "from": self.name,
                 "head_seq": self.pglog.head_seq,
                 "tail_seq": self.pglog.tail_seq,
+                "dup_head": self.pglog.dup_head_seq,
                 "nonempty": self._store_nonempty,
+            })
+            return
+        if op == "pg_dups":
+            # peering dup exchange: reqid dup entries above the
+            # requester's per-peer watermark (bounded by
+            # osd_pg_log_dups_tracked, so worst case is one small full
+            # sweep per new primary)
+            ents = [
+                (d.seq, list(d.reqid), d.result, d.oid,
+                 list(d.version) if d.version is not None else None)
+                for d in self.pglog.dups_after(int(msg.get("from_seq", 0)))
+            ]
+            self.perf.inc("pg_dups_serve")
+            await self.messenger.send_message(self.name, src, {
+                "op": "pg_dups_reply", "tid": msg["tid"],
+                "from": self.name, "dups": ents,
+                "head": self.pglog.dup_head_seq,
+            })
+            return
+        if op == "dup_record":
+            # a primary pushing a completed composite op's result
+            # (exec/snap_trim) into our log before it replies to the
+            # client -- the awaited leg of the exactly-once protocol
+            self.pglog.record_dup(
+                tuple(msg["reqid"]), msg.get("result"),
+                oid=msg.get("oid", ""),
+            )
+            self.perf.inc("dup_record")
+            await self.messenger.send_message(self.name, src, {
+                "op": "dup_record_reply", "tid": msg["tid"],
+                "from": self.name, "ok": True,
             })
             return
         if op == "pg_log_entries":
@@ -613,6 +718,19 @@ class OSDShard:
             # version-gap gate would either be rejected forever or stamp
             # a newer version over incomplete contents
             ver = msg["version"]
+            if msg.get("reqid") is not None:
+                # exactly-once: the originating client op's dup entry
+                # lands with the replicated state (recorded even when
+                # the version gate below refuses a stale re-apply --
+                # the op itself DID happen cluster-wide).  dup_result
+                # carries the client-visible outcome where one exists
+                # (a replicated CAS); plain omap writes answer None.
+                # version stays None: meta versions live on their own
+                # sequence and must never be pruned by a CHUNK-plane
+                # rollback of the same base oid.
+                self.pglog.record_dup(
+                    tuple(msg["reqid"]), msg.get("dup_result"), oid=oid,
+                )
             try:
                 cur = self.store.getattr(soid, "_meta_version") or 0
             except FileNotFoundError:
@@ -674,10 +792,33 @@ class OSDShard:
                 omap = self.store.omap_get(soid)
             except FileNotFoundError:
                 omap = {}
+            reqid = msg.get("reqid")
+            if reqid is not None:
+                hit = self.pglog.lookup_dup(reqid)
+                if hit is not None and hit.result is not None:
+                    # replayed CAS: the compare already ran and (maybe)
+                    # swapped -- re-comparing against post-apply state
+                    # would report a false failure.  Answer the original
+                    # outcome; the current full state rides along for
+                    # the caller's replication fan-out as usual.
+                    self.perf.inc("dup_op_hit")
+                    ver = (self.store.getattr(soid, "_meta_version") or 0
+                           if self.store.exists(soid) else 0)
+                    await self.messenger.send_message(self.name, src, {
+                        "op": "omap_cas_reply", "tid": msg["tid"],
+                        "success": hit.result[0],
+                        "current": hit.result[1],
+                        "version": ver, "omap": omap,
+                    })
+                    return
             cur = omap.get(key)
             success = cur == expect
             ver = (self.store.getattr(soid, "_meta_version") or 0
                    if self.store.exists(soid) else 0)
+            if reqid is not None:
+                # recorded with the compare itself (zero-width window);
+                # the result is final whether or not the swap applied
+                self.pglog.record_dup(reqid, [success, cur], oid=oid)
             if success:
                 ver += 1
                 if new is None:
@@ -887,11 +1028,27 @@ class OSDShard:
                     )
                     backend = None
                     self.perf.inc("cap_denied")
+            kind = msg.get("kind", "")
+            reqid = msg.get("reqid")
+            dedupable = reqid is not None and kind in MUTATING_KINDS
             if backend is None and "etype" not in reply:
                 reply.update(
                     ok=False, etype="IOError",
                     error=f"{self.name} hosts no pool",
                 )
+            elif backend is not None and dedupable and (
+                self.pglog.lookup_dup(reqid) is not None
+            ):
+                # replay of an op this PG already applied (the client
+                # resent after a failover): answer with the ORIGINAL
+                # result from the log instead of re-executing -- the
+                # exactly-once guarantee (reference:
+                # PrimaryLogPG::do_op eversion/reqid check via
+                # pg_log_dup_t, src/osd/osd_types.h)
+                reply.update(
+                    ok=True, result=self.pglog.lookup_dup(reqid).result
+                )
+                self.perf.inc("dup_op_hit")
             elif backend is not None:
                 try:
                     reply.update(ok=True, result=await backend.client_op(msg))
@@ -902,15 +1059,64 @@ class OSDShard:
                     reply.update(
                         ok=False, etype=type(e).__name__, error=str(e)
                     )
+                if dedupable and reply.get("ok"):
+                    await self._record_op_dup(
+                        backend, msg, reply.get("result"))
             op.mark_event("replied")
         op.finish()
         self.op_hist.inc(op.duration * 1e6,
                          len(msg.get("data") or b""))
         if msg.get("oid"):
             self.hitsets.record(msg["oid"])
+        fault = getattr(self.messenger, "fault", None)
+        if (
+            fault is not None and reply.get("ok") and dedupable
+            and fault.kill_after_apply_fire(kind)
+        ):
+            # injected dup-detection window: the op applied (and its
+            # dup entries reached the acting set above) but this
+            # primary dies before the reply frame -- the client must
+            # resend and be answered from a surviving PG log
+            self.messenger.mark_down(self.name)
+            return
         if self.frozen or self.messenger.is_down(self.name):
             return
         await self.messenger.send_message(self.name, src, reply)
+
+    async def _record_op_dup(self, backend, msg: dict, result) -> None:
+        """Persist a completed client op's reqid + result as a PG-log
+        dup entry on this primary, and -- for composite kinds whose
+        result only exists at completion (exec, snap_trim) -- push it to
+        the rest of the acting set with an AWAITED ``dup_record``
+        fan-out before the client reply can go out.  Single-fan-out
+        kinds already recorded their dups on the mutating sub-ops
+        themselves (see pg.REQID_FANOUT_KINDS), so they pay no extra
+        round trip here."""
+        reqid = msg.get("reqid")
+        oid = msg.get("oid", "")
+        # None-result upgrade: the fan-out-recorded entry learns the
+        # final client-visible result (exec's (ret, out), snap_trim's
+        # dropped-clone count, omap_cas's (success, current))
+        self.pglog.record_dup(reqid, result, oid=oid)
+        if msg.get("kind") not in _RESULT_FANOUT_KINDS:
+            return
+        try:
+            acting = backend.acting_set(oid)
+        except Exception:  # noqa: BLE001 -- placement failure: the
+            # local record above still covers the common replay path
+            return
+        targets = [
+            f"osd.{acting[s]}"
+            for s in range(backend.km)
+            if backend._shard_up(acting, s)
+            and f"osd.{acting[s]}" != self.name
+        ]
+        if not targets:
+            return
+        await backend._meta_roundtrip(targets, {
+            "op": "dup_record", "reqid": list(reqid),
+            "result": result, "oid": oid,
+        }, timeout=3.0)
 
     async def handle_sub_write(self, src: str, msg: ECSubWrite) -> None:
         """reference ECBackend::handle_sub_write (:922): log the operation,
@@ -1005,6 +1211,15 @@ class OSDShard:
             existed=existed, prior_size=prior,
             prior_attrs=prior_attrs or None, rollbackable=rollbackable,
         )
+        if msg.reqid is not None and msg.op_class == "client":
+            # exactly-once: the dup entry lands in the SAME step as the
+            # mutation, so there is no window in which this shard holds
+            # the write but could not detect its replay (the reference
+            # writes pg_log_dup_t with the log entry).  Result None is
+            # exact for every reqid-carrying fan-out kind; composite
+            # ops upgrade it via dup_record (see _record_op_dup).
+            self.pglog.record_dup(msg.reqid, None, oid=msg.oid,
+                                  version=new_vt)
         self.pglog.maybe_trim()
         self.store.queue_transaction(msg.transaction)
         self.perf.inc("sub_write")

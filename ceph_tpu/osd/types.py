@@ -114,6 +114,12 @@ class ECSubWrite:
     #: missing-set role, reference src/osd/PG.h pg_missing_t).  None for
     #: full-rewrite transactions, which are safe on any base.
     prev_version: object = None
+    #: originating client op's reqid ``(client, incarnation, tid)`` for
+    #: client-class sub-ops (the osd_reqid_t role): the applying shard
+    #: records a PG-log dup entry so a replayed op after primary
+    #: failover is answered from the log instead of re-executed.  None
+    #: for recovery/scrub pushes and legacy senders.
+    reqid: object = None
 
 
 @dataclasses.dataclass

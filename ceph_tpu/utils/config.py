@@ -57,6 +57,28 @@ OPTIONS: Dict[str, Option] = {
              "ops only, recovery/scrub stay per-call)"),
         _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
              "max bytes per recovery window"),
+        _opt("osd_pg_log_dups_tracked", int, 3000, LEVEL_ADVANCED,
+             "reqid dup entries retained per OSD PG log for client-op "
+             "replay detection; kept past trim() like the reference's "
+             "pg_log_dup_t list (src/osd/osd_types.h), evicted oldest "
+             "first past this bound"),
+        _opt("client_probe_retries", int, 2, LEVEL_ADVANCED,
+             "consecutive failed probes of an unresponsive primary "
+             "before the Objecter demotes it and fails the op over "
+             "(the osd_heartbeat_grace role on the client side; one "
+             "missed connect under host load must not demote a live "
+             "primary)"),
+        _opt("client_probe_grace", float, 1.0, LEVEL_ADVANCED,
+             "seconds per Objecter reply-wait slice and per probe "
+             "attempt while an op is in flight",
+             see_also=("client_probe_retries",)),
+        _opt("client_backoff_base", float, 0.05, LEVEL_ADVANCED,
+             "initial delay before an Objecter resend after a primary "
+             "failover; doubles per attempt (with jitter) up to "
+             "client_backoff_max, always capped by the op deadline"),
+        _opt("client_backoff_max", float, 2.0, LEVEL_ADVANCED,
+             "ceiling on the Objecter's exponential resend backoff",
+             see_also=("client_backoff_base",)),
         _opt("osd_recovery_max_active", int, 3, LEVEL_ADVANCED,
              "max concurrent object recoveries per OSD"),
         _opt("osd_tick_interval", float, 5.0, LEVEL_ADVANCED,

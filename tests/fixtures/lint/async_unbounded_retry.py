@@ -1,0 +1,68 @@
+# cephlint fixture: async-unbounded-retry
+# A `while True` retry loop (an except handler that continues the loop)
+# must carry a deadline check or an awaited backoff; blind spins are the
+# client-side failure mode the Objecter's jittered backoff prevents.
+import asyncio
+
+
+async def fetch(conn):
+    return await conn.read()
+
+
+async def blind_retry(conn):
+    while True:  # LINT: async-unbounded-retry
+        try:
+            return await fetch(conn)
+        except IOError:
+            continue
+
+
+async def blind_retry_logged(conn, log):
+    while True:  # LINT: async-unbounded-retry
+        try:
+            return await fetch(conn)
+        except IOError as e:
+            log.append(e)
+            continue
+
+
+async def backoff_retry(conn):
+    # negative: awaited exponential backoff paces the loop
+    delay = 0.05
+    while True:
+        try:
+            return await fetch(conn)
+        except IOError:
+            await asyncio.sleep(delay)
+            delay = min(2.0, delay * 2)
+            continue
+
+
+async def deadline_retry(conn):
+    # negative: a deadline consult bounds the loop
+    deadline = asyncio.get_event_loop().time() + 30.0
+    while True:
+        try:
+            return await fetch(conn)
+        except IOError:
+            if asyncio.get_event_loop().time() >= deadline:
+                raise
+            continue
+
+
+async def event_parked_loop(queue):
+    # negative: not a retry loop -- the awaited queue.get() parks it
+    while True:
+        item = await queue.get()
+        if item is None:
+            continue
+        return item
+
+
+def sync_retry(read_fn):
+    # negative: sync code is outside the async pack's jurisdiction
+    while True:
+        try:
+            return read_fn()
+        except IOError:
+            continue

@@ -36,6 +36,7 @@ FIXTURES = {
     "async_blocking_call.py": None,
     "async_sync_lock_await.py": None,
     "async_drain_per_item.py": None,
+    "async_unbounded_retry.py": None,
     "jax_host_sync.py": "ceph_tpu/ops/_fixture_host_sync.py",
     "jax_gf_dtype_drift.py": "ceph_tpu/matrices/_fixture_dtype.py",
     "jax_device_iteration.py": None,

@@ -1,0 +1,356 @@
+"""Exactly-once client ops: reqid dup detection in the PG log, safe
+resend with backoff, and the RADOS-style PG backoff protocol.
+
+Covers the acceptance surface of the robustness round: a primary killed
+between apply and reply (the ``kill_after_apply`` injector) yields
+exactly one application and the ORIGINAL result on resend -- for the
+formerly-refused non-idempotent kinds (omap_cas, exec, snap_rollback)
+included; dup entries survive ``PGLog.trim()`` up to
+``osd_pg_log_dups_tracked`` and transfer during peering; ops targeting
+a peering PG receive an explicit backoff and complete the moment the PG
+reactivates.  Reference: pg_log_dup_t / osd_reqid_t replay detection
+(src/osd/osd_types.h, src/osd/PGLog.cc) and the Backoff protocol
+(src/osd/osd_types.h Backoff, PrimaryLogPG::maybe_add_backoff).
+"""
+
+import asyncio
+import json
+
+import pytest
+
+from ceph_tpu.msg.fault import FaultInjector
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.osd.pglog import PGLog
+from ceph_tpu.utils.config import get_config
+from ceph_tpu.utils.encoding import Decoder
+from ceph_tpu.utils.perf import PerfCounters
+
+PROFILE = {"k": "2", "m": "1", "technique": "reed_sol_van",
+           "plugin": "jerasure"}
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _mk(n_osds=6, profile=None, **kw):
+    PerfCounters.reset_all()
+    fault = FaultInjector(seed=3)
+    cluster = ECCluster(n_osds, dict(profile or PROFILE), fault=fault, **kw)
+    return cluster, fault
+
+
+def _dup_hits() -> int:
+    dump = json.loads(PerfCounters.dump())
+    return sum(v.get("dup_op_hit", 0) for name, v in dump.items()
+               if name.startswith("osd."))
+
+
+class _FastProbe:
+    """Shrink the client probe grace so dead-primary discovery does not
+    dominate test wall time; restores on exit."""
+
+    def __enter__(self):
+        self.cfg = get_config()
+        self.prior = self.cfg.get_val("client_probe_grace")
+        self.cfg.apply_changes({"client_probe_grace": 0.1})
+        return self
+
+    def __exit__(self, *exc):
+        self.cfg.apply_changes({"client_probe_grace": self.prior})
+        return False
+
+
+# -- the dup-detection window (acceptance criterion) ------------------------
+
+
+@pytest.mark.parametrize("kind", ["omap_cas", "exec", "snap_rollback",
+                                  "write"])
+def test_kill_after_apply_exactly_once(kind):
+    """Primary killed after the op applies, before the reply frame: the
+    automatic resend must be answered with the original result from the
+    PG-log dups and the op must have applied exactly once."""
+
+    async def main():
+        cluster, fault = _mk()
+        b = cluster.backend
+        with _FastProbe():
+            if kind == "omap_cas":
+                await b.omap_set("o", {"n": b"a"})
+                fault.schedule_kill_after_apply(kind)
+                ok, cur = await b.omap_cas("o", "n", b"a", b"b")
+                # the ORIGINAL outcome, not a post-apply re-compare
+                # (which would report (False, b"b"))
+                assert (ok, cur) == (True, b"a")
+                assert (await b.omap_get("o", ["n"]))["n"] == b"b"
+                # exactly once: the swapped-from value is really gone
+                ok2, cur2 = await b.omap_cas("o", "n", b"a", b"c")
+                assert not ok2 and cur2 == b"b"
+            elif kind == "exec":
+                fault.schedule_kill_after_apply(kind)
+                ret, out = await b.exec("o", "version", "inc")
+                assert ret == 0 and Decoder(out).value() == 1
+                ret, out = await b.exec("o", "version", "get")
+                assert ret == 0 and Decoder(out).value() == 1  # not 2
+            elif kind == "snap_rollback":
+                await b.write("o", b"v1" * 500)
+                await b.write("o", b"v2" * 500,
+                              snapc={"seq": 1, "snaps": [1]})
+                fault.schedule_kill_after_apply(kind)
+                await b.snap_rollback("o", 1)
+                assert await b.read("o") == b"v1" * 500
+            else:
+                fault.schedule_kill_after_apply(kind)
+                await b.write("o", b"payload" * 300)
+                assert await b.read("o") == b"payload" * 300
+            assert fault.apply_kills == 1
+            assert _dup_hits() >= 1
+            snap = b.perf.snapshot()
+            assert snap.get("primary_failover", 0) >= 1
+            assert snap.get("op_resend", 0) >= 1
+        await cluster.shutdown()
+
+    run(main())
+
+
+# -- PGLog dup registry -----------------------------------------------------
+
+
+def test_dups_survive_trim_and_evict_at_bound():
+    log = PGLog(trim_target=4, dups_tracked=3)
+    for i in range(6):
+        log.append("o@0", "write", (i + 1, "w"))
+    log.record_dup(("c", 1, 1), None, oid="o", version=(1, "w"))
+    log.trim(log.head_seq)
+    assert not log.entries, "log entries trim normally"
+    assert log.lookup_dup(("c", 1, 1)) is not None, \
+        "dup entries must survive trim"
+    # the dups ride their own osd_pg_log_dups_tracked bound instead
+    for i in range(2, 5):
+        log.record_dup(("c", 1, i), None, oid="o")
+    assert log.lookup_dup(("c", 1, 1)) is None, "oldest evicted at bound"
+    assert log.lookup_dup(("c", 1, 4)) is not None
+    assert len(log.dups) == 3
+
+
+def test_dup_result_upgrades_once():
+    log = PGLog(dups_tracked=10)
+    # the sub-op fan-out records first (result not yet known) ...
+    log.record_dup(("c", 2, 1), None, oid="o")
+    # ... the primary upgrades it at completion ...
+    log.record_dup(("c", 2, 1), (0, b"out"), oid="o")
+    assert log.lookup_dup(("c", 2, 1)).result == (0, b"out")
+    # ... and a later record (replayed fan-out) never clobbers it
+    log.record_dup(("c", 2, 1), (1, b"other"), oid="o")
+    assert log.lookup_dup(("c", 2, 1)).result == (0, b"out")
+
+
+def test_rollback_prunes_rolled_back_dups():
+    """A torn write peering rolls back must take its dup along: the
+    replay has to RE-EXECUTE, not report an undone success."""
+
+    class Store:
+        def queue_transaction(self, txn):
+            pass
+
+    log = PGLog(dups_tracked=10)
+    log.append("o@0", "write", (5, "w"), existed=False)
+    log.record_dup(("c", 3, 1), None, oid="o", version=(5, "w"))
+    log.record_dup(("c", 3, 2), None, oid="other", version=(9, "w"))
+    assert log.rollback_object_to("o@0", (0, ""), Store())
+    assert log.lookup_dup(("c", 3, 1)) is None
+    assert log.lookup_dup(("c", 3, 2)) is not None, "other objects keep theirs"
+
+
+def test_subwrite_reqid_rides_the_wire():
+    from ceph_tpu.msg.wire import decode_message, encode_message
+    from ceph_tpu.osd.types import ECSubWrite, Transaction
+
+    sub = ECSubWrite(
+        from_shard=1, tid=7, oid="x",
+        transaction=Transaction().write("x@1", 0, b"d"),
+        at_version=(3, "client"), reqid=("client", 2, 9),
+    )
+    back = decode_message(encode_message(sub))
+    assert tuple(back.reqid) == ("client", 2, 9)
+    sub.reqid = None
+    assert decode_message(encode_message(sub)).reqid is None
+
+
+# -- dup exchange at peering ------------------------------------------------
+
+
+def test_dup_exchange_at_peering_answers_replay():
+    """An OSD that was DOWN while an op committed revives, is promoted
+    primary, and must answer the op's replay from dups fetched during
+    peering -- the pg_log_dup_t exchange."""
+
+    async def main():
+        cluster, _fault = _mk()
+        reqid = ["rawclient", 1, 1]
+        replies = {}
+        waiters = {}
+
+        async def raw_dispatch(src, msg):
+            if isinstance(msg, dict) and msg.get("op") == "client_reply":
+                replies[msg["tid"]] = msg
+                ev = waiters.pop(msg["tid"], None)
+                if ev is not None:
+                    ev.set()
+
+        cluster.messenger.register("rawclient", raw_dispatch)
+
+        async def raw_op(target, tid):
+            waiters[tid] = asyncio.Event()
+            await cluster.messenger.send_message("rawclient", target, {
+                "op": "client_op", "tid": tid, "kind": "omap_cas",
+                "oid": "px", "pool": cluster.pool, "key": "n",
+                "expect": b"0", "new": b"1", "reqid": list(reqid),
+            })
+            await asyncio.wait_for(waiters.get(tid, asyncio.Event()).wait(),
+                                   timeout=5.0)
+            return replies[tid]
+
+        await cluster.backend.omap_set("px", {"n": b"0"})
+        acting = cluster.backend.acting_set("px")
+        p0, p1 = acting[0], acting[1]
+        # P0 misses the op entirely
+        cluster.kill_osd(p0)
+        r = await raw_op(f"osd.{p1}", 1)
+        assert r["ok"] and list(r["result"]) == [True, b"0"]
+        assert cluster.osds[p1].pglog.lookup_dup(tuple(reqid)) is not None
+        # role handoff: P0 back, the primary that served the op gone
+        cluster.revive_osd(p0)
+        cluster.kill_osd(p1)
+        assert cluster.backend.primary_of("px") == f"osd.{p0}"
+        assert cluster.osds[p0].pglog.lookup_dup(tuple(reqid)) is None
+        # peering transfers the dups (and recovers the meta state)
+        await cluster.osds[p0].pools[cluster.pool].peering_pass()
+        assert cluster.osds[p0].pglog.lookup_dup(tuple(reqid)) is not None
+        # the replayed CAS is answered with the ORIGINAL outcome; a
+        # re-execution would compare against the post-apply value and
+        # report (False, b"1")
+        r2 = await raw_op(f"osd.{p0}", 2)
+        assert r2["ok"] and list(r2["result"]) == [True, b"0"]
+        assert cluster.osds[p0].perf.snapshot().get("dup_op_hit", 0) >= 1
+        await cluster.shutdown()
+
+    run(main())
+
+
+# -- PG backoff protocol ----------------------------------------------------
+
+
+def test_backoff_release_ordering():
+    """An op targeting a peering PG receives an explicit backoff, parks
+    client-side, and completes the moment the PG activates -- no probe
+    slices, no timeout."""
+
+    async def main():
+        cluster, _fault = _mk()
+        b = cluster.backend
+        await b.write("bo", b"seed" * 100)
+        primary = int(b.primary_of("bo").split(".")[1])
+        shard = cluster.osds[primary]
+        shard.pg_states[cluster.pool] = "peering"
+        task = asyncio.get_event_loop().create_task(
+            b.write("bo", b"after" * 100)
+        )
+        for _ in range(100):
+            await asyncio.sleep(0.01)
+            if b.perf.snapshot().get("backoff_received", 0) >= 1:
+                break
+        snap = b.perf.snapshot()
+        assert snap.get("backoff_received", 0) >= 1
+        assert not task.done(), "op must park until the release"
+        assert shard.perf.snapshot().get("backoff_sent", 0) >= 1
+        await shard._activate_pool(cluster.pool)
+        await asyncio.wait_for(task, timeout=5.0)
+        snap = b.perf.snapshot()
+        assert snap.get("backoff_release_received", 0) >= 1
+        assert snap.get("op_resend", 0) >= 1
+        assert await b.read("bo") == b"after" * 100
+        await cluster.shutdown()
+
+    run(main())
+
+
+def test_backoff_end_to_end_with_peering_loop():
+    """Integration: liveness churn flips pools to peering on every OSD
+    (request_peering); in-flight ops either ride a backoff/release
+    round or land normally -- nothing times out, nothing errors."""
+
+    async def main():
+        cluster, _fault = _mk()
+        cluster.start_auto_recovery(interval=30.0)  # event-driven only
+        b = cluster.backend
+        victim = 5
+        cluster.kill_osd(victim)  # all pools go peering, loop wakes
+        results = await asyncio.gather(*(
+            b.write(f"eo{i}", b"x" * 512) for i in range(6)
+        ))
+        assert all(r is None for r in results)
+        cluster.revive_osd(victim)
+        for i in range(6):
+            assert await b.read(f"eo{i}") == b"x" * 512
+        await cluster.shutdown()
+
+    run(main())
+
+
+# -- objecter retry observability -------------------------------------------
+
+
+def test_false_demotion_counter():
+    async def main():
+        cluster, _fault = _mk(n_osds=3)
+        b = cluster.backend
+        b._demoted.add(999)
+        await b.dispatch("osd.0", {"op": "client_reply", "tid": 999,
+                                   "ok": True})
+        assert b.perf.snapshot().get("false_demotion", 0) == 1
+        assert 999 not in b._demoted
+        await cluster.shutdown()
+
+    run(main())
+
+
+def test_resend_uses_one_reqid_and_conflict_retry_refreshes_it():
+    """Failover resends must reuse the logical op's reqid (that is what
+    the dup gate keys on); a WriteConflict retry is a NEW execution and
+    must mint a fresh one."""
+
+    async def main():
+        cluster, fault = _mk()
+        b = cluster.backend
+        seen = []
+        orig = b._new_reqid
+
+        def spy():
+            rid = orig()
+            seen.append(rid)
+            return rid
+
+        b._new_reqid = spy
+        with _FastProbe():
+            fault.schedule_kill_after_apply("write")
+            await b.write("rq", b"z" * 256)
+        assert len(seen) == 1, "a failover resend must not mint a reqid"
+        await cluster.shutdown()
+
+    run(main())
+
+
+# -- bench smoke ------------------------------------------------------------
+
+
+def test_failover_bench_smoke():
+    from ceph_tpu.osd.failover_bench import run_failover_bench
+
+    out = run_failover_bench(n_osds=6, n_objects=6, obj_bytes=2048,
+                             kills=2)
+    assert out["kills"] == 2
+    assert out["dup_op_hit"] >= 1
+    assert out["ttfs_mean_ms"] > 0
+    assert out["thrash_p99_ms"] >= out["steady_p50_ms"] * 0 \
+        and out["steady_p99_ms"] > 0

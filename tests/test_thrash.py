@@ -102,6 +102,94 @@ def test_thrash_cluster(pool_type, profile, max_read_down):
     asyncio.new_event_loop().run_until_complete(main())
 
 
+def test_thrash_exactly_once_mix():
+    """Thrash with NON-IDEMPOTENT ops in the mix (omap_cas + exec) and
+    primaries killed in the apply/reply window: every op must complete
+    with its true result via reqid dup detection -- zero indeterminate
+    outcomes (the OpIndeterminate escape hatch is gone) and zero double
+    applies (the counters advance exactly once per acked success)."""
+
+    async def main():
+        PerfCounters.reset_all()
+        fault = FaultInjector(seed=11)
+        cluster = ECCluster(
+            10,
+            {"k": "4", "m": "2", "technique": "reed_sol_van",
+             "plugin": "jerasure"},
+            fault=fault,
+        )
+        cfg = get_config()
+        cfg.apply_changes({"client_probe_grace": 0.1})
+        try:
+            from ceph_tpu.utils.encoding import Decoder, Encoder
+
+            rng = random.Random(23)
+            down = []
+            cas_ok = 0
+            exec_ok = 0
+            kills_armed = 0
+            await cluster.backend.omap_set("cas-cnt", {})
+            for round_no in range(40):
+                if down and rng.random() < 0.5:
+                    cluster.revive_osd(down.pop())
+                choice = rng.random()
+                kind = "omap_cas" if choice < 0.5 else "exec"
+                oid = "cas-cnt" if kind == "omap_cas" else "exec-cnt"
+                primary = cluster.backend.primary_of(oid)
+                victim = int(primary.split(".")[1])
+                # every few rounds, kill THIS op's primary between apply
+                # and reply (the dup-detection window); stay within the
+                # m=2 failure budget
+                if len(down) < 2 and victim not in down and \
+                        rng.random() < 0.4:
+                    fault.schedule_kill_after_apply(kind)
+                    kills_armed += 1
+                    down.append(victim)
+                if kind == "omap_cas":
+                    cur = (await cluster.backend.omap_get(
+                        "cas-cnt", ["n"])).get("n")
+                    nxt = Encoder().value(
+                        (Decoder(cur).value() if cur else 0) + 1).bytes()
+                    ok, _seen = await cluster.backend.omap_cas(
+                        "cas-cnt", "n", cur, nxt)
+                    if ok:
+                        cas_ok += 1
+                else:
+                    ret, _out = await cluster.backend.exec(
+                        "exec-cnt", "version", "inc")
+                    if ret == 0:
+                        exec_ok += 1
+                # an armed-but-unfired kill (op answered from a dup
+                # before re-executing anything) keeps the victim up
+                if down and down[-1] == victim and \
+                        not cluster.messenger.is_down(primary):
+                    down.pop()
+            for osd in list(down):
+                cluster.revive_osd(osd)
+            assert kills_armed >= 5, "the window was never exercised"
+            # zero double-applies: each acked success advanced its
+            # counter exactly once (a replayed re-execution would
+            # overshoot; a lying failure would undershoot)
+            raw = (await cluster.backend.omap_get("cas-cnt", ["n"])).get("n")
+            assert (Decoder(raw).value() if raw else 0) == cas_ok
+            ret, out = await cluster.backend.exec(
+                "exec-cnt", "version", "get")
+            assert ret == 0 and Decoder(out).value() == exec_ok
+            # the window really produced replays answered from the log
+            import json
+
+            dump = json.loads(PerfCounters.dump())
+            hits = sum(v.get("dup_op_hit", 0)
+                       for name, v in dump.items()
+                       if name.startswith("osd."))
+            assert hits >= 1
+        finally:
+            cfg.apply_changes({"client_probe_grace": 1.0})
+        await cluster.shutdown()
+
+    asyncio.new_event_loop().run_until_complete(main())
+
+
 def test_trace_spans():
     from ceph_tpu.utils import trace
 
