@@ -538,22 +538,40 @@ def main() -> int:
 
     fo_host = _secondary(_failover_path_host)
 
-    def _lint_findings_total():
-        """Static-health trend metric: unsuppressed cephlint findings
-        across ceph_tpu/tools/tests (tools/cephlint.py --format json).
-        0 is the gated steady state; any rise is new debt the tier-1
-        gate will also be failing on."""
+    def _lint_stage():
+        """Static-health trend metrics: unsuppressed cephlint findings
+        across ceph_tpu/tools/tests (tools/cephlint.py --format json) as
+        a per-rule histogram plus the analyzer's own wall time -- a
+        rising lint_runtime_secs is the flow-aware engine regressing,
+        and any non-zero rule count is new debt the tier-1 gate will
+        also be failing on.  A fast --changed pass runs first so the
+        common bench-on-a-dirty-tree case reports the same debt in a
+        fraction of the time budget; the full scan is the artifact."""
         import subprocess
 
         root = __file__.rsplit("/", 1)[0]
-        proc = subprocess.run(
-            [sys.executable, os.path.join(root, "tools", "cephlint.py"),
-             "--format", "json", "ceph_tpu", "tools", "tests"],
+        cli = os.path.join(root, "tools", "cephlint.py")
+        # fast diff-scoped pass (timing evidence for the --changed path)
+        changed = subprocess.run(
+            [sys.executable, cli, "--format", "json", "--changed"],
             capture_output=True, text=True, timeout=300,
         )
-        return json.loads(proc.stdout)["lint_findings_total"]
+        changed_data = json.loads(changed.stdout) if changed.stdout else {}
+        proc = subprocess.run(
+            [sys.executable, cli, "--format", "json",
+             "ceph_tpu", "tools", "tests"],
+            capture_output=True, text=True, timeout=300,
+        )
+        data = json.loads(proc.stdout)
+        return {
+            "total": data["lint_findings_total"],
+            "by_rule": data["lint_findings_by_rule"],
+            "runtime_secs": data["lint_runtime_secs"],
+            "changed_runtime_secs": changed_data.get("lint_runtime_secs"),
+            "changed_files_scanned": changed_data.get("files_scanned"),
+        }
 
-    lint_total = _secondary(_lint_findings_total)
+    lint_stage = _secondary(_lint_stage)
 
     def _r3(v):
         return round(v, 3) if v is not None else None
@@ -615,7 +633,13 @@ def main() -> int:
         "failover_path_host_steady_p99_ms": (
             fo_host["steady_p99_ms"] if fo_host else None),
         "failover_path_host": fo_host,
-        "lint_findings_total": lint_total,
+        "lint_findings_total": lint_stage["total"] if lint_stage else None,
+        "lint_findings_by_rule": (
+            lint_stage["by_rule"] if lint_stage else None),
+        "lint_runtime_secs": (
+            lint_stage["runtime_secs"] if lint_stage else None),
+        "lint_changed_runtime_secs": (
+            lint_stage["changed_runtime_secs"] if lint_stage else None),
         "platform": jax.devices()[0].platform + (
             "-fallback"
             if os.environ.get("CEPH_TPU_BENCH_FALLBACK")

@@ -13,7 +13,7 @@ from __future__ import annotations
 
 import ast
 import dataclasses
-from typing import Callable, Dict, Iterable, List, Optional
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
 
 SEV_ERROR = "error"
 SEV_WARNING = "warning"
@@ -68,7 +68,9 @@ def all_rules() -> Dict[str, Rule]:
     # doesn't force them, but any registry consumer sees every rule
     from ceph_tpu.analysis import rules_async  # noqa: F401
     from ceph_tpu.analysis import rules_config  # noqa: F401
+    from ceph_tpu.analysis import rules_interleave  # noqa: F401
     from ceph_tpu.analysis import rules_jax  # noqa: F401
+    from ceph_tpu.analysis import rules_wire  # noqa: F401
 
     return dict(_RULES)
 
@@ -176,6 +178,63 @@ def is_jitted(fn: ast.AST) -> bool:
     """Decorated with jax.jit / jit / functools.partial(jax.jit, ...)."""
     return any("jit" == d.rsplit(".", 1)[-1] or d.endswith(".jit")
                for d in decorator_names(fn))
+
+
+import re as _re
+
+#: declared yield-free regions, marked by comment pairs of the form
+#: ``cephlint: atomic-section <name>`` ... ``cephlint:
+#: end-atomic-section`` (each after a ``#``).  The annotation is a
+#: contract, enforced twice: statically (rules_interleave flags any
+#: task-switch point between the markers) and at runtime
+#: (analysis/runtime.py asserts no task ever suspends inside one).
+_ATOMIC_BEGIN = _re.compile(
+    r"#\s*cephlint:\s*atomic-section\s+([A-Za-z0-9_.\-]+)")
+_ATOMIC_END = _re.compile(r"#\s*cephlint:\s*end-atomic-section\b")
+
+
+@dataclasses.dataclass(frozen=True)
+class AtomicSection:
+    """One declared yield-free region: the markers sit on ``start`` and
+    ``end``; the protected statements are the lines strictly between."""
+
+    name: str
+    start: int  # 1-based line of the begin marker
+    end: int    # 1-based line of the end marker
+
+
+def parse_atomic_sections(lines) -> "Tuple[List[AtomicSection], List[Tuple[int, str]]]":  # noqa: E501
+    """(sections, problems) from a file's source lines.  Problems are
+    (line, message) pairs: an end without a begin, a begin without an
+    end, a begin nested inside an open section."""
+    sections: List[AtomicSection] = []
+    problems: List[tuple] = []
+    open_name: Optional[str] = None
+    open_line = 0
+    for i, line in enumerate(lines, start=1):
+        m = _ATOMIC_BEGIN.search(line)
+        if m:
+            if open_name is not None:
+                problems.append((
+                    i, f"atomic-section {m.group(1)!r} opens inside "
+                       f"still-open section {open_name!r} (line "
+                       f"{open_line}); sections cannot nest"))
+            open_name, open_line = m.group(1), i
+            continue
+        if _ATOMIC_END.search(line):
+            if open_name is None:
+                problems.append((
+                    i, "end-atomic-section without a matching "
+                       "atomic-section begin"))
+            else:
+                sections.append(AtomicSection(open_name, open_line, i))
+                open_name = None
+    if open_name is not None:
+        problems.append((
+            open_line,
+            f"atomic-section {open_name!r} is never closed "
+            "(missing end-atomic-section)"))
+    return sections, problems
 
 
 def module_str_constants(tree: ast.Module) -> Dict[str, str]:

@@ -8,12 +8,8 @@
   when the bad key is actually hit) and the raw env layer
   (``os.environ.get("CEPH_TPU_K")``), which never raises and so drifts
   silently.
-* ``ceph-encoding-version-pair``: every struct that serializes through
-  ``utils/encoding.py`` must keep encode and decode together (the
-  ENCODE_START/DECODE_START discipline of src/include/encoding.h): an
-  ``encode*`` without its ``decode*`` twin is a wire/persist format
-  with no reader, and a version constant referenced on only one side is
-  a compat break waiting for the next format bump.
+The encode/decode pairing rule moved to :mod:`rules_wire` when it grew
+flow-aware (field-sequence symmetry, append-only trailing compat).
 """
 
 from __future__ import annotations
@@ -21,11 +17,10 @@ from __future__ import annotations
 import ast
 import functools
 import os
-import re
 from typing import Dict, Iterator, List, Optional, Set, Tuple
 
-from ceph_tpu.analysis.core import (SEV_ERROR, SEV_WARNING, FileContext,
-                                    Finding, call_attr, call_name,
+from ceph_tpu.analysis.core import (SEV_ERROR, FileContext, Finding,
+                                    call_attr, call_name,
                                     module_str_constants, rule)
 
 _ENV_PREFIX = "CEPH_TPU_"
@@ -126,68 +121,8 @@ def call_name_of_sub(node: ast.Subscript) -> str:
     return dotted_name(node.value)
 
 
-_VERSION_CONST = re.compile(r"^_?[A-Z][A-Z0-9_]*VERSION[A-Z0-9_]*$|"
-                            r"^_?[A-Z][A-Z0-9_]*_V$")
-
-
-def _referenced_version_consts(fn: ast.AST) -> Set[str]:
-    out: Set[str] = set()
-    for node in ast.walk(fn):
-        name = None
-        if isinstance(node, ast.Name):
-            name = node.id
-        elif isinstance(node, ast.Attribute):
-            name = node.attr
-        if name and _VERSION_CONST.match(name):
-            out.add(name)
-    return out
-
-
-def _pairing_findings(ctx: FileContext, scope_desc: str,
-                      fns: Dict[str, ast.AST]) -> Iterator[Finding]:
-    for name, fn in fns.items():
-        if name.startswith("encode"):
-            twin = "decode" + name[len("encode"):]
-        elif name.startswith("decode"):
-            twin = "encode" + name[len("decode"):]
-        else:
-            continue
-        if twin not in fns:
-            yield ctx.finding(
-                "ceph-encoding-version-pair", fn,
-                f"{scope_desc}{name}() has no {twin}() counterpart; "
-                "serialized formats must keep both directions together "
-                "(src/include/encoding.h ENCODE/DECODE discipline)",
-            )
-            continue
-        if name.startswith("encode"):
-            enc_v = _referenced_version_consts(fn)
-            dec_v = _referenced_version_consts(fns[twin])
-            for missing in sorted(enc_v - dec_v):
-                yield ctx.finding(
-                    "ceph-encoding-version-pair", fn,
-                    f"{scope_desc}{name}() writes version constant "
-                    f"{missing} but {twin}() never reads it: the "
-                    "decoder cannot gate on struct version at the next "
-                    "format bump",
-                )
-
-
-@rule(
-    "ceph-encoding-version-pair", "ceph", SEV_WARNING,
-    "encode*/decode* pairing in utils/encoding.py users: one-sided "
-    "serializers and one-sided struct-version constants",
-)
-def check_encoding_pairs(ctx: FileContext) -> Iterator[Finding]:
-    if not ctx.imports_module("ceph_tpu.utils.encoding"):
-        return
-    mod_fns = {n.name: n for n in ctx.tree.body
-               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
-    yield from _pairing_findings(ctx, "", mod_fns)
-    for node in ctx.tree.body:
-        if isinstance(node, ast.ClassDef):
-            methods = {n.name: n for n in node.body
-                       if isinstance(n, (ast.FunctionDef,
-                                         ast.AsyncFunctionDef))}
-            yield from _pairing_findings(
-                ctx, f"{node.name}.", methods)
+# NOTE: the encode/decode pairing rule that used to live here
+# (ceph-encoding-version-pair) grew into the flow-aware wire-schema
+# pack: see rules_wire.py (wire-version-pairing carries the old
+# checks; wire-schema-symmetry / wire-trailing-compat add the field
+# sequence and append-only compat analysis).

@@ -6,11 +6,12 @@ from __future__ import annotations
 import ast
 import json
 import os
+import time
 from typing import Dict, Iterable, List, Optional, Tuple
 
 from ceph_tpu.analysis import baseline as baseline_mod
 from ceph_tpu.analysis import suppress as suppress_mod
-from ceph_tpu.analysis.core import (SEV_ERROR, FileContext, Finding,
+from ceph_tpu.analysis.core import (SEV_ERROR, FileContext, Finding, Rule,
                                     all_rules)
 
 #: paths skipped by default: the lint fixtures are DELIBERATE findings
@@ -56,6 +57,10 @@ class ScanResult:
         self.suppression_audit: List[dict] = []
         #: raw per-file lines (baseline hashing)
         self.file_lines: Dict[str, List[str]] = {}
+        #: analysis wall time (bench.py's lint_runtime_secs metric)
+        self.runtime_secs = 0.0
+        #: names of the rules this scan ran (all, or a --rule subset)
+        self.rules_run: List[str] = []
 
     @property
     def all_findings(self) -> List[Finding]:
@@ -67,15 +72,35 @@ class ScanResult:
             counts[f.rule] = counts.get(f.rule, 0) + 1
         return {
             "lint_findings_total": len(self.new),
+            "lint_findings_by_rule": dict(sorted(counts.items())),
+            "lint_runtime_secs": round(self.runtime_secs, 3),
             "files_scanned": self.files_scanned,
             "suppressed": len(self.suppressed),
             "baselined": len(self.baselined),
+            "rules_run": list(self.rules_run),
+            # legacy spelling kept for older consumers of the JSON
             "counts_by_rule": dict(sorted(counts.items())),
             "findings": [f.to_dict() for f in self.new],
         }
 
 
-def scan_file(path: str, source: str) -> List[Finding]:
+def resolve_rules(names: Optional[Iterable[str]] = None) -> Dict[str, Rule]:
+    """The rule set a scan runs: every registered rule, or the ``--rule``
+    subset (unknown names raise with the valid spellings listed)."""
+    registry = all_rules()
+    if not names:
+        return registry
+    out: Dict[str, Rule] = {}
+    for name in names:
+        if name not in registry:
+            known = ", ".join(sorted(registry))
+            raise ValueError(f"unknown rule {name!r}; known rules: {known}")
+        out[name] = registry[name]
+    return out
+
+
+def scan_file(path: str, source: str,
+              rules: Optional[Dict[str, Rule]] = None) -> List[Finding]:
     """All raw findings for one file (no suppression/baseline yet)."""
     try:
         tree = ast.parse(source)
@@ -84,7 +109,7 @@ def scan_file(path: str, source: str) -> List[Finding]:
                         f"file does not parse: {e.msg}", SEV_ERROR)]
     ctx = FileContext(path, source, tree)
     findings: List[Finding] = []
-    for r in all_rules().values():
+    for r in (rules if rules is not None else all_rules()).values():
         findings.extend(r.check(ctx))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -92,9 +117,13 @@ def scan_file(path: str, source: str) -> List[Finding]:
 
 def run_paths(paths: Iterable[str], root: Optional[str] = None,
               baseline_path: Optional[str] = None,
-              excludes: Tuple[str, ...] = DEFAULT_EXCLUDES) -> ScanResult:
+              excludes: Tuple[str, ...] = DEFAULT_EXCLUDES,
+              rules: Optional[Iterable[str]] = None) -> ScanResult:
     root = root or repo_root()
+    t0 = time.monotonic()
+    rule_set = resolve_rules(rules)
     result = ScanResult()
+    result.rules_run = sorted(rule_set)
     accepted = baseline_mod.load(baseline_path) if baseline_path else {}
     for rel in collect_files(paths, root, excludes):
         try:
@@ -104,7 +133,7 @@ def run_paths(paths: Iterable[str], root: Optional[str] = None,
             continue
         result.files_scanned += 1
         result.file_lines[rel] = source.splitlines()
-        raw = scan_file(rel, source)
+        raw = scan_file(rel, source, rule_set)
         result.suppression_audit.extend(suppress_mod.audit(rel, source))
         if not raw:
             continue
@@ -118,16 +147,43 @@ def run_paths(paths: Iterable[str], root: Optional[str] = None,
         new, old = baseline_mod.split(live, result.file_lines, accepted)
         result.new.extend(new)
         result.baselined.extend(old)
+    result.runtime_secs = time.monotonic() - t0
     return result
+
+
+def changed_files(root: Optional[str] = None) -> List[str]:
+    """Repo-relative .py files differing from HEAD (staged, unstaged,
+    and untracked) -- the ``--changed`` scan scope.  Empty when git is
+    unavailable (callers fall back to a full scan or a no-op)."""
+    import subprocess
+
+    root = root or repo_root()
+    out: set = set()
+    for args in (["git", "diff", "--name-only", "HEAD", "--"],
+                 ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(args, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return []
+        if proc.returncode != 0:
+            return []
+        for line in proc.stdout.splitlines():
+            line = line.strip()
+            if line.endswith(".py") and \
+                    os.path.exists(os.path.join(root, line)):
+                out.add(line.replace(os.sep, "/"))
+    return sorted(out)
 
 
 def run(paths: Iterable[str], fmt: str = "text",
         baseline_path: Optional[str] = None,
         root: Optional[str] = None,
-        excludes: Tuple[str, ...] = DEFAULT_EXCLUDES) -> Tuple[int, str]:
+        excludes: Tuple[str, ...] = DEFAULT_EXCLUDES,
+        rules: Optional[Iterable[str]] = None) -> Tuple[int, str]:
     """(exit_code, rendered_output); exit 0 iff no new findings."""
     result = run_paths(paths, root=root, baseline_path=baseline_path,
-                       excludes=excludes)
+                       excludes=excludes, rules=rules)
     if fmt == "json":
         out = json.dumps(result.to_dict(), indent=2)
     else:
@@ -136,7 +192,8 @@ def run(paths: Iterable[str], fmt: str = "text",
             f"cephlint: {len(result.new)} finding(s) in "
             f"{result.files_scanned} files "
             f"({len(result.suppressed)} inline-suppressed, "
-            f"{len(result.baselined)} baselined)"
+            f"{len(result.baselined)} baselined, "
+            f"{result.runtime_secs:.2f}s)"
         )
         out = "\n".join(lines)
     return (1 if result.new else 0), out

@@ -1,0 +1,287 @@
+"""Runtime atomic-section verifier: the declared invariants, tested.
+
+The static rule (``rules_interleave.check_atomic_sections``) proves no
+*lexical* task-switch point sits inside a declared atomic section.
+This module closes the loop at runtime so the annotation itself is
+tested, not trusted: under tier-1 every event loop gets a verifying
+task factory whose coroutine shim observes each yield-to-the-loop and
+walks the suspended await chain's frames; a frame parked between a
+section's markers means a task switch happened inside a region the
+code declared switch-free -- recorded as a violation (and attributed
+to the running test by the conftest hook).
+
+Cost: one generator shim per task and, per yield, a short frame walk
+with one dict probe per frame (only files that declare sections are in
+the table).  No tracing/profiling hooks, so the suite's hot paths are
+untouched between yields.
+
+The FaultInjector additionally reports every injected tear
+(mid-burst connection kill, apply-window primary kill) via
+:func:`on_tear`; the verifier then asserts no OTHER task is suspended
+inside a section at tear time -- i.e. the tear window crosses only
+watermark-safe states.  Since sections are yield-free this can only
+fire if the static layer was evaded (dynamic code, monkeypatching),
+which is exactly the gap a runtime verifier exists to cover.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import os
+import types
+from typing import Dict, List, Optional, Tuple
+
+from ceph_tpu.analysis.core import parse_atomic_sections
+
+
+class AtomicViolation:
+    """One observed task switch inside a declared atomic section."""
+
+    __slots__ = ("section", "path", "line", "task", "note")
+
+    def __init__(self, section: str, path: str, line: int, task: str,
+                 note: str):
+        self.section = section
+        self.path = path
+        self.line = line
+        self.task = task
+        self.note = note
+
+    def __repr__(self) -> str:
+        return (f"task {self.task!r} suspended at {self.path}:{self.line} "
+                f"inside atomic section {self.section!r} ({self.note})")
+
+
+class AtomicSectionError(AssertionError):
+    """Raised (opt-in) when a task switches inside an atomic section."""
+
+
+class AtomicVerifier:
+    """Section registry + the verifying coroutine shim."""
+
+    def __init__(self, raise_on_violation: bool = False):
+        #: realpath -> [(name, start, end)], sorted by start
+        self.sections: Dict[str, List[Tuple[str, int, int]]] = {}
+        self.violations: List[AtomicViolation] = []
+        self.raise_on_violation = raise_on_violation
+
+    # -- registration ------------------------------------------------------
+
+    def register_source(self, path: str, source: str) -> int:
+        """Register every well-formed section declared in ``source``;
+        returns how many.  Malformed pairs are the static rule's
+        finding, not a runtime concern -- they are skipped here."""
+        sections, _problems = parse_atomic_sections(source.splitlines())
+        if not sections:
+            return 0
+        key = os.path.realpath(path)
+        table = self.sections.setdefault(key, [])
+        for s in sections:
+            table.append((s.name, s.start, s.end))
+        table.sort(key=lambda t: t[1])
+        return len(sections)
+
+    def register_file(self, path: str) -> int:
+        try:
+            with open(path, encoding="utf-8") as fh:
+                source = fh.read()
+        except OSError:
+            return 0
+        if "atomic-section" not in source:
+            return 0  # cheap pre-filter: most files declare nothing
+        return self.register_source(path, source)
+
+    def register_tree(self, root: str) -> int:
+        total = 0
+        for dirpath, dirnames, filenames in os.walk(root):
+            dirnames[:] = [d for d in dirnames
+                           if d not in ("__pycache__", ".git")]
+            for fn in filenames:
+                if fn.endswith(".py"):
+                    total += self.register_file(os.path.join(dirpath, fn))
+        return total
+
+    # -- the check ---------------------------------------------------------
+
+    def _hit(self, filename: str,
+             lineno: int) -> Optional[Tuple[str, int, int]]:
+        table = self.sections.get(filename)
+        if table is None:
+            table = self.sections.get(os.path.realpath(filename))
+            if table is None:
+                return None
+            # memoize the spelling the interpreter actually uses
+            self.sections[filename] = table
+        for name, start, end in table:
+            if start < lineno < end:
+                return name, start, end
+        return None
+
+    def _record(self, section: str, path: str, line: int,
+                note: str) -> None:
+        task = asyncio.current_task()
+        v = AtomicViolation(section, path, line,
+                            task.get_name() if task else "<no task>", note)
+        self.violations.append(v)
+        if self.raise_on_violation:
+            raise AtomicSectionError(repr(v))
+
+    def check_awaitable(self, coro, note: str) -> None:
+        """Walk a suspended coroutine's await chain; record a violation
+        for every frame parked inside a registered section."""
+        cur = coro
+        for _ in range(64):  # chain-depth bound (cycles are impossible,
+            # but a bound keeps the shim's worst case flat)
+            frame = getattr(cur, "cr_frame", None)
+            if frame is None:
+                frame = getattr(cur, "gi_frame", None)
+            if frame is None:
+                return
+            hit = self._hit(frame.f_code.co_filename, frame.f_lineno)
+            if hit is not None:
+                self._record(hit[0], frame.f_code.co_filename,
+                             frame.f_lineno, note)
+            nxt = getattr(cur, "cr_await", None)
+            if nxt is None:
+                nxt = getattr(cur, "gi_yieldfrom", None)
+            if nxt is None and frame.f_code.co_name == "driven":
+                # the verifying shim itself (a task's outermost frame
+                # when walked from Task.get_coro() in the tear sweep):
+                # bridge into the wrapped coroutine it drives
+                nxt = frame.f_locals.get("coro")
+            if nxt is None:
+                return
+            cur = nxt
+
+    def check_all_tasks(self, note: str) -> None:
+        """Tear-time sweep: no task may be parked inside a section when
+        an injected fault fires (watermark-safe tear states only)."""
+        try:
+            loop = asyncio.get_running_loop()
+        except RuntimeError:
+            return
+        current = asyncio.current_task(loop)
+        for task in asyncio.all_tasks(loop):
+            if task is current or task.done():
+                continue
+            coro = task.get_coro()
+            if coro is not None:
+                self.check_awaitable(coro, note)
+
+    # -- the shim ----------------------------------------------------------
+
+    def wrap(self, coro):
+        """A pass-through driver for ``coro`` that inspects the await
+        chain at every yield-to-the-loop."""
+        if not asyncio.iscoroutine(coro):
+            return coro
+
+        @types.coroutine
+        def driven():
+            to_send = None
+            to_throw = None
+            while True:
+                try:
+                    if to_throw is not None:
+                        yielded = coro.throw(to_throw)
+                    else:
+                        yielded = coro.send(to_send)
+                except StopIteration as e:
+                    return e.value
+                # the inner coroutine is suspended at a real yield:
+                # this is the only moment another task can run
+                self.check_awaitable(coro, "yield observed by verifier")
+                to_send = None
+                to_throw = None
+                try:
+                    to_send = yield yielded
+                except GeneratorExit:
+                    coro.close()
+                    raise
+                except BaseException as e:  # noqa: BLE001 -- relayed
+                    to_throw = e            # into the inner coroutine
+
+        return driven()
+
+    def install(self, loop: asyncio.AbstractEventLoop) -> None:
+        verifier = self
+
+        def factory(loop_, coro, **kwargs):
+            wrapped = verifier.wrap(coro)
+            task = asyncio.Task(wrapped, loop=loop_, **kwargs)
+            if wrapped is not coro:
+                # a task cancelled BEFORE its first step closes only
+                # the shim (a not-yet-started generator's throw never
+                # enters its body), which would leave the wrapped
+                # coroutine un-started -> RuntimeWarning at GC.  Close
+                # it explicitly once the task is done; close() on a
+                # finished coroutine is a no-op.
+                def _close(_task, coro=coro):
+                    try:
+                        coro.close()
+                    except Exception:  # noqa: BLE001 -- best-effort GC
+                        pass
+
+                task.add_done_callback(_close)
+            return task
+
+        loop.set_task_factory(factory)
+
+
+#: process-global verifier (the tier-1 conftest installs it); tests
+#: that provoke violations on purpose build private instances instead
+_GLOBAL: Optional[AtomicVerifier] = None
+
+
+def global_verifier() -> Optional[AtomicVerifier]:
+    return _GLOBAL
+
+
+def violations() -> List[AtomicViolation]:
+    return list(_GLOBAL.violations) if _GLOBAL is not None else []
+
+
+def register_default_sections(verifier: AtomicVerifier) -> int:
+    """Register every section declared under the ceph_tpu package and
+    tools/ (the scan is one substring probe per file)."""
+    pkg_root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_root = os.path.dirname(pkg_root)
+    n = verifier.register_tree(pkg_root)
+    tools = os.path.join(repo_root, "tools")
+    if os.path.isdir(tools):
+        n += verifier.register_tree(tools)
+    return n
+
+
+class _VerifyingPolicy(asyncio.DefaultEventLoopPolicy):
+    """Event-loop policy whose loops carry the verifying task factory
+    (covers ``asyncio.run`` and ``asyncio.new_event_loop`` both)."""
+
+    def __init__(self, verifier: AtomicVerifier):
+        super().__init__()
+        self._verifier = verifier
+
+    def new_event_loop(self):
+        loop = super().new_event_loop()
+        self._verifier.install(loop)
+        return loop
+
+
+def install() -> AtomicVerifier:
+    """Install the global verifier (idempotent): registers the repo's
+    declared sections and routes every future event loop through the
+    verifying task factory."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = AtomicVerifier()
+        register_default_sections(_GLOBAL)
+        asyncio.set_event_loop_policy(_VerifyingPolicy(_GLOBAL))
+    return _GLOBAL
+
+
+def on_tear(kind: str) -> None:
+    """FaultInjector hook: an injected tear (connection kill, apply-
+    window primary kill) just fired; assert no task is parked inside an
+    atomic section (the tear crosses only watermark-safe states)."""
+    if _GLOBAL is not None:
+        _GLOBAL.check_all_tasks(f"injected tear ({kind})")

@@ -214,17 +214,19 @@ async def serve(args) -> None:
         # dispatched into a shard that "hosts no pool"
         conf = await aio.read_json(args.cluster_conf)
     await messenger.start()
+    # The PR-2 invariant, now machine-enforced: the socket is LISTENING
+    # from the moment start() returns, and peers immediately replay
+    # queued lossless sub-ops (a revived OSD's backlog).  The stretch
+    # from here to host_pool below must stay yield-free, or early ops
+    # are dispatched into a shard that "hosts no pool" (the cluster
+    # conf is read BEFORE start() for exactly this reason).  The static
+    # rule flags any await inside; the runtime verifier
+    # (analysis/runtime.py) asserts no task switch lands here in tier-1.
+    # cephlint: atomic-section osd-listen-to-host-pool
     shard = OSDShard(
         args.id, messenger, op_queue=args.op_queue,
         objectstore=args.objectstore, data_path=args.data_path,
     )
-    if mon_ranks:
-        # monitor-integrated boot (reference src/ceph_osd.cc:650 ->
-        # OSD::start_boot, src/osd/OSD.cc:5386): register with the mon,
-        # subscribe to osdmap epochs, learn pools FROM the map, run peer
-        # heartbeats and report failures -- no static pool conf needed
-        await _mon_integrate(args, shard, messenger, addr_map,
-                             len(mon_ranks))
     if conf is not None:
         # legacy static bring-up: host a primary engine for the cluster's
         # pool from a JSON conf: THIS daemon (not the client) owns
@@ -251,6 +253,17 @@ async def serve(args) -> None:
                         pool_type=pool_type, size=km)
         # daemons run peering-driven auto recovery by default (OSD::tick)
         shard.start_tick()
+    # cephlint: end-atomic-section
+    if mon_ranks:
+        # monitor-integrated boot (reference src/ceph_osd.cc:650 ->
+        # OSD::start_boot, src/osd/OSD.cc:5386): register with the mon,
+        # subscribe to osdmap epochs, learn pools FROM the map, run peer
+        # heartbeats and report failures -- no static pool conf needed.
+        # (Mon-learned pools arrive via osdmap broadcasts; replayed
+        # sub-ops for them are refused un-acked until the map applies,
+        # so this branch may yield -- it sits OUTSIDE the section.)
+        await _mon_integrate(args, shard, messenger, addr_map,
+                             len(mon_ranks))
     # admin socket (src/common/admin_socket.cc): perf dump / ops /
     # config show / status over a unix socket next to the data dir
     asok = None

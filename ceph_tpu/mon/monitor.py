@@ -147,6 +147,11 @@ class Monitor:
                         "quorum": quorum,
                     },
                 )
+        if self.leader is not None and self.leader != self.rank:
+            # a lower rank's victory landed while we were broadcasting
+            # ours: writing self.rank here would clobber the real leader
+            # (asyncsan rmw-across-await: the victory sends above yield)
+            return False
         self.leader = self.rank
         self.quorum = quorum
         # recovery: bring the quorum's stores into agreement
@@ -850,7 +855,10 @@ class MonClient:
             if rc == -11:  # EAGAIN: that mon has no leader yet; try next
                 last = (rc, out)
                 continue
-            self._active = rank  # stick with the mon that answered
+            # affinity hint only: concurrent command() calls may each
+            # stick a different answering mon and ANY of them is a
+            # valid next-attempt start -- no invariant to clobber
+            self._active = rank  # cephlint: disable=async-rmw-across-await
             return rc, out
         return last
 

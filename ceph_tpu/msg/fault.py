@@ -117,7 +117,20 @@ class FaultInjector:
             return False
         self._kill_after_apply = None
         self.apply_kills += 1
+        self._notify_tear(f"apply-window kill ({kind})")
         return True
+
+    @staticmethod
+    def _notify_tear(kind: str) -> None:
+        """Report an injected tear to the runtime atomic-section
+        verifier (tier-1 asserts tears only cross watermark-safe
+        states: no task parked inside a declared section).  A no-op
+        when the verifier is not installed."""
+        try:
+            from ceph_tpu.analysis import runtime as _runtime
+        except ImportError:  # analysis stripped from a deploy: fine
+            return
+        _runtime.on_tear(kind)
 
     # -- connection-level injection (torn-burst manufacture) ---------------
 
@@ -139,4 +152,5 @@ class FaultInjector:
         split = self._conn_kill_countdown
         self._conn_kill_countdown = None
         self.conn_kills += 1
+        self._notify_tear("mid-burst connection kill")
         return split

@@ -579,6 +579,7 @@ class TCPMessenger:
             # v4 piggyback: a trailing cumulative ack for OUR reverse
             # stream to this peer rides the data frame (v3 senders never
             # append it; v3 receivers never read this far)
+            # cephlint: wire-optional -- v3 senders end at the blob
             if dec.remaining():
                 back_ack = dec.varint()
                 if back_ack:
@@ -607,13 +608,21 @@ class TCPMessenger:
                             session_key, peer_node, in_key)
                 else:
                     await self._ack_now(writer, session_key, seq)
+                # The PR-3 invariant, now machine-enforced: the dup
+                # check and the watermark advance are one indivisible
+                # step, AFTER every await that can tear this connection
+                # down (the per-message ack drain above).  An await
+                # slipped between them lets the conn die with the
+                # watermark past an undelivered message, so the
+                # reconnect replay skips it -- silent loss.  The static
+                # rule flags any yield inside; the runtime verifier
+                # (analysis/runtime.py) asserts no task ever suspends
+                # here under tier-1.
+                # cephlint: atomic-section msgr-watermark-ordering
                 if seq <= self._in_seqs.get(in_key, 0):
                     continue  # duplicate from a replay: already delivered
-                # the watermark advances only AFTER every await that can
-                # tear this connection down (the per-message ack drain
-                # above): a watermark past an undelivered message would
-                # make the reconnect replay skip it -- silent loss
                 self._in_seqs[in_key] = seq
+                # cephlint: end-atomic-section
             msg = decode_message(body)
             queue = self._local_queues.get(dst)
             if queue is not None and dst not in self._marked_down:
@@ -625,7 +634,12 @@ class TCPMessenger:
                     # themselves stuck behind the throttle -- a
                     # distributed deadlock
                     cost = len(rec)
-                    await self.dispatch_throttle.get(cost)
+                    # deliberate budget HAND-OFF, not a leak: the cost
+                    # rides the queue item and _dispatch_one releases
+                    # it (or passes release to the claiming OSD) after
+                    # the dispatcher runs -- that hand-off is what
+                    # makes the byte cap a real memory bound
+                    await self.dispatch_throttle.get(cost)  # cephlint: disable=async-lock-across-await
                     queue.put_nowait((src, msg, cost))
                 else:
                     # unbounded queue: put() never blocks, put_nowait

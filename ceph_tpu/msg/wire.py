@@ -138,7 +138,7 @@ def decode_message(data: bytes) -> object:
             at_version=at_version, log_entries=entries,
             op_class=dec.string(), rollback=dec.value(),
             prev_version=dec.value(),
-            # trailing-field compat: pre-reqid senders end here
+            # cephlint: wire-optional -- pre-reqid senders end here
             reqid=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_WRITE_REPLY:

@@ -1713,6 +1713,12 @@ class PG:
             for osd_name, last in fetches
         ))
         merged = 0
+        # merge + watermark advance are one indivisible step: a
+        # concurrent peering pass that observes the advanced
+        # _peer_dup_seq must be able to rely on these entries already
+        # sitting in the host log -- a task switch between them would
+        # let that pass skip (and never re-fetch) the gap.
+        # cephlint: atomic-section peering-dup-merge
         for (osd_name, last), r in zip(fetches, results):
             rep = r.get(osd_name)
             if rep is None:
@@ -1727,6 +1733,7 @@ class PG:
                 merged += 1
             self._peer_dup_seq[osd_name] = max(
                 maxseq, int(rep.get("head", 0)))
+        # cephlint: end-atomic-section
         if merged:
             self.perf.inc("dup_entries_merged", merged)
         return merged

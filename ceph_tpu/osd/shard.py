@@ -370,12 +370,17 @@ class OSDShard:
         repaired = 0
         scanned = 0
         n = len(bases)
-        start = self._scrub_cursor % n
-        for i in range(n):
+        for _ in range(n):
             if scanned >= limit:
                 break
-            base = bases[(start + i) % n]
-            self._scrub_cursor = (start + i + 1) % n
+            # advance from the LIVE cursor each step (not a start-of-
+            # round snapshot): the deep_scrub awaits below yield, and a
+            # concurrent tick deriving positions from a stale snapshot
+            # would re-walk this round's objects (asyncsan
+            # rmw-across-await); live advance makes overlapping rounds
+            # cooperate instead
+            base = bases[self._scrub_cursor % n]
+            self._scrub_cursor = (self._scrub_cursor % n + 1) % n
             base_tag = getattr(self, "_scrub_pool_tags", {}).get(base)
             for backend in self.pools.values():
                 if not backend._pool_match(base_tag):
@@ -811,13 +816,20 @@ class OSDShard:
                         "version": ver, "omap": omap,
                     })
                     return
+            # The PR-5 exactly-once invariant, machine-enforced: the
+            # compare, the dup record, the swap and the transaction
+            # queue are ONE indivisible step (the "zero-width
+            # dup-detection window").  An await slipped inside lets a
+            # replayed CAS re-run the compare against post-apply state
+            # (false failure) or apply twice before the dup lands.
+            # cephlint: atomic-section omap-cas-dup-with-apply
             cur = omap.get(key)
             success = cur == expect
             ver = (self.store.getattr(soid, "_meta_version") or 0
                    if self.store.exists(soid) else 0)
             if reqid is not None:
-                # recorded with the compare itself (zero-width window);
-                # the result is final whether or not the swap applied
+                # recorded with the compare itself; the result is
+                # final whether or not the swap applied
                 self.pglog.record_dup(reqid, [success, cur], oid=oid)
             if success:
                 ver += 1
@@ -834,6 +846,7 @@ class OSDShard:
                 if msg.get("pool") is not None:
                     txn.setattr(soid, POOL_KEY, msg["pool"])
                 self.store.queue_transaction(txn)
+            # cephlint: end-atomic-section
             await self.messenger.send_message(self.name, src, {
                 "op": "omap_cas_reply", "tid": msg["tid"],
                 "success": success, "current": cur, "version": ver,
@@ -1176,6 +1189,13 @@ class OSDShard:
                 )
             await self.messenger.send_message(self.name, src, reply)
             return
+        # From the version stamp to queue_transaction is ONE indivisible
+        # apply step: the stale gate above was evaluated against
+        # _applied_version, and a task switch before the transaction
+        # lands would let a racing sub-write interleave between gate
+        # and apply (clobbering newer bytes) or observe the version
+        # advanced with the dup entry/log append missing.
+        # cephlint: atomic-section sub-write-apply
         self._applied_version[soid] = new_vt
         # device-tier coherence: an applied sub-write proves any resident
         # copy stale UNLESS it belongs to this very write (the primary's
@@ -1222,6 +1242,7 @@ class OSDShard:
                                   version=new_vt)
         self.pglog.maybe_trim()
         self.store.queue_transaction(msg.transaction)
+        # cephlint: end-atomic-section
         self.perf.inc("sub_write")
         reply = ECSubWriteReply(
             from_shard=msg.from_shard, tid=msg.tid, committed=True, applied=True

@@ -25,6 +25,7 @@ registered clients.
 
 from __future__ import annotations
 
+import asyncio
 from typing import List, Optional, Tuple
 
 from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
@@ -46,6 +47,11 @@ class Journaler:
         self.write_pos = 0
         self.expire_pos = 0
         self.commit_pos = 0
+        #: serializes append(): two concurrent appenders would read the
+        #: same write_pos, stripe both records over the same extent and
+        #: lose one (asyncsan rmw-across-await; the reference Journaler
+        #: serializes appends on its lock too)
+        self._append_lock = asyncio.Lock()
 
     @property
     def _header(self) -> str:
@@ -80,17 +86,19 @@ class Journaler:
         (the reference pads with a skip entry at object boundaries)."""
         rec = frame(_enc(entry))
         osz = self.object_size
-        start = self.write_pos
-        if start // osz != (start + len(rec) - 1) // osz:
-            start = ((start // osz) + 1) * osz  # skip to the next object
-        objno, off = divmod(start, osz)
-        await self.backend.write_range(self._data(objno), off, rec)
-        self.write_pos = start + len(rec)
-        # persist only the field this writer owns: the header is shared
-        # with committers and trimmers (e.g. a mirror daemon) whose
-        # in-memory copies of the OTHER pointers may be stale
-        await self.backend.omap_set(
-            self._header, {"write_pos": _enc(self.write_pos)})
+        async with self._append_lock:
+            start = self.write_pos
+            if start // osz != (start + len(rec) - 1) // osz:
+                start = ((start // osz) + 1) * osz  # next object
+            objno, off = divmod(start, osz)
+            await self.backend.write_range(self._data(objno), off, rec)
+            self.write_pos = start + len(rec)
+            # persist only the field this writer owns: the header is
+            # shared with committers and trimmers (e.g. a mirror
+            # daemon) whose in-memory copies of the OTHER pointers may
+            # be stale
+            await self.backend.omap_set(
+                self._header, {"write_pos": _enc(self.write_pos)})
         return start
 
     # -- replay (Journaler::read_entry loop) -------------------------------

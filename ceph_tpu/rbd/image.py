@@ -12,6 +12,8 @@ dependency by copying every still-inherited block.
 
 from __future__ import annotations
 
+import contextvars
+
 from typing import Dict, List, Optional
 
 from ceph_tpu.osdc.striper import FileLayout, Striper
@@ -22,6 +24,12 @@ from ceph_tpu.rbd.journal import (FEATURE_JOURNALING, MIRROR_DIR_OID,
                                   ImageJournal, apply_event,
                                   destroy_journal)
 from ceph_tpu.utils.encoding import Decoder, Encoder
+
+#: images (by instance id) whose journal events are being re-applied in
+#: the CURRENT task -- see Image._replay_mode for why this is a
+#: contextvar rather than an instance flag
+_REPLAYING: contextvars.ContextVar = contextvars.ContextVar(
+    "rbd_replaying", default=frozenset())
 
 _DIR_OID = "rbd_directory"
 
@@ -155,9 +163,6 @@ class Image:
         self.parent = parent
         self.features: List[str] = features or []
         self._journal: Optional[ImageJournal] = None
-        # set while re-applying journal events so the mutators below run
-        # their plain data path instead of re-journaling (librbd Replay)
-        self._replay_mode = False
         self.read_snap_id: Optional[int] = None
         if read_snap is not None:
             ent = snaps.get(read_snap)
@@ -223,18 +228,33 @@ class Image:
                 f"image {self.name} is non-primary (demoted); promote "
                 "it or write on the primary peer")
 
+    @property
+    def _replay_mode(self) -> bool:
+        """True while THIS task re-applies journal events to this image
+        (librbd Replay's re-entrancy marker): the mutators run their
+        plain data path instead of re-journaling.  Task-local by
+        construction (a contextvar, not an instance flag): two client
+        ops journaling concurrently must not see each other's replay
+        state -- an instance bool cleared by whichever op finished
+        first would let the other's nested mutators re-journal
+        mid-apply (the asyncsan rmw-across-await class)."""
+        return id(self) in _REPLAYING.get()
+
+    def _enter_replay(self):
+        return _REPLAYING.set(_REPLAYING.get() | {id(self)})
+
     async def _crash_replay(self) -> None:
         """Re-apply journal events past the commit position (a writer
         crashed between append and commit -- librbd Journal replay on
         dirty open)."""
         entries = await self._journal.uncommitted()
-        self._replay_mode = True
+        token = self._enter_replay()
         try:
             for _start, end, ev in entries:
                 await apply_event(self, ev)
                 await self._journal.commit(end)
         finally:
-            self._replay_mode = False
+            _REPLAYING.reset(token)
 
     async def _journaled(self, event: dict) -> bool:
         """Record ``event`` in the image journal, apply it through the
@@ -243,11 +263,11 @@ class Image:
         if self._journal is None or self._replay_mode:
             return False
         _start, end = await self._journal.append(event)
-        self._replay_mode = True
+        token = self._enter_replay()
         try:
             await apply_event(self, event)
         finally:
-            self._replay_mode = False
+            _REPLAYING.reset(token)
         await self._journal.commit(end)
         return True
 
@@ -495,18 +515,22 @@ class Image:
     async def flatten(self) -> None:
         """Copy every still-inherited block from the parent and sever
         the dependency (librbd::Image::flatten)."""
-        if self.parent is None:
+        # snapshot the link once: a concurrent flatten nulling
+        # self.parent between the copy-up awaits would crash the
+        # dereferences below (asyncsan rmw-across-await window)
+        parent = self.parent
+        if parent is None:
             return
         if await self._journaled({"op": "flatten"}):
             return
         osz = 1 << self.order
-        overlap = self.parent["overlap"]
+        overlap = parent["overlap"]
         for object_no in range((overlap + osz - 1) // osz):
             if await self._object_absent(_data_oid(self.name, object_no)):
                 await self._copy_up(object_no)
         await self.backend.exec(
-            _header_oid(self.parent["image"]), "rbd", "remove_child",
-            _enc({"snap_id": self.parent["snap_id"], "child": self.name}),
+            _header_oid(parent["image"]), "rbd", "remove_child",
+            _enc({"snap_id": parent["snap_id"], "child": self.name}),
         )
         await self.backend.exec(
             _header_oid(self.name), "rbd", "remove_parent", b"")

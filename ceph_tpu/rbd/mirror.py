@@ -225,7 +225,10 @@ class ImageReplayer:
             # a restarted daemon resumes from the persisted position
             # instead of re-copying the whole image
             if await jr.j.client_pos(self.peer_id) is not None:
-                self._bootstrapped = True
+                # one-way latch: every writer stores True, so two
+                # replay_once calls racing this window agree on the
+                # value -- nothing to clobber
+                self._bootstrapped = True  # cephlint: disable=async-rmw-across-await
             else:
                 await self.bootstrap()
         entries = await jr.peer_entries(self.peer_id)

@@ -67,6 +67,13 @@ EINVAL = 22
 #: buffer 4 GiB -- the dispatch-throttle class of problem)
 MAX_PAYLOAD = 32 << 20
 
+#: flow-control high-water mark: drain() is awaited only once this many
+#: bytes sit unflushed on the transport.  Replies reach the wire
+#: asynchronously as soon as they are written; a per-reply drain is one
+#: coroutine round of pure overhead per request (the round-8 corked-
+#: messenger discipline: drain is backpressure, not delivery)
+DRAIN_HIWAT = 1 << 20
+
 
 class NBDServer:
     """Serve the pool's RBD images over NBD (one export per image)."""
@@ -112,6 +119,16 @@ class NBDServer:
             self._serve_tasks.discard(task)
             writer.close()
 
+    @staticmethod
+    async def _pace(writer) -> None:
+        """Backpressure only: a slow or stalled client eventually fills
+        the transport buffer and this parks the handler until it
+        drains, bounding per-connection memory.  Everything below the
+        high-water mark flushes asynchronously without costing a
+        coroutine round per reply."""
+        if writer.transport.get_write_buffer_size() >= DRAIN_HIWAT:
+            await writer.drain()
+
     async def _serve_inner(self, reader, writer) -> None:
         # -- fixed-newstyle handshake --------------------------------------
         writer.write(struct.pack(
@@ -138,7 +155,7 @@ class NBDServer:
                 if not client_flags & FLAG_NO_ZEROES:
                     out += bytes(124)
                 writer.write(out)
-                await writer.drain()
+                await self._pace(writer)
             elif opt == OPT_LIST:
                 from ceph_tpu.rbd.image import RBD
 
@@ -149,16 +166,15 @@ class NBDServer:
                     ) + payload)
                 writer.write(struct.pack(">QIII", REP_MAGIC, opt,
                                          REP_ACK, 0))
-                await writer.drain()
+                await self._pace(writer)
             elif opt == OPT_ABORT:
                 writer.write(struct.pack(">QIII", REP_MAGIC, opt,
                                          REP_ACK, 0))
-                await writer.drain()
-                return
+                return  # close() flushes the ack on the way out
             else:
                 writer.write(struct.pack(">QIII", REP_MAGIC, opt,
                                          REP_ERR_UNSUP, 0))
-                await writer.drain()
+                await self._pace(writer)
 
         # -- transmission phase --------------------------------------------
         while True:
@@ -172,7 +188,7 @@ class NBDServer:
                     return  # cannot resync past an absurd payload: drop
                 writer.write(struct.pack(
                     ">IIQ", REPLY_MAGIC, EINVAL, handle))
-                await writer.drain()
+                await self._pace(writer)
                 continue
             payload = (await reader.readexactly(length)
                        if cmd == CMD_WRITE else b"")
@@ -206,7 +222,7 @@ class NBDServer:
             writer.write(struct.pack(">IIQ", REPLY_MAGIC, err, handle))
             if cmd == CMD_READ and not err:
                 writer.write(out)
-            await writer.drain()
+            await self._pace(writer)
 
     def _count(self, op: str) -> None:
         self.stats[op] = self.stats.get(op, 0) + 1

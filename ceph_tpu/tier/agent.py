@@ -135,17 +135,33 @@ class TierAgent:
 
         stats["flushed"] = shard.tier.flush_dirty()
 
-        items = []
-        for pool, backend, oid in self._promotion_candidates(
-            active, limit, thresh
-        ):
-            got = await self._gather_block(backend, oid)
-            if got is None:
-                continue
-            block, version, logical_size = got
-            items.append((pool, oid, block, version, logical_size))
-        if items:
-            stats["promoted"] = shard.tier.put_many(items)
+        # the consistent-cut gathers below span awaits; a sub-write
+        # applying inside that window invalidates BEFORE the block is
+        # resident (a no-op) and put_many would insert the stale cut.
+        # The watch collects every invalidated oid for the window so
+        # the insert step can drop them (asyncsan rmw-across-await at
+        # the tier layer; a false drop just defers one tick).
+        watch = shard.tier.watch_invalidations()
+        try:
+            items = []
+            for pool, backend, oid in self._promotion_candidates(
+                active, limit, thresh
+            ):
+                got = await self._gather_block(backend, oid)
+                if got is None or oid in watch:
+                    continue
+                block, version, logical_size = got
+                items.append((pool, oid, block, version, logical_size))
+            if items:
+                # filter + insert must be ONE yield-free step or the
+                # window the watch closes reopens between them
+                # cephlint: atomic-section tier-promote-cut
+                fresh = [it for it in items if it[1] not in watch]
+                if fresh:
+                    stats["promoted"] = shard.tier.put_many(fresh)
+                # cephlint: end-atomic-section
+        finally:
+            shard.tier.unwatch(watch)
 
         stats["evicted_bytes"] = shard.tier.evict_to_budget()
         return stats

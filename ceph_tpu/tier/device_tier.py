@@ -143,6 +143,9 @@ class DeviceTierStore:
             OrderedDict()
         self._seq = 0
         self._resident_bytes = 0
+        #: live invalidation-watch sets (the promotion agent's
+        #: stale-gather coherence hook, see watch_invalidations)
+        self._invalidation_watchers: List[set] = []
         self.hits = 0
         self.misses = 0
 
@@ -303,9 +306,32 @@ class DeviceTierStore:
 
     # -- invalidation ------------------------------------------------------
 
+    def watch_invalidations(self) -> set:
+        """Start collecting invalidated oids into a fresh set (returned;
+        stop with :meth:`unwatch`).  The promotion agent's coherence
+        hook: its consistent-cut gathers span awaits, and an
+        invalidation landing in that window would otherwise no-op (the
+        entry is not resident yet) and let ``put_many`` insert a stale
+        block right after -- the asyncsan rmw-across-await class at the
+        tier layer."""
+        watch: set = set()
+        self._invalidation_watchers.append(watch)
+        return watch
+
+    def unwatch(self, watch: set) -> None:
+        try:
+            self._invalidation_watchers.remove(watch)
+        except ValueError:
+            pass
+
+    def _note_invalidated(self, oid: str) -> None:
+        for watch in self._invalidation_watchers:
+            watch.add(oid)
+
     def invalidate(self, pool: Optional[str], oid: str) -> bool:
         with self._lock:
             ent = self._entries.pop((pool, oid), None)
+            self._note_invalidated(oid)
             if ent is None:
                 return False
             self._resident_bytes -= ent.nbytes
@@ -322,6 +348,11 @@ class DeviceTierStore:
         any other applied write proves the copy stale."""
         dropped = 0
         with self._lock:
+            # watchers hear about the oid even when nothing is resident
+            # (the whole point: an in-flight promotion gather must drop
+            # it); a conservative false drop only defers the promotion
+            # to the next agent tick
+            self._note_invalidated(oid)
             for key in [k for k in self._entries if k[1] == oid]:
                 ent = self._entries[key]
                 if keep_version is not None and \

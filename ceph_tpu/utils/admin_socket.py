@@ -45,10 +45,13 @@ class AdminSocket:
         return self.path
 
     async def stop(self) -> None:
-        if self._server is not None:
-            self._server.close()
-            await self._server.wait_closed()
-            self._server = None
+        # swap-then-await: claim the server synchronously so concurrent
+        # stop() calls cannot both pass the None check and one of them
+        # close a server the other is still awaiting on
+        server, self._server = self._server, None
+        if server is not None:
+            server.close()
+            await server.wait_closed()
         try:
             os.unlink(self.path)
         except FileNotFoundError:

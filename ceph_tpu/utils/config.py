@@ -173,6 +173,12 @@ OPTIONS: Dict[str, Option] = {
         _opt("cli_state", str, "", LEVEL_DEV,
              "path of the ceph CLI's persisted mini-cluster state file "
              "(tools/ceph_cli.py; empty = its per-user default)"),
+        _opt("atomic_verify", bool, True, LEVEL_DEV,
+             "tier-1 runtime atomic-section verifier "
+             "(analysis/runtime.py via tests/conftest.py): every event "
+             "loop's task factory checks that no task ever suspends "
+             "inside a declared `cephlint: atomic-section` region; "
+             "CEPH_TPU_ATOMIC_VERIFY=0 disables the instrumentation"),
         _opt("bench_probe_timeout", float, 120.0, LEVEL_DEV,
              "seconds bench.py allows each TPU availability probe"),
         _opt("bench_retry_secs", float, 600.0, LEVEL_DEV,

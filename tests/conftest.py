@@ -25,3 +25,41 @@ except AttributeError:
     # older jax: the XLA_FLAGS device-count override above is the only
     # (and sufficient) way to get the 8-device virtual mesh
     pass
+
+# -- runtime atomic-section verifier (analysis/runtime.py) -----------------
+# Tier-1 runs every event loop through a verifying task factory: each
+# yield-to-the-loop walks the suspended await chain and records a
+# violation if any frame is parked inside a declared atomic section
+# (the regions `cephlint: atomic-section <name>` marks yield-free).
+# The static rule proves the lexical property; this proves the runtime
+# one, so the annotations are tested, not trusted.  Disable with
+# CEPH_TPU_ATOMIC_VERIFY=0.
+
+import pytest  # noqa: E402
+
+_ATOMIC_VERIFIER = None
+if os.environ.get("CEPH_TPU_ATOMIC_VERIFY", "1") != "0":
+    from ceph_tpu.analysis import runtime as _atomic_runtime
+
+    _ATOMIC_VERIFIER = _atomic_runtime.install()
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    """Attribute atomic-section violations to the test whose event
+    loop produced them: the test that drove a task switch through a
+    declared yield-free region fails, right there."""
+    before = len(_ATOMIC_VERIFIER.violations) if _ATOMIC_VERIFIER else 0
+    yield
+    if _ATOMIC_VERIFIER is None:
+        return
+    fresh = _ATOMIC_VERIFIER.violations[before:]
+    if fresh:
+        del _ATOMIC_VERIFIER.violations[before:]
+        lines = "\n".join(f"  {v!r}" for v in fresh)
+        pytest.fail(
+            "task switch inside declared atomic section(s) -- the "
+            "region is marked yield-free and other code relies on "
+            f"that invariant:\n{lines}",
+            pytrace=False,
+        )

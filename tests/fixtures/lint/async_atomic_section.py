@@ -1,0 +1,29 @@
+"""Fixture: async-atomic-section.
+
+A declared yield-free region containing any task-switch point is a
+finding; so are malformed marker pairs.  The clean section shows the
+contract holding: state mutations grouped with no await between the
+markers.
+"""
+import asyncio
+
+
+class Daemon:
+    async def violating_section(self):
+        # cephlint: atomic-section boot-window
+        self.ready = True
+        await asyncio.sleep(0)  # LINT: async-atomic-section
+        self.pools["a"] = object()
+        # cephlint: end-atomic-section
+
+    async def clean_section(self):
+        await asyncio.sleep(0)
+        # cephlint: atomic-section apply-step
+        self.version += 1
+        self.log.append(self.version)
+        # cephlint: end-atomic-section
+        return self.version
+
+
+# an end marker with no open section is malformed
+# cephlint: end-atomic-section  # LINT: async-atomic-section

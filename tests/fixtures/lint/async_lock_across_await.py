@@ -1,0 +1,46 @@
+"""Fixture: async-lock-across-await.
+
+Locks and admission tokens (throttle/budget/ledger ``get``) held at a
+task-switch point with no try/finally release leak on the failure
+path; ``async with``, try/finally, and release-before-yield are the
+sanctioned shapes.
+"""
+import asyncio
+
+
+class Budgeted:
+    async def leak_lock(self):
+        await self.cache_lock.acquire()  # LINT: async-lock-across-await
+        await asyncio.sleep(0)
+        self.cache_lock.release()
+
+    async def leak_token(self):
+        await self.byte_throttle.get(100)  # LINT: async-lock-across-await
+        await self.fan_out()
+        self.byte_throttle.put(100)
+
+    # -- negatives ---------------------------------------------------------
+
+    async def finally_releases(self):
+        await self.cache_lock.acquire()
+        try:
+            await asyncio.sleep(0)
+        finally:
+            self.cache_lock.release()
+
+    async def async_with_is_sanctioned(self):
+        async with self.cache_lock:
+            await asyncio.sleep(0)
+
+    async def released_before_any_yield(self):
+        await self.byte_throttle.get(1)
+        self.byte_throttle.put(1)
+        await asyncio.sleep(0)
+
+    async def queue_get_is_not_a_token(self):
+        item = await self.inbox.get()
+        await asyncio.sleep(0)
+        return item
+
+    async def fan_out(self):
+        await asyncio.sleep(0)

@@ -1,0 +1,68 @@
+"""Fixture: async-rmw-across-await (flow-aware + interprocedural).
+
+The positives cover every detected shape: a stale-read carrier split
+across a direct await, the same split across a call to a helper that
+only TRANSITIVELY awaits (may-await propagation through the module
+call graph -- the acceptance-criteria case), a one-statement RMW whose
+value awaits, an augmented assign whose value awaits, and
+check-then-act.  The negatives pin the precision claims: awaiting an
+async helper that provably never yields is NOT a task-switch point,
+a span held under ``async with ...lock`` is sanctioned, and a fresh
+re-check after the last await suppresses the check-then-act report.
+"""
+import asyncio
+
+
+class Counter:
+    async def _sleeps(self):
+        await asyncio.sleep(0)
+
+    async def _pure(self):
+        return 41  # an async def with no awaits: runs to completion
+        # synchronously when awaited -- it can never suspend the task
+
+    async def _via_helper(self):
+        # may-await reaches this function only transitively: it awaits
+        # _sleeps, which awaits the event loop
+        await self._sleeps()
+
+    async def rmw_direct(self):
+        stale = self.count
+        await asyncio.sleep(0)
+        self.count = stale + 1  # LINT: async-rmw-across-await
+
+    async def rmw_through_awaiting_helper(self):
+        stale = self.count
+        await self._via_helper()
+        self.count = stale + 1  # LINT: async-rmw-across-await
+
+    async def rmw_same_statement(self):
+        self.count = max(self.count, await self._sleeps())  # LINT: async-rmw-across-await
+
+    async def rmw_augassign(self):
+        self.count += await self._sleeps()  # LINT: async-rmw-across-await
+
+    async def check_then_act(self):
+        if self.state == "idle":
+            await asyncio.sleep(0)
+            self.state = "busy"  # LINT: async-rmw-across-await
+
+    # -- negatives ---------------------------------------------------------
+
+    async def pure_helper_is_not_a_switch(self):
+        stale = self.count
+        await self._pure()  # cannot suspend: nothing to flag
+        self.count = stale + 1
+
+    async def lock_protected_span(self):
+        async with self.state_lock:
+            stale = self.count
+            await asyncio.sleep(0)
+            self.count = stale + 1
+
+    async def fresh_recheck_after_await(self):
+        if self.state == "idle":
+            await asyncio.sleep(0)
+            if self.state != "idle":
+                return  # re-checked against LIVE state: sanctioned fix
+            self.state = "busy"

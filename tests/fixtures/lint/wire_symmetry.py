@@ -1,0 +1,145 @@
+"""Fixture: wire-schema-symmetry / wire-trailing-compat /
+wire-version-pairing.
+
+Paired encode*/decode* bodies linearize into field sequences; the
+positives cover a reordered field, a one-sided trailing field, an
+unguarded field after an optional one, a dispatcher-branch retype, a
+write-only serializer and a version-const drift.  The negatives are
+the sanctioned evolutions: identical sequences, a guarded OPTIONAL
+suffix (the pre-reqid ECSubWrite shape), and loop-structured nested
+records.
+"""
+from ceph_tpu.utils.encoding import Decoder, Encoder
+
+HEADER_VERSION = 3
+_MSG_PING = 1
+
+
+# -- reordered fields ------------------------------------------------------
+
+def encode_reordered(enc, rec):
+    enc.varint(rec.seq).string(rec.name)
+
+
+def decode_reordered(dec):
+    name = dec.string()  # LINT: wire-schema-symmetry
+    seq = dec.varint()
+    return name, seq
+
+
+# -- one-sided trailing field (unguarded length skew) ----------------------
+
+def encode_skewed(enc, rec):
+    enc.varint(rec.seq)
+    enc.blob(rec.payload)  # LINT: wire-schema-symmetry
+
+
+def decode_skewed(dec):
+    return dec.varint()
+
+
+# -- unguarded field after an optional one ---------------------------------
+
+def encode_optional(enc, rec):
+    enc.varint(rec.seq)
+    enc.value(rec.extra)
+    enc.string(rec.name)
+
+
+def decode_optional(dec):
+    seq = dec.varint()
+    extra = dec.value() if dec.remaining() else None
+    name = dec.string()  # LINT: wire-trailing-compat
+    return seq, extra, name
+
+
+# -- version pairing -------------------------------------------------------
+
+class WriteOnlyRecord:
+    def encode(self) -> bytes:  # LINT: wire-version-pairing
+        return Encoder().u8(HEADER_VERSION).string("x").bytes()
+
+
+class VersionSkewRecord:
+    # encode stamps HEADER_VERSION but decode never reads it back
+    def encode(self) -> bytes:  # LINT: wire-version-pairing
+        return Encoder().u8(HEADER_VERSION).string("x").bytes()
+
+    @classmethod
+    def decode(cls, data: bytes) -> "VersionSkewRecord":
+        dec = Decoder(data)
+        dec.u8()  # version byte dropped on the floor
+        return cls()
+
+
+def decode_orphan_entry(data):  # LINT: wire-version-pairing
+    # reader with no writer: the one-sided twin is also flagged
+    return Decoder(data).varint()
+
+
+# -- dispatcher branches (the msg/wire.py message_encoder shape) -----------
+
+def message_encoder(msg, enc):
+    if isinstance(msg, tuple):
+        enc.u8(_MSG_PING)
+        enc.varint(msg[0])
+        enc.string(msg[1])
+    return enc
+
+
+def encode_message(msg) -> bytes:
+    return message_encoder(msg, Encoder()).bytes()
+
+
+def decode_message(data):
+    dec = Decoder(data)
+    kind = dec.u8()
+    if kind == _MSG_PING:
+        return dec.varint(), dec.blob()  # LINT: wire-schema-symmetry
+    raise ValueError(kind)
+
+
+# -- negatives: the sanctioned shapes --------------------------------------
+
+def encode_entry(enc, e):
+    enc.varint(e.version).string(e.oid)
+    enc.varint(len(e.parts))
+    for part in e.parts:
+        enc.blob(part)
+
+
+def decode_entry(dec):
+    version = dec.varint()
+    oid = dec.string()
+    parts = [dec.blob() for _ in range(dec.varint())]
+    return version, oid, parts
+
+
+def encode_compat(enc, rec):
+    enc.varint(rec.seq)
+    enc.value(rec.reqid)  # appended field: old decoders stop before it
+
+
+def decode_compat(dec):
+    seq = dec.varint()
+    # cephlint: wire-optional -- pre-reqid senders end here (the
+    # ECSubWrite evolution rule from PR 5, machine-checked)
+    reqid = dec.value() if dec.remaining() else None
+    return seq, reqid
+
+
+# -- declared guard deleted by a "simplifying" refactor --------------------
+# The comment survives the refactor that drops the remaining() guard;
+# the declaration is exactly what keeps the compat rule enforceable
+# once no guard is left for the suffix rule to anchor on.
+
+def encode_degraded(enc, rec):
+    enc.varint(rec.seq)
+    enc.value(rec.reqid)
+
+
+def decode_degraded(dec):
+    seq = dec.varint()
+    # cephlint: wire-optional -- pre-reqid senders end here
+    reqid = dec.value()  # LINT: wire-trailing-compat
+    return seq, reqid

@@ -3,6 +3,8 @@
 
   python tools/cephlint.py ceph_tpu tools tests
   python tools/cephlint.py --format json ceph_tpu | jq .lint_findings_total
+  python tools/cephlint.py --changed                 # git-diff scope
+  python tools/cephlint.py --rule async-rmw-across-await ceph_tpu
   python tools/cephlint.py --write-baseline ceph_tpu tools tests
   python tools/cephlint.py --list-rules
 
@@ -15,6 +17,7 @@ suppression syntax and the baseline workflow.
 from __future__ import annotations
 
 import argparse
+import json
 import os
 import sys
 
@@ -49,6 +52,15 @@ def main(argv=None) -> int:
     ap.add_argument("--include-fixtures", action="store_true",
                     help="also scan tests/fixtures/lint (the deliberate "
                          "positive examples; excluded by default)")
+    ap.add_argument("--rule", action="append", default=None,
+                    metavar="NAME",
+                    help="run only this rule (repeatable); unknown "
+                         "names list the valid spellings")
+    ap.add_argument("--changed", action="store_true",
+                    help="scan only .py files differing from git HEAD "
+                         "(staged, unstaged and untracked) -- the fast "
+                         "pre-commit/bench scope; exits 0 immediately "
+                         "when nothing changed")
     ap.add_argument("--list-rules", action="store_true")
     args = ap.parse_args(argv)
 
@@ -60,6 +72,20 @@ def main(argv=None) -> int:
     root = runner.repo_root()
     paths = args.paths or ["ceph_tpu", "tools", "tests"]
     excludes = () if args.include_fixtures else runner.DEFAULT_EXCLUDES
+    if args.changed:
+        changed = runner.changed_files(root)
+        scopes = tuple(p.rstrip("/") + "/" for p in paths)
+        paths = [c for c in changed
+                 if any(c.startswith(s) for s in scopes)
+                 and not any(c.startswith(e) for e in excludes)]
+        if not paths:
+            if args.format == "json":
+                from ceph_tpu.analysis.runner import ScanResult
+
+                print(json.dumps(ScanResult().to_dict(), indent=2))
+            else:
+                print("cephlint: no changed files in scope")
+            return 0
 
     baseline_path = args.baseline
     if baseline_path is None:
@@ -81,9 +107,13 @@ def main(argv=None) -> int:
               f"entries to {os.path.relpath(out_path, root)}")
         return 0
 
-    code, out = runner.run(paths, fmt=args.format,
-                           baseline_path=baseline_path, root=root,
-                           excludes=excludes)
+    try:
+        code, out = runner.run(paths, fmt=args.format,
+                               baseline_path=baseline_path, root=root,
+                               excludes=excludes, rules=args.rule)
+    except ValueError as e:  # unknown --rule name
+        print(f"cephlint: {e}", file=sys.stderr)
+        return 2
     print(out)
     return code
 
