@@ -426,10 +426,30 @@ def main() -> int:
     ]
     const_payload = np.full(SIZE, ord("X"), dtype=np.uint8)  # reference fill
 
+    # -- per-stage residency accounting (analysis/residency.py) ------------
+    # Every bench stage runs between two snapshots of the process
+    # transfer/retrace ledger, so a residency regression (a new D2H on
+    # the write path, a per-shape recompile) shows up as a NUMBER in
+    # the round artifact, not a vibe.  The counters see the counted
+    # seams (pipeline dispatch/landing, tier transfers, engine
+    # matrix/data uploads) plus every XLA backend compile.
+    from ceph_tpu.analysis import residency as residency_mod
+
+    stage_residency = {}
+
+    def _staged(name, fn):
+        before = residency_mod.counters().snapshot()
+        out = _secondary(fn)
+        after = residency_mod.counters().snapshot()
+        stage_residency[name] = residency_mod.ResidencyCounters.delta(
+            before, after)
+        return out
+
     # -- TPU plugin at the tool surface (host-to-host, honest) -------------
     tpu_ec = registry.factory("tpu", dict(profile), "")
     prior_cache_env = os.environ.get("CEPH_TPU_NO_H2D_CACHE")
     os.environ["CEPH_TPU_NO_H2D_CACHE"] = "1"
+    _tool_before = residency_mod.counters().snapshot()
     try:
         enc = _tool_encode_gibps(tpu_ec, stripes, ITERS)
         dec = _tool_decode_gibps(tpu_ec, stripes, ITERS)
@@ -442,6 +462,8 @@ def main() -> int:
     # Secondary: the reference benchmark's own semantics (constant 'X'
     # buffer re-encoded each iteration, caches allowed) for comparison.
     enc_cached = _tool_encode_gibps(tpu_ec, [const_payload] * BATCH, ITERS)
+    stage_residency["tool_path"] = residency_mod.ResidencyCounters.delta(
+        _tool_before, residency_mod.counters().snapshot())
 
     # -- CPU baseline plugin, same surface ---------------------------------
     cpu_prof = dict(profile)
@@ -470,9 +492,10 @@ def main() -> int:
                   file=sys.stderr)
             return None
 
-    dev = _secondary(_device_resident_gibps)
-    dev_dec = _secondary(_device_resident_decode_gibps)
-    storage = _secondary(_storage_path_device_gibps)
+    dev = _staged("device_resident", _device_resident_gibps)
+    dev_dec = _staged("device_resident_decode",
+                      _device_resident_decode_gibps)
+    storage = _staged("storage_path_device", _storage_path_device_gibps)
 
     def _storage_path_host():
         """Round-6 tentpole metric: the HOST OSD storage path (assemble /
@@ -486,7 +509,7 @@ def main() -> int:
             tpu_ec, n_objects=64, obj_bytes=1 << 14, writers=8, iters=2
         )
 
-    sp_host = _secondary(_storage_path_host)
+    sp_host = _staged("storage_path_host", _storage_path_host)
 
     def _cluster_path_host():
         """Round-8 tentpole metric: the DISTRIBUTED storage path over
@@ -504,7 +527,7 @@ def main() -> int:
             cpu_ec, n_objects=64, obj_bytes=16 << 10, writers=8, iters=2
         )
 
-    cp_host = _secondary(_cluster_path_host)
+    cp_host = _staged("cluster_path_host", _cluster_path_host)
 
     def _tier_path_host():
         """Round-9 tentpole metric: hot device-resident tier read (one
@@ -519,7 +542,7 @@ def main() -> int:
             cpu_ec, n_objects=64, obj_bytes=1 << 16, iters=2
         )
 
-    tp_host = _secondary(_tier_path_host)
+    tp_host = _staged("tier_path_host", _tier_path_host)
 
     def _failover_path_host():
         """Round-10 robustness metric: client-visible failover cost on
@@ -536,7 +559,7 @@ def main() -> int:
             n_osds=8, n_objects=16, obj_bytes=16 << 10, kills=5
         )
 
-    fo_host = _secondary(_failover_path_host)
+    fo_host = _staged("failover_path_host", _failover_path_host)
 
     def _lint_stage():
         """Static-health trend metrics: unsuppressed cephlint findings
@@ -640,6 +663,16 @@ def main() -> int:
             lint_stage["runtime_secs"] if lint_stage else None),
         "lint_changed_runtime_secs": (
             lint_stage["changed_runtime_secs"] if lint_stage else None),
+        # per-stage transfer/retrace deltas (h2d/d2h ops+bytes,
+        # jit_retraces) -- the residency regression sensor
+        "residency_by_stage": stage_residency,
+        "storage_path_h2d_bytes": (
+            stage_residency.get("storage_path_host", {}).get("h2d_bytes")),
+        "storage_path_d2h_bytes": (
+            stage_residency.get("storage_path_host", {}).get("d2h_bytes")),
+        "storage_path_jit_retraces": (
+            stage_residency.get("storage_path_host", {}).get(
+                "jit_retraces")),
         "platform": jax.devices()[0].platform + (
             "-fallback"
             if os.environ.get("CEPH_TPU_BENCH_FALLBACK")

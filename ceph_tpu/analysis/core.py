@@ -70,6 +70,7 @@ def all_rules() -> Dict[str, Rule]:
     from ceph_tpu.analysis import rules_config  # noqa: F401
     from ceph_tpu.analysis import rules_interleave  # noqa: F401
     from ceph_tpu.analysis import rules_jax  # noqa: F401
+    from ceph_tpu.analysis import rules_residency  # noqa: F401
     from ceph_tpu.analysis import rules_wire  # noqa: F401
 
     return dict(_RULES)
@@ -192,6 +193,18 @@ _ATOMIC_BEGIN = _re.compile(
     r"#\s*cephlint:\s*atomic-section\s+([A-Za-z0-9_.\-]+)")
 _ATOMIC_END = _re.compile(r"#\s*cephlint:\s*end-atomic-section\b")
 
+#: declared device-resident regions: ``cephlint: device-resident-section
+#: <name>`` ... ``cephlint: end-device-resident-section``.  Inside the
+#: markers no value may leave the device (no D2H sink -- np.asarray,
+#: .tolist(), float()/int(), iteration, device_get).  Enforced twice:
+#: statically (rules_residency walks the residency lattice through the
+#: region, helpers included) and at runtime (analysis/residency.py wraps
+#: the paired ``resident_section(name)`` scope in a
+#: jax.transfer_guard_device_to_host("disallow") under tier-1).
+_RESIDENT_BEGIN = _re.compile(
+    r"#\s*cephlint:\s*device-resident-section\s+([A-Za-z0-9_.\-]+)")
+_RESIDENT_END = _re.compile(r"#\s*cephlint:\s*end-device-resident-section\b")
+
 
 @dataclasses.dataclass(frozen=True)
 class AtomicSection:
@@ -203,38 +216,76 @@ class AtomicSection:
     end: int    # 1-based line of the end marker
 
 
-def parse_atomic_sections(lines) -> "Tuple[List[AtomicSection], List[Tuple[int, str]]]":  # noqa: E501
-    """(sections, problems) from a file's source lines.  Problems are
-    (line, message) pairs: an end without a begin, a begin without an
-    end, a begin nested inside an open section."""
+def _comment_line_numbers(lines) -> "Optional[set]":
+    """1-based line numbers that carry a real ``#`` comment token, so
+    marker regexes don't fire on marker text quoted inside string
+    literals (e.g. a test embedding a marked source as a fixture).
+    Returns None when the file doesn't tokenize -- callers fall back to
+    treating every line as eligible."""
+    import io
+    import tokenize
+    src = "\n".join(lines) + "\n"
+    out = set()
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(src).readline):
+            if tok.type == tokenize.COMMENT:
+                out.add(tok.start[0])
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        return None
+    return out
+
+
+def _parse_marked_sections(lines, begin_re, end_re, what: str,
+                           end_spelling: str):
+    """Shared marker-pair parser: (sections, problems) where problems
+    are (line, message) pairs -- an end without a begin, a begin without
+    an end, a begin nested inside an open section."""
     sections: List[AtomicSection] = []
     problems: List[tuple] = []
     open_name: Optional[str] = None
     open_line = 0
+    if not any(begin_re.search(ln) or end_re.search(ln) for ln in lines):
+        return sections, problems
+    comment_lines = _comment_line_numbers(lines)
     for i, line in enumerate(lines, start=1):
-        m = _ATOMIC_BEGIN.search(line)
+        if comment_lines is not None and i not in comment_lines:
+            continue
+        m = begin_re.search(line)
         if m:
             if open_name is not None:
                 problems.append((
-                    i, f"atomic-section {m.group(1)!r} opens inside "
+                    i, f"{what} {m.group(1)!r} opens inside "
                        f"still-open section {open_name!r} (line "
                        f"{open_line}); sections cannot nest"))
             open_name, open_line = m.group(1), i
             continue
-        if _ATOMIC_END.search(line):
+        if end_re.search(line):
             if open_name is None:
                 problems.append((
-                    i, "end-atomic-section without a matching "
-                       "atomic-section begin"))
+                    i, f"{end_spelling} without a matching "
+                       f"{what} begin"))
             else:
                 sections.append(AtomicSection(open_name, open_line, i))
                 open_name = None
     if open_name is not None:
         problems.append((
             open_line,
-            f"atomic-section {open_name!r} is never closed "
-            "(missing end-atomic-section)"))
+            f"{what} {open_name!r} is never closed "
+            f"(missing {end_spelling})"))
     return sections, problems
+
+
+def parse_atomic_sections(lines) -> "Tuple[List[AtomicSection], List[Tuple[int, str]]]":  # noqa: E501
+    """(sections, problems) from a file's source lines."""
+    return _parse_marked_sections(lines, _ATOMIC_BEGIN, _ATOMIC_END,
+                                  "atomic-section", "end-atomic-section")
+
+
+def parse_resident_sections(lines) -> "Tuple[List[AtomicSection], List[Tuple[int, str]]]":  # noqa: E501
+    """(sections, problems) for declared device-resident regions."""
+    return _parse_marked_sections(
+        lines, _RESIDENT_BEGIN, _RESIDENT_END,
+        "device-resident-section", "end-device-resident-section")
 
 
 def module_str_constants(tree: ast.Module) -> Dict[str, str]:

@@ -1,0 +1,313 @@
+"""tpusan's runtime arm: transfer counters, seams, and the
+device-resident-section verifier.
+
+The static rule (``rules_residency.check_d2h_in_resident_section``)
+proves no *lexical* D2H sink sits inside a declared resident section.
+This module closes the loop at runtime, the way ``analysis/runtime.py``
+does for atomic sections -- the annotation is tested, not trusted:
+
+* **Counters** -- every transfer the storage layer performs through the
+  sanctioned seams (:func:`device_put` / :func:`device_get`, plus the
+  direct ``note_h2d``/``note_d2h`` hooks at call sites that keep their
+  raw jax spelling) lands in one process-wide
+  :class:`ResidencyCounters` ledger: h2d/d2h ops and bytes.  JIT
+  retraces ride the same ledger through a ``jax.monitoring`` listener
+  counting XLA backend compiles (one event per compilation; cache hits
+  emit nothing), so "no per-shape recompiles" is a number, not a vibe.
+  ``bench.py`` snapshots the ledger around every stage and emits the
+  deltas; the prometheus mgr module exposes the same counters as
+  ``ceph_transfer_bytes_total{direction=...}`` / ``ceph_jit_retraces_total``.
+* **Sections** -- :func:`resident_section` is the runtime guard paired
+  with the ``# cephlint: device-resident-section`` comment markers
+  (the static rule enforces the pairing).  Under tier-1 the global
+  verifier runs in ``raise`` mode: a seam D2H inside an open section
+  raises :class:`ResidencySectionError` at the offending call, and the
+  section body additionally runs under
+  ``jax.transfer_guard_device_to_host("disallow")`` so *implicit* D2H
+  that bypasses the seams fails natively on a real device.  (The full
+  ``transfer_guard("disallow")`` is deliberately NOT used: device-side
+  slicing/arithmetic materializes index scalars as implicit H2D, which
+  is legal inside a resident region.)  ``record`` mode detects the same
+  seam violations without perturbing control flow -- the conftest hook
+  fails the driving test, like atomic-section violations.  Escape
+  hatch: ``CEPH_TPU_RESIDENCY_VERIFY=0`` (declared in OPTIONS as
+  ``residency_verify``).
+
+On a CPU backend the jax transfer guard cannot see D2H (host and
+device memory are one, the copy is free), so under the cpu-fallback
+tier-1 run the seams ARE the verifier; on TPU both layers are live.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+from typing import Dict, List, Optional
+
+import numpy as np
+
+
+class ResidencyCounters:
+    """Process-wide transfer/retrace ledger (thread-safe)."""
+
+    __slots__ = ("_lock", "h2d_ops", "h2d_bytes", "d2h_ops", "d2h_bytes",
+                 "jit_retraces")
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.h2d_ops = 0
+        self.h2d_bytes = 0
+        self.d2h_ops = 0
+        self.d2h_bytes = 0
+        self.jit_retraces = 0
+
+    def note_h2d(self, nbytes: int) -> None:
+        with self._lock:
+            self.h2d_ops += 1
+            self.h2d_bytes += int(nbytes)
+
+    def note_d2h(self, nbytes: int) -> None:
+        with self._lock:
+            self.d2h_ops += 1
+            self.d2h_bytes += int(nbytes)
+
+    def note_retrace(self) -> None:
+        with self._lock:
+            self.jit_retraces += 1
+
+    def snapshot(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "h2d_ops": self.h2d_ops,
+                "h2d_bytes": self.h2d_bytes,
+                "d2h_ops": self.d2h_ops,
+                "d2h_bytes": self.d2h_bytes,
+                "jit_retraces": self.jit_retraces,
+            }
+
+    @staticmethod
+    def delta(before: Dict[str, int], after: Dict[str, int]) -> Dict[str, int]:
+        return {k: after[k] - before.get(k, 0) for k in after}
+
+
+_COUNTERS = ResidencyCounters()
+_hooks_lock = threading.Lock()
+_jax_hooks_installed = False
+
+
+def counters() -> ResidencyCounters:
+    """The process ledger; installs the retrace listener on first use."""
+    _ensure_jax_hooks()
+    return _COUNTERS
+
+
+def _ensure_jax_hooks() -> None:
+    """Register the compile-event listener once (idempotent, lazy so a
+    jax-less process never imports it)."""
+    global _jax_hooks_installed
+    if _jax_hooks_installed:
+        return
+    with _hooks_lock:
+        if _jax_hooks_installed:
+            return
+        _jax_hooks_installed = True
+        try:
+            import jax
+
+            def _on_duration(name: str, duration: float, **kw) -> None:
+                # one backend_compile per XLA compilation; jit cache
+                # hits emit nothing, so this counts exactly the
+                # retraces the batch-shape bucketing exists to prevent
+                if name.endswith("backend_compile_duration"):
+                    _COUNTERS.note_retrace()
+
+            jax.monitoring.register_event_duration_secs_listener(
+                _on_duration)
+        except Exception:  # noqa: BLE001 -- no jax: counters still work
+            pass
+
+
+# -- the transfer seams -----------------------------------------------------
+
+
+def _is_device_array(arr) -> bool:
+    """True for a jax array (the only thing a D2H can move); numpy
+    arrays pass the seams unchanged and uncounted."""
+    if isinstance(arr, np.ndarray):
+        return False
+    mod = type(arr).__module__ or ""
+    return mod.startswith("jax") or mod.startswith("jaxlib")
+
+
+def device_put(arr, *args, **kwargs):
+    """Counted H2D seam: ``jax.device_put`` with the bytes charged to
+    the ledger.  Falls back to a host copy when no jax backend is
+    importable (tier/tooling degrade identically to ``_to_device``)."""
+    _ensure_jax_hooks()
+    try:
+        import jax
+    except Exception:  # noqa: BLE001 -- no backend: host residency
+        return np.ascontiguousarray(arr)
+    out = jax.device_put(arr, *args, **kwargs)
+    _COUNTERS.note_h2d(getattr(arr, "nbytes", 0))
+    return out
+
+
+def note_h2d(nbytes: int) -> None:
+    """Direct H2D accounting hook for call sites that keep their raw
+    ``jax.device_put``/``jnp.asarray`` spelling (kernel-module uploads)."""
+    _ensure_jax_hooks()
+    _COUNTERS.note_h2d(nbytes)
+
+
+def device_get(arr) -> np.ndarray:
+    """Counted D2H seam: the ONE sanctioned way the storage path pulls
+    a device value to host.  Inside an open resident section this is a
+    violation (recorded or raised per the verifier mode)."""
+    _ensure_jax_hooks()
+    if not _is_device_array(arr):
+        return np.asarray(arr)
+    nbytes = int(getattr(arr, "nbytes", 0) or 0)
+    _note_d2h_checked(nbytes, "device_get")
+    return np.asarray(arr)
+
+
+def note_d2h(nbytes: int, what: str = "d2h") -> None:
+    """Direct D2H accounting hook (section-checked like the seam)."""
+    _ensure_jax_hooks()
+    _note_d2h_checked(nbytes, what)
+
+
+def _note_d2h_checked(nbytes: int, what: str) -> None:
+    _COUNTERS.note_d2h(nbytes)
+    stack = getattr(_tls, "sections", None)
+    if stack:
+        verifier, name = stack[-1]
+        verifier._on_violation(name, what, nbytes)
+
+
+# -- the section verifier ---------------------------------------------------
+
+
+class ResidencyViolation:
+    """One observed D2H inside a declared device-resident section."""
+
+    __slots__ = ("section", "what", "nbytes")
+
+    def __init__(self, section: str, what: str, nbytes: int):
+        self.section = section
+        self.what = what
+        self.nbytes = nbytes
+
+    def __repr__(self) -> str:
+        return (f"D2H transfer ({self.what}, {self.nbytes} bytes) inside "
+                f"device-resident section {self.section!r}")
+
+
+class ResidencySectionError(AssertionError):
+    """Raised (raise mode) when a D2H lands inside a resident section."""
+
+
+_tls = threading.local()
+
+
+class ResidencyVerifier:
+    """Section registry + the runtime guard modes.
+
+    ``mode``: ``"record"`` -- seam violations are appended to
+    :attr:`violations` (the tier-1 conftest hook fails the driving
+    test); ``"raise"`` -- seam violations raise at the offending call
+    AND the section body runs under
+    ``jax.transfer_guard_device_to_host("disallow")``.
+    """
+
+    def __init__(self, mode: str = "raise"):
+        assert mode in ("record", "raise")
+        self.mode = mode
+        self.violations: List[ResidencyViolation] = []
+        #: section names entered at least once (observability)
+        self.sections_entered: Dict[str, int] = {}
+
+    def _on_violation(self, section: str, what: str, nbytes: int) -> None:
+        v = ResidencyViolation(section, what, nbytes)
+        self.violations.append(v)
+        if self.mode == "raise":
+            raise ResidencySectionError(repr(v))
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        stack = getattr(_tls, "sections", None)
+        if stack is None:
+            stack = _tls.sections = []
+        stack.append((self, name))
+        self.sections_entered[name] = self.sections_entered.get(name, 0) + 1
+        guard = None
+        if self.mode == "raise":
+            try:
+                import jax
+
+                guard = jax.transfer_guard_device_to_host("disallow")
+                guard.__enter__()
+            except Exception:  # noqa: BLE001 -- no jax / old jax: the
+                guard = None   # seam layer still verifies
+        try:
+            yield
+        finally:
+            if guard is not None:
+                guard.__exit__(None, None, None)
+            stack.pop()
+
+    def status(self) -> dict:
+        return {
+            "mode": self.mode,
+            "sections_entered": dict(self.sections_entered),
+            "violations": [repr(v) for v in self.violations],
+        }
+
+
+#: process-global verifier (tier-1 conftest installs it); tests that
+#: provoke violations on purpose build private instances instead
+_GLOBAL: Optional[ResidencyVerifier] = None
+
+
+def install(mode: str = "raise") -> ResidencyVerifier:
+    """Install the global verifier (idempotent per process)."""
+    global _GLOBAL
+    if _GLOBAL is None:
+        _GLOBAL = ResidencyVerifier(mode)
+    return _GLOBAL
+
+
+def global_verifier() -> Optional[ResidencyVerifier]:
+    return _GLOBAL
+
+
+def violations() -> List[ResidencyViolation]:
+    return list(_GLOBAL.violations) if _GLOBAL is not None else []
+
+
+@contextlib.contextmanager
+def resident_section(name: str):
+    """The runtime guard paired with a ``# cephlint:
+    device-resident-section <name>`` comment region.  A no-op when no
+    verifier is installed (production default), so the hot path pays
+    one attribute probe when the machinery is off."""
+    v = _GLOBAL
+    if v is None:
+        yield
+        return
+    with v.section(name):
+        yield
+
+
+def status() -> dict:
+    """Admin-socket ``residency status`` payload: the ledger plus the
+    verifier state."""
+    out: dict = {"counters": _COUNTERS.snapshot()}
+    if _GLOBAL is not None:
+        out.update(_GLOBAL.status())
+    else:
+        out.update({"mode": "off", "sections_entered": {},
+                    "violations": []})
+    return out

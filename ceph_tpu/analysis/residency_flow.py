@@ -1,0 +1,443 @@
+"""tpusan's engine: interprocedural device-residency dataflow.
+
+ROADMAP item 2 is a transfer problem, not a compute problem: the
+storage path runs orders of magnitude below the measured transfer
+ceiling because values silently ping-pong between host and device
+(BENCH_r05: storage_path 0.054 GiB/s vs ceiling 239 GiB/s).  The
+shallow jax rules could pattern-match ``np.asarray`` in a loop, but
+they were blind to where an array actually LIVES -- they flagged host
+arrays being converted (noise) and missed device arrays leaking through
+a helper call (the real bug).
+
+This module tracks a three-point lattice per value --
+
+    ``device``  -- produced by ``jax.device_put``/``jnp.*``/a jitted
+                   call/a callee that returns device values; stays
+                   device through slicing, arithmetic and
+                   shape-preserving methods;
+    ``host``    -- produced by ``np.*``/``bytes``/``jax.device_get``/
+                   literals;
+    ``unknown`` -- parameters, ``self.*`` attributes, joins of
+                   conflicting branches (rules only fire on *definite*
+                   device values, so unknown is the safe default)
+
+-- from producers through assignments, returns and direct + ``self.``
+method calls (resolved by ``analysis/callgraph.py``'s tables).  Each
+function gets a summary:
+
+* ``returns``         -- lattice value of its return expression(s);
+* ``syncs``           -- the body performs a definite D2H: an explicit
+                         seam call (``jax.device_get``,
+                         ``residency.device_get``) or an implicit sink
+                         (``np.asarray``/``.tolist()``/``float()``/
+                         iteration) applied to a device value --
+                         directly or through a callee;
+* ``syncing_params``  -- positions whose argument gets D2H-synced when
+                         a device value is passed there (the
+                         "transitively-syncing helper" information the
+                         resident-section rule needs).
+
+Summaries reach a module-wide fixpoint so ``self._land()`` three calls
+deep still counts as a sync.  Like every cephlint component this is a
+pure AST consumer -- nothing under analysis is imported or executed.
+
+Module analyses are memoized on ``(path, source hash)`` -- the
+mtime-cache role, but keyed by content so a touched-but-unchanged file
+reuses its summary -- which keeps repeated scans (``--changed`` then
+the full gate, bench's lint stage) from re-deriving the fixpoint.
+"""
+
+from __future__ import annotations
+
+import ast
+import hashlib
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis import callgraph as callgraph_mod
+from ceph_tpu.analysis.core import FileContext, call_name, dotted_name
+
+HOST = "host"
+DEVICE = "device"
+UNKNOWN = "unknown"
+
+#: fixpoint bound (module-wide summary propagation; cycles converge)
+_MAX_ROUNDS = 12
+
+#: calls whose result is a device-resident array
+DEVICE_PRODUCER_CALLS = {
+    "jax.device_put", "jax.device_put_sharded", "jax.device_put_replicated",
+    "residency.device_put", "residency.to_device", "_to_device",
+    "accounted_device_matrix", "pipeline.accounted_device_matrix",
+}
+#: module prefixes whose calls produce device arrays
+DEVICE_PRODUCER_PREFIXES = ("jnp.", "jax.numpy.", "jax.lax.", "jax.random.")
+
+#: explicit D2H seams: ALWAYS a transfer, whatever the operand lattice
+#: says (these are the sanctioned boundary edges -- legal outside a
+#: resident section, a definite violation inside one)
+EXPLICIT_D2H_CALLS = {
+    "jax.device_get", "residency.device_get", "residency.to_host",
+    "device_get", "to_host",  # the bare from-import spellings
+}
+
+#: implicit D2H sinks: a transfer iff the operand is device-resident
+IMPLICIT_SINK_CALLS = {
+    "np.asarray", "np.array", "np.ascontiguousarray", "np.frombuffer",
+    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
+    "float", "int", "bytes", "list", "tuple",
+}
+#: sinks taking a SEQUENCE whose elements may be device arrays
+IMPLICIT_SEQ_SINK_CALLS = {
+    "np.stack", "np.concatenate", "numpy.stack", "numpy.concatenate",
+}
+#: method calls that pull the receiver to host
+SINK_METHODS = {"tolist", "item"}
+#: method calls that keep a device receiver on device
+DEVICE_PRESERVING_METHODS = {
+    "reshape", "astype", "transpose", "view", "copy", "ravel", "flatten",
+    "sum", "min", "max", "squeeze", "swapaxes", "set", "add", "get",
+    "block_until_ready",
+}
+
+#: host-producing calls (beyond the np prefix probe)
+HOST_PRODUCER_CALLS = {
+    "bytes", "bytearray", "len", "range", "sorted",
+}
+_NP_PREFIXES = ("np.", "numpy.")
+
+
+def join(a: str, b: str) -> str:
+    return a if a == b else UNKNOWN
+
+
+class SyncSite:
+    """One D2H transfer site inside a function body."""
+
+    __slots__ = ("node", "kind", "desc", "operand")
+
+    def __init__(self, node: ast.AST, kind: str, desc: str,
+                 operand: Optional[ast.AST] = None):
+        self.node = node
+        #: "explicit" (device_get seam), "implicit" (sink on a device
+        #: value), "helper" (call to a syncing callee), "param"
+        #: (device argument passed at a callee's syncing position)
+        self.kind = kind
+        self.desc = desc
+        self.operand = operand
+
+
+class FunctionResidency:
+    """Per-function residency facts + the interprocedural summary."""
+
+    __slots__ = ("info", "names", "returns", "syncs", "sync_desc",
+                 "syncing_params", "sync_sites", "param_names")
+
+    def __init__(self, info):
+        self.info = info  # callgraph.FunctionInfo
+        self.names: Dict[str, str] = {}
+        self.returns = UNKNOWN
+        self.syncs = False
+        self.sync_desc = ""
+        self.syncing_params: Set[int] = set()
+        self.sync_sites: List[SyncSite] = []
+        args = info.node.args
+        params = [a.arg for a in getattr(args, "posonlyargs", [])] + \
+                 [a.arg for a in args.args]
+        if params and params[0] in ("self", "cls"):
+            params = params[1:]
+        self.param_names: List[str] = params
+
+
+class ModuleResidency:
+    """Residency lattice + summaries for every function in one module."""
+
+    def __init__(self, ctx: FileContext):
+        self.ctx = ctx
+        self.graph = callgraph_mod.get(ctx)
+        #: qualname -> FunctionResidency
+        self.functions: Dict[str, FunctionResidency] = {
+            q: FunctionResidency(info)
+            for q, info in self.graph.functions.items()
+        }
+        self._fixpoint()
+
+    # -- interprocedural fixpoint ------------------------------------------
+
+    def _fixpoint(self) -> None:
+        for _ in range(_MAX_ROUNDS):
+            changed = False
+            for fr in self.functions.values():
+                before = (fr.returns, fr.syncs,
+                          frozenset(fr.syncing_params))
+                self._analyze(fr)
+                if (fr.returns, fr.syncs,
+                        frozenset(fr.syncing_params)) != before:
+                    changed = True
+            if not changed:
+                break
+
+    # -- queries -----------------------------------------------------------
+
+    def of_node(self, node: ast.AST) -> Optional[FunctionResidency]:
+        info = self.graph.by_node.get(node)
+        if info is None:
+            return None
+        return self.functions.get(info.qualname)
+
+    def resolve_call(self, fr: FunctionResidency,
+                     call: ast.Call) -> Optional[FunctionResidency]:
+        qual = self.graph._resolve_call(fr.info, call)
+        if qual is None:
+            return None
+        return self.functions.get(qual)
+
+    # -- per-function analysis ---------------------------------------------
+
+    def _analyze(self, fr: FunctionResidency) -> None:
+        """(Re)compute one function's lattice, sink sites and summary
+        given the current callee summaries.  Flow-insensitive over the
+        body (two passes settle forward+backward name references)."""
+        fr.sync_sites = []
+        fr.syncs = False
+        fr.sync_desc = ""
+        fr.syncing_params = set()
+        for _ in range(2):
+            for node in self._own_stmts_and_exprs(fr.info.node):
+                if isinstance(node, ast.Assign):
+                    res = self.expr_res(fr, node.value)
+                    for tgt in node.targets:
+                        self._bind(fr, tgt, res)
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    self._bind(fr, node.target,
+                               self.expr_res(fr, node.value))
+                elif isinstance(node, ast.AugAssign):
+                    if isinstance(node.target, ast.Name):
+                        res = join(
+                            fr.names.get(node.target.id, UNKNOWN),
+                            self.expr_res(fr, node.value))
+                        fr.names[node.target.id] = res
+                elif isinstance(node, (ast.For, ast.AsyncFor)):
+                    # iterating a device array: each element stays a
+                    # device scalar/row (and the loop is a sink, see
+                    # below)
+                    res = self.expr_res(fr, node.iter)
+                    self._bind(fr, node.target,
+                               DEVICE if res == DEVICE else UNKNOWN)
+        # final pass: collect sink sites + returns with settled names
+        returns: List[str] = []
+        for node in self._own_stmts_and_exprs(fr.info.node):
+            if isinstance(node, ast.Return) and node.value is not None:
+                returns.append(self.expr_res(fr, node.value))
+            self._collect_sinks(fr, node)
+        fr.returns = returns[0] if returns else UNKNOWN
+        for r in returns[1:]:
+            fr.returns = join(fr.returns, r)
+        # summary: any definite sink makes the function syncing
+        for site in fr.sync_sites:
+            if not fr.syncs:
+                fr.syncs = True
+                fr.sync_desc = site.desc
+            # a sink whose operand is a bare (never locally re-bound to
+            # host) parameter marks that position syncing
+            op = site.operand
+            if isinstance(op, ast.Name) and op.id in fr.param_names and \
+                    fr.names.get(op.id, UNKNOWN) != HOST:
+                fr.syncing_params.add(fr.param_names.index(op.id))
+
+    def _bind(self, fr: FunctionResidency, target: ast.expr,
+              res: str) -> None:
+        if isinstance(target, ast.Name):
+            fr.names[target.id] = res
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for elt in target.elts:
+                self._bind(fr, elt, UNKNOWN)
+
+    @staticmethod
+    def _own_stmts_and_exprs(fn: ast.AST) -> Iterator[ast.AST]:
+        stack: List[ast.AST] = list(ast.iter_child_nodes(fn))
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    # -- expression lattice -------------------------------------------------
+
+    def expr_res(self, fr: FunctionResidency, e: ast.AST,
+                 depth: int = 0) -> str:
+        if depth > 24:
+            return UNKNOWN
+        if isinstance(e, ast.Name):
+            return fr.names.get(e.id, UNKNOWN)
+        if isinstance(e, ast.Constant):
+            return HOST
+        if isinstance(e, ast.Call):
+            return self._call_res(fr, e, depth)
+        if isinstance(e, ast.Subscript):
+            # slicing/indexing a device array yields a device array
+            base = self.expr_res(fr, e.value, depth + 1)
+            return DEVICE if base == DEVICE else UNKNOWN
+        if isinstance(e, (ast.BinOp,)):
+            left = self.expr_res(fr, e.left, depth + 1)
+            right = self.expr_res(fr, e.right, depth + 1)
+            if DEVICE in (left, right):
+                return DEVICE  # device op promotes the result to device
+            if left == right == HOST:
+                return HOST
+            return UNKNOWN
+        if isinstance(e, ast.UnaryOp):
+            return self.expr_res(fr, e.operand, depth + 1)
+        if isinstance(e, ast.IfExp):
+            return join(self.expr_res(fr, e.body, depth + 1),
+                        self.expr_res(fr, e.orelse, depth + 1))
+        if isinstance(e, ast.Attribute):
+            # x.T / x.at on a device value stays device; anything else
+            # (self.foo, module attrs) is unknown
+            if e.attr in ("T", "at", "mT") and \
+                    self.expr_res(fr, e.value, depth + 1) == DEVICE:
+                return DEVICE
+            return UNKNOWN
+        if isinstance(e, ast.Await):
+            return self.expr_res(fr, e.value, depth + 1)
+        return UNKNOWN
+
+    def _call_res(self, fr: FunctionResidency, call: ast.Call,
+                  depth: int) -> str:
+        name = call_name(call)
+        if name in DEVICE_PRODUCER_CALLS or \
+                name.startswith(DEVICE_PRODUCER_PREFIXES):
+            return DEVICE
+        if name in EXPLICIT_D2H_CALLS or name in HOST_PRODUCER_CALLS or \
+                name.startswith(_NP_PREFIXES) or \
+                name in IMPLICIT_SINK_CALLS or \
+                name in IMPLICIT_SEQ_SINK_CALLS:
+            return HOST
+        # method call: residency-preserving ops keep the receiver's home
+        if isinstance(call.func, ast.Attribute):
+            recv = self.expr_res(fr, call.func.value, depth + 1)
+            if call.func.attr in SINK_METHODS:
+                return HOST
+            if call.func.attr in DEVICE_PRESERVING_METHODS and \
+                    recv == DEVICE:
+                return DEVICE
+        callee = self.resolve_call(fr, call)
+        if callee is not None:
+            from ceph_tpu.analysis.core import is_jitted
+
+            if is_jitted(callee.info.node):
+                return DEVICE  # a jitted call returns device arrays
+            return callee.returns
+        return UNKNOWN
+
+    # -- sink collection ----------------------------------------------------
+
+    def _collect_sinks(self, fr: FunctionResidency, node: ast.AST) -> None:
+        if isinstance(node, (ast.For, ast.AsyncFor)):
+            if self.expr_res(fr, node.iter) == DEVICE:
+                fr.sync_sites.append(SyncSite(
+                    node, "implicit",
+                    "Python iteration over a device array (one blocking "
+                    "D2H per element)",
+                    node.iter))
+            return
+        if not isinstance(node, ast.Call):
+            return
+        name = call_name(node)
+        operand = node.args[0] if node.args else None
+        if name in EXPLICIT_D2H_CALLS:
+            fr.sync_sites.append(SyncSite(
+                node, "explicit", f"{name}(...) is an explicit D2H edge",
+                operand))
+            return
+        if name in IMPLICIT_SINK_CALLS and operand is not None:
+            res = self.expr_res(fr, operand)
+            if res == DEVICE:
+                fr.sync_sites.append(SyncSite(
+                    node, "implicit",
+                    f"{name}(...) on a device-resident value pulls it "
+                    "to host", operand))
+            elif isinstance(operand, ast.Name) and \
+                    operand.id in fr.param_names and res != HOST:
+                # sink on a parameter of unknown residency: the
+                # function syncs WHATEVER it is handed -- callers
+                # passing a device value get flagged at the call site
+                fr.syncing_params.add(fr.param_names.index(operand.id))
+            return
+        if name in IMPLICIT_SEQ_SINK_CALLS and operand is not None:
+            elts = operand.elts if isinstance(
+                operand, (ast.List, ast.Tuple)) else [operand]
+            for elt in elts:
+                # a comprehension over a device array D2Hs every element
+                if isinstance(elt, (ast.ListComp, ast.GeneratorExp)) and \
+                        any(self.expr_res(fr, gen.iter) == DEVICE
+                            for gen in elt.generators):
+                    fr.sync_sites.append(SyncSite(
+                        node, "implicit",
+                        f"{name}(...) gathers elements of a device "
+                        "array to host", elt))
+                    return
+                if self.expr_res(fr, elt) == DEVICE:
+                    fr.sync_sites.append(SyncSite(
+                        node, "implicit",
+                        f"{name}(...) on device-resident value(s) pulls "
+                        "them to host", elt))
+                    return
+            return
+        if isinstance(node.func, ast.Attribute) and \
+                node.func.attr in SINK_METHODS:
+            recv = node.func.value
+            res = self.expr_res(fr, recv)
+            if res == DEVICE:
+                fr.sync_sites.append(SyncSite(
+                    node, "implicit",
+                    f".{node.func.attr}() on a device-resident value "
+                    "pulls it to host", recv))
+            elif isinstance(recv, ast.Name) and \
+                    recv.id in fr.param_names and res != HOST:
+                fr.syncing_params.add(fr.param_names.index(recv.id))
+            return
+        # interprocedural: a call to a syncing module-local helper, or a
+        # device argument handed to a callee position that syncs it
+        callee = self.resolve_call(fr, node)
+        if callee is None or callee is fr:
+            return
+        if callee.syncs:
+            fr.sync_sites.append(SyncSite(
+                node, "helper",
+                f"{name}() syncs to host inside its body "
+                f"({callee.sync_desc})", None))
+            return
+        if callee.syncing_params:
+            for idx, arg in enumerate(node.args):
+                if idx in callee.syncing_params and \
+                        self.expr_res(fr, arg) == DEVICE:
+                    fr.sync_sites.append(SyncSite(
+                        node, "param",
+                        f"{name}() D2H-syncs its argument "
+                        f"{callee.param_names[idx]!r} and this call "
+                        "passes a device-resident value there", arg))
+                    return
+
+
+# -- memoization ------------------------------------------------------------
+
+#: path -> (source blake2 digest, ModuleResidency); content-keyed so a
+#: rescan of an unchanged file (``--changed`` then the full gate, bench)
+#: reuses the fixpoint instead of re-deriving it
+_CACHE: Dict[str, Tuple[bytes, ModuleResidency]] = {}
+_CACHE_MAX = 512
+
+
+def get(ctx: FileContext) -> ModuleResidency:
+    digest = hashlib.blake2b(ctx.source.encode("utf-8", "replace"),
+                             digest_size=16).digest()
+    hit = _CACHE.get(ctx.path)
+    if hit is not None and hit[0] == digest:
+        return hit[1]
+    analysis = ModuleResidency(ctx)
+    if len(_CACHE) >= _CACHE_MAX:
+        _CACHE.clear()
+    _CACHE[ctx.path] = (digest, analysis)
+    return analysis

@@ -4,18 +4,19 @@ The batching papers this tree follows (arxiv 2108.02692 on XOR-EC
 program optimization, arxiv 2112.09017 on TPU-scale linear algebra)
 both live or die on two disciplines: no host<->device round-trips
 inside the per-stripe loop, and no silent dtype widening of the
-GF(2^8) byte domain.  These rules machine-check both where it matters:
+GF(2^8) byte domain.  The transfer discipline is now owned by the
+flow-aware residency pack (``rules_residency.py``: the old shallow
+``jax-host-sync-hot-path`` and ``jax-device-array-iteration`` pattern
+checks were retired in its favor -- the lattice knows where a value
+lives, so a host array converted in a loop is no longer noise and a
+device array leaking through a helper is no longer invisible).  What
+stays here:
 
-* host-sync rule: scoped to modules that import jax under the hot
-  paths (``ops/``, ``osd/ecutil.py``, ``osd/coalescer.py``) -- a
-  one-shot boundary conversion in a wrapper is the DESIGNED H2D/D2H
-  edge and is not flagged; the same call inside a for/while loop (per
-  stripe, per chunk) or inside a jitted kernel is.
 * dtype rule: array constructors without an explicit ``dtype=`` default
   to float64/int64 -- an 8x widening of a byte lane that XLA will
   happily carry all the way to the MXU; float64 is never right here.
-* device-iteration rule: a Python ``for`` over a device array pulls one
-  element per step across PCIe (len(arr) blocking syncs each time).
+* device-bytes accounting rule: retained device arrays must route
+  through the two ledger seams so HBM stays evictable.
 """
 
 from __future__ import annotations
@@ -24,21 +25,10 @@ import ast
 from typing import Iterator
 
 from ceph_tpu.analysis.core import (SEV_WARNING, FileContext, Finding,
-                                    call_name, dotted_name,
-                                    enclosing_functions, is_jitted, rule)
-
-#: hot-path scope (posix path prefixes / exact files, repo-relative)
-HOT_PATH_PREFIXES = ("ceph_tpu/ops/",)
-HOT_PATH_FILES = ("ceph_tpu/osd/ecutil.py", "ceph_tpu/osd/coalescer.py")
+                                    call_name, dotted_name, rule)
 
 #: matrices + ops: everything that builds or consumes GF kernel operands
 DTYPE_SCOPE_PREFIXES = ("ceph_tpu/matrices/", "ceph_tpu/ops/")
-
-_HOST_SYNC_CALLS = {
-    "np.asarray", "np.array", "np.ascontiguousarray",
-    "numpy.asarray", "numpy.array", "numpy.ascontiguousarray",
-    "jax.device_get",
-}
 
 #: constructors whose dtype defaults to float64/int64
 _DEFAULT_DTYPE_CTORS = {
@@ -47,75 +37,6 @@ _DEFAULT_DTYPE_CTORS = {
     "numpy.eye",
     "jnp.zeros", "jnp.ones", "jnp.empty", "jnp.arange", "jnp.eye",
 }
-
-
-def _is_hot_path(path: str) -> bool:
-    return path in HOT_PATH_FILES or \
-        any(path.startswith(p) for p in HOT_PATH_PREFIXES)
-
-
-def _in_loop(ctx: FileContext, node: ast.AST) -> bool:
-    """Lexically inside a for/while loop body (same function level --
-    loops in NESTED defs don't make the outer call per-iteration)."""
-    parents = ctx.parent_map()
-    fn_chain = enclosing_functions(ctx, node)
-    innermost_fn = fn_chain[-1] if fn_chain else None
-    cur = node
-    while cur in parents:
-        cur = parents[cur]
-        if cur is innermost_fn:
-            return False
-        if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
-            return True
-    return False
-
-
-def _in_jitted_fn(ctx: FileContext, node: ast.AST) -> bool:
-    return any(is_jitted(fn) for fn in enclosing_functions(ctx, node))
-
-
-@rule(
-    "jax-host-sync-hot-path", "jax", SEV_WARNING,
-    "host<->device sync (np.asarray / jax.device_get / "
-    ".block_until_ready / float()/int() on an array element) inside a "
-    "hot-path loop or jitted kernel: each call stalls the dispatch "
-    "pipeline for a PCIe round-trip; batch the transfer at the wrapper "
-    "boundary instead",
-)
-def check_host_sync(ctx: FileContext) -> Iterator[Finding]:
-    if not _is_hot_path(ctx.path):
-        return
-    if not ctx.imports_module("jax"):
-        # pure-host numpy engines (cpu_engine) have no device to sync
-        return
-    for node in ast.walk(ctx.tree):
-        if not isinstance(node, ast.Call):
-            continue
-        name = call_name(node)
-        sync = None
-        if name in _HOST_SYNC_CALLS:
-            sync = f"{name}(...)"
-        elif name.rsplit(".", 1)[-1] == "block_until_ready":
-            sync = ".block_until_ready()"
-        elif name in ("float", "int") and node.args and \
-                isinstance(node.args[0], ast.Subscript):
-            # float(arr[i]) / int(arr[i]): per-element D2H pull
-            sync = f"{name}() on a subscripted array element"
-        if sync is None:
-            continue
-        if _in_jitted_fn(ctx, node):
-            yield ctx.finding(
-                "jax-host-sync-hot-path", node,
-                f"{sync} inside a jitted function: host syncs do not "
-                "belong under jax.jit (trace-time surprise or silent "
-                "constant-folding)",
-            )
-        elif _in_loop(ctx, node):
-            yield ctx.finding(
-                "jax-host-sync-hot-path", node,
-                f"{sync} inside a hot-path loop: one host round-trip "
-                "per iteration; hoist the conversion out of the loop",
-            )
 
 
 @rule(
@@ -200,7 +121,8 @@ def check_device_bytes_unaccounted(ctx: FileContext) -> Iterator[Finding]:
         if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
             continue
         # names bound to device_put results in this function (simple
-        # local flow, the same depth check_device_iteration uses)
+        # single-function local flow; retention, not transfer, is the
+        # concern here, so the full residency lattice is not needed)
         put_names = set()
         for node in ast.walk(fn):
             if isinstance(node, ast.Assign) and \
@@ -226,41 +148,4 @@ def check_device_bytes_unaccounted(ctx: FileContext) -> Iterator[Finding]:
                     "outside the accounting seams (tier/device_tier.py, "
                     "ops/pipeline.py): these bytes bypass the "
                     "osd_tier_hbm_bytes ledger",
-                )
-
-
-@rule(
-    "jax-device-array-iteration", "jax", SEV_WARNING,
-    "Python for-loop directly over a device array: every element is a "
-    "separate blocking D2H transfer; device_get the whole array first "
-    "(or vectorize the loop body)",
-)
-def check_device_iteration(ctx: FileContext) -> Iterator[Finding]:
-    if not ctx.imports_module("jax"):
-        return
-    # per-function: names assigned from device-producing calls
-    for fn in ast.walk(ctx.tree):
-        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
-            continue
-        device_names = set()
-        for node in ast.walk(fn):
-            if isinstance(node, ast.Assign) and \
-                    isinstance(node.value, ast.Call):
-                src = call_name(node.value)
-                if src.startswith("jnp.") or src in (
-                        "jax.device_put", "jax.numpy.asarray"):
-                    for tgt in node.targets:
-                        if isinstance(tgt, ast.Name):
-                            device_names.add(tgt.id)
-        if not device_names:
-            continue
-        for node in ast.walk(fn):
-            if isinstance(node, ast.For) and \
-                    isinstance(node.iter, ast.Name) and \
-                    node.iter.id in device_names:
-                yield ctx.finding(
-                    "jax-device-array-iteration", node,
-                    f"for-loop iterates device array {node.iter.id!r} "
-                    "element-wise; pull it to host once with "
-                    "jax.device_get / np.asarray outside the loop",
                 )

@@ -1,0 +1,596 @@
+"""tpusan rules: device-residency invariants for the storage hot path.
+
+Four rules ride the interprocedural lattice in
+``analysis/residency_flow.py``:
+
+* ``jax-d2h-in-resident-section`` -- a D2H transfer (explicit
+  ``device_get`` seam, ``np.asarray``/``.tolist()``/``float()`` on a
+  device value, iteration, or a call to a helper that transitively
+  syncs) is reachable inside a declared ``# cephlint:
+  device-resident-section <name>`` region.  The declaration is the
+  storage path's roofline contract: inside the region bytes stay in
+  HBM.  The same regions are enforced at runtime by
+  ``analysis/residency.py`` (``jax.transfer_guard_device_to_host``
+  under tier-1), so each section must also carry its
+  ``resident_section(<name>)`` runtime guard.
+* ``jax-recompile-hazard`` -- ``jax.jit`` constructed per call inside a
+  function body, a shape-derived value (``x.shape[i]``, ``len(x)``)
+  fed raw to a static parameter of a jitted kernel (one retrace per
+  distinct size; the granule ladder exists so shapes are bucketed),
+  or a bare Python scalar literal fed to a traced parameter.
+* ``jax-donated-after-use`` -- a buffer passed at a
+  ``donate_argnums`` position and read again on any CFG path after
+  the call: donation hands the buffer to XLA, the read sees freed or
+  aliased memory.
+* ``jax-loop-invariant-transfer`` -- H2D (``device_put``/
+  ``jnp.asarray``) of a loop-invariant value inside a loop, a D2H of a
+  loop-invariant device value per iteration (Python iteration over a
+  device array included), and the method-scope variant: per-call
+  upload of instance-constant state (``jnp.asarray(self.B)`` outside
+  ``__init__``) -- the exact shape that re-shipped the mesh codec's
+  coding matrix on EVERY encode call.  Hoist onto the accounted upload
+  cache (``ops/pipeline.py accounted_device_matrix``) or upload once
+  at construction.
+
+These subsume the retired shallow checks (``jax-host-sync-hot-path``,
+``jax-device-array-iteration``): the lattice knows where a value lives,
+so converting a HOST array in a loop is no longer noise and a device
+array leaking through a helper is no longer invisible.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from ceph_tpu.analysis import cfg as cfg_mod
+from ceph_tpu.analysis import residency_flow as flow
+from ceph_tpu.analysis.core import (SEV_ERROR, SEV_WARNING, FileContext,
+                                    Finding, call_name, dotted_name,
+                                    parse_resident_sections, rule)
+
+
+def _wants_analysis(ctx: FileContext) -> bool:
+    return ctx.imports_module("jax") or \
+        "device-resident-section" in ctx.source
+
+
+def _in_ceph_tpu(ctx: FileContext) -> bool:
+    return ctx.path.startswith("ceph_tpu/")
+
+
+# -- jit decoration parsing -------------------------------------------------
+
+
+def _const_set(expr: ast.AST) -> Set:
+    """Literal values of a tuple/list/single constant expression."""
+    if isinstance(expr, ast.Constant):
+        return {expr.value}
+    if isinstance(expr, (ast.Tuple, ast.List)):
+        return {e.value for e in expr.elts if isinstance(e, ast.Constant)}
+    return set()
+
+
+def _jit_kwargs(call: ast.Call) -> Dict[str, Set]:
+    out: Dict[str, Set] = {}
+    for kw in call.keywords:
+        if kw.arg in ("static_argnums", "static_argnames",
+                      "donate_argnums"):
+            out[kw.arg] = _const_set(kw.value)
+    return out
+
+
+def _is_jit_target(expr: ast.AST) -> bool:
+    return dotted_name(expr).rsplit(".", 1)[-1] == "jit"
+
+
+def _jit_spec(fn_node: ast.AST) -> Optional[Dict[str, Set]]:
+    """{"static_argnums", "static_argnames", "donate_argnums"} sets when
+    ``fn_node`` is decorated jitted, else None."""
+    for dec in getattr(fn_node, "decorator_list", []):
+        if isinstance(dec, ast.Call):
+            if _is_jit_target(dec.func):
+                return _jit_kwargs(dec)
+            if dotted_name(dec.func).rsplit(".", 1)[-1] == "partial" and \
+                    dec.args and _is_jit_target(dec.args[0]):
+                return _jit_kwargs(dec)
+        elif _is_jit_target(dec):
+            return {}
+    return None
+
+
+def _params_of(fn_node: ast.AST) -> List[str]:
+    args = fn_node.args
+    params = [a.arg for a in getattr(args, "posonlyargs", [])] + \
+             [a.arg for a in args.args]
+    if params and params[0] in ("self", "cls"):
+        params = params[1:]
+    return params
+
+
+# -- rule: D2H inside a declared device-resident section --------------------
+
+
+@rule(
+    "jax-d2h-in-resident-section", "jax", SEV_ERROR,
+    "a D2H transfer (np.asarray / .tolist() / float() / iteration / "
+    "device_get, or a helper that transitively syncs) is reachable "
+    "inside a declared `cephlint: device-resident-section` region, or "
+    "the markers are malformed / missing their runtime "
+    "resident_section() guard.  The region declares that bytes stay in "
+    "HBM; the runtime verifier (analysis/residency.py) enforces the "
+    "same contract under tier-1 with jax.transfer_guard",
+)
+def check_d2h_in_resident_section(ctx: FileContext) -> Iterator[Finding]:
+    if "device-resident-section" not in ctx.source:
+        return
+    sections, problems = parse_resident_sections(ctx.lines)
+    for line, message in problems:
+        yield Finding("jax-d2h-in-resident-section", ctx.path, line, 0,
+                      message, SEV_ERROR)
+    if not sections:
+        return
+    analysis = flow.get(ctx)
+    # each declared region must pair with its runtime guard: a
+    # resident_section("<name>") call between the markers (the static
+    # markers and the transfer_guard scope must cover the same lines)
+    guarded: Set[str] = set()
+    for node in ast.walk(analysis.ctx.tree):
+        if isinstance(node, ast.Call) and \
+                call_name(node).rsplit(".", 1)[-1] == "resident_section" \
+                and node.args and isinstance(node.args[0], ast.Constant):
+            for s in sections:
+                if s.start < node.lineno < s.end and \
+                        node.args[0].value == s.name:
+                    guarded.add(s.name)
+    for s in sections:
+        if s.name not in guarded:
+            yield Finding(
+                "jax-d2h-in-resident-section", ctx.path, s.start, 0,
+                f"device-resident-section {s.name!r} has no matching "
+                f"runtime guard: wrap the region's body in "
+                f"`with resident_section({s.name!r}):` "
+                "(ceph_tpu.analysis.residency) so the declaration is "
+                "enforced, not trusted", SEV_ERROR)
+    for fr in analysis.functions.values():
+        for site in fr.sync_sites:
+            line = getattr(site.node, "lineno", None)
+            if line is None:
+                continue
+            section = next(
+                (s for s in sections if s.start < line < s.end), None)
+            if section is None:
+                continue
+            yield ctx.finding(
+                "jax-d2h-in-resident-section", site.node,
+                f"D2H transfer inside device-resident-section "
+                f"{section.name!r} (lines {section.start}-{section.end}):"
+                f" {site.desc}; the section declares this stretch "
+                "device-resident -- move the sync to the section "
+                "boundary or keep the value on device",
+            )
+
+
+# -- rule: recompile hazards ------------------------------------------------
+
+
+def _contains_shape_probe(expr: ast.AST) -> bool:
+    """The expression derives from a runtime shape: x.shape[i], len(x),
+    or x.size."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Attribute) and node.attr in ("shape",
+                                                            "size"):
+            return True
+        if isinstance(node, ast.Call) and call_name(node) == "len":
+            return True
+    return False
+
+
+def _is_bucketed(expr: ast.AST) -> bool:
+    """Routed through the sanctioned batch-shape bucketing idiom: a call
+    whose name mentions the granule ladder (rung/bucket/ladder/tile),
+    or a min()/max() cap against a constant (the ladder's last step)."""
+    if not isinstance(expr, ast.Call):
+        return False
+    name = call_name(expr).rsplit(".", 1)[-1].lower()
+    if any(h in name for h in ("rung", "bucket", "ladder", "tile")):
+        return True
+    if name in ("min", "max"):
+        return any(isinstance(a, ast.Constant) for a in expr.args)
+    return False
+
+
+@rule(
+    "jax-recompile-hazard", "jax", SEV_WARNING,
+    "per-call jax.jit construction, a raw shape-derived value fed to a "
+    "static parameter of a jitted kernel (one XLA compile per distinct "
+    "size -- route it through the batch-shape bucketing helper / a "
+    "constant cap), or a Python scalar literal fed to a traced "
+    "parameter (weak-typed scalars promote per call site; make it "
+    "static or ship an array)",
+)
+def check_recompile_hazard(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_ceph_tpu(ctx) or not ctx.imports_module("jax"):
+        return
+    analysis = flow.get(ctx)
+    actx = analysis.ctx
+    parents = actx.parent_map()
+
+    def _in_decorator(node: ast.AST) -> bool:
+        cur = node
+        while cur in parents:
+            parent = parents[cur]
+            decs = getattr(parent, "decorator_list", [])
+            if any(cur is d for d in decs):
+                return True
+            cur = parent
+        return False
+
+    def _enclosing_fn(node: ast.AST):
+        cur = node
+        while cur in parents:
+            cur = parents[cur]
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                return cur
+        return None
+
+    # (1) per-call jit construction inside a function body
+    for node in ast.walk(actx.tree):
+        if not (isinstance(node, ast.Call) and _is_jit_target(node.func)):
+            continue
+        if _enclosing_fn(node) is None or _in_decorator(node):
+            continue  # module-level / decorator position: compiled once
+        stmt = node
+        while stmt in parents and not isinstance(stmt, ast.stmt):
+            stmt = parents[stmt]
+        # sanctioned caching shapes: `return jax.jit(f)` from a builder
+        # (the caller caches the result) and `self._fn = jax.jit(f)`
+        if isinstance(stmt, ast.Return):
+            continue
+        if isinstance(stmt, ast.Assign) and any(
+                isinstance(t, ast.Attribute) for t in stmt.targets):
+            continue
+        yield ctx.finding(
+            "jax-recompile-hazard", node,
+            "jax.jit(...) constructed inside a function body: every "
+            "call builds a fresh jitted callable with an empty compile "
+            "cache; build it once (module level, __init__, or a cached "
+            "builder)",
+        )
+
+    # (2)/(3) call sites of module-local jitted kernels
+    jitted: Dict[str, Tuple[Dict[str, Set], List[str]]] = {}
+    for qual, fr in analysis.functions.items():
+        spec = _jit_spec(fr.info.node)
+        if spec is not None:
+            jitted[qual] = (spec, _params_of(fr.info.node))
+    if not jitted:
+        return
+    for fr in analysis.functions.values():
+        for node in ast.walk(fr.info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = analysis.graph._resolve_call(fr.info, node)
+            if qual not in jitted:
+                continue
+            spec, params = jitted[qual]
+            static_nums = spec.get("static_argnums", set())
+            static_names = spec.get("static_argnames", set())
+            for idx, arg in enumerate(node.args):
+                pname = params[idx] if idx < len(params) else None
+                is_static = idx in static_nums or pname in static_names
+                if is_static:
+                    if _contains_shape_probe(arg) and \
+                            not _is_bucketed(arg):
+                        yield ctx.finding(
+                            "jax-recompile-hazard", arg,
+                            f"shape-derived value fed raw to static "
+                            f"parameter {pname or idx!r} of jitted "
+                            f"{qual}(): one XLA compile per distinct "
+                            "size; bucket it (granule ladder / "
+                            "min(cap, n))",
+                        )
+                elif isinstance(arg, ast.Constant) and \
+                        isinstance(arg.value, (int, float)) and \
+                        not isinstance(arg.value, bool):
+                    yield ctx.finding(
+                        "jax-recompile-hazard", arg,
+                        f"Python scalar literal fed to traced parameter "
+                        f"{pname or idx!r} of jitted {qual}(): weak-"
+                        "typed scalars re-promote per call site and a "
+                        "dtype flip retraces; make the parameter "
+                        "static_argnums or pass a device array",
+                    )
+            for kw in node.keywords:
+                if kw.arg in static_names and \
+                        _contains_shape_probe(kw.value) and \
+                        not _is_bucketed(kw.value):
+                    yield ctx.finding(
+                        "jax-recompile-hazard", kw.value,
+                        f"shape-derived value fed raw to static "
+                        f"parameter {kw.arg!r} of jitted {qual}(): one "
+                        "XLA compile per distinct size; bucket it",
+                    )
+
+
+# -- rule: donated buffer read after the call -------------------------------
+
+
+def _stmt_of(node: ast.AST, parents) -> Optional[ast.stmt]:
+    cur = node
+    while cur in parents and not isinstance(cur, ast.stmt):
+        cur = parents[cur]
+    return cur if isinstance(cur, ast.stmt) else None
+
+
+def _own_exprs(stmt: ast.stmt) -> Iterator[ast.AST]:
+    for child in ast.iter_child_nodes(stmt):
+        if isinstance(child, ast.stmt):
+            continue
+        for node in ast.walk(child):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+
+
+def _reads_name(stmt: ast.stmt, name: str) -> bool:
+    return any(isinstance(n, ast.Name) and n.id == name and
+               isinstance(n.ctx, ast.Load) for n in _own_exprs(stmt))
+
+
+def _rebinds_name(stmt: ast.stmt, name: str) -> bool:
+    targets: List[ast.expr] = []
+    if isinstance(stmt, ast.Assign):
+        targets = stmt.targets
+    elif isinstance(stmt, (ast.AugAssign, ast.AnnAssign)):
+        targets = [stmt.target]
+    elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+        targets = [stmt.target]
+    for t in targets:
+        for n in ast.walk(t):
+            if isinstance(n, ast.Name) and n.id == name:
+                return True
+    return False
+
+
+@rule(
+    "jax-donated-after-use", "jax", SEV_ERROR,
+    "a buffer passed at a donate_argnums position is read again on a "
+    "CFG path after the donating call: donation hands the buffer's "
+    "memory to XLA (the in-place update optimization), so the read "
+    "observes freed or aliased storage.  Re-derive the value from the "
+    "call's RESULT, or drop the donation",
+)
+def check_donated_after_use(ctx: FileContext) -> Iterator[Finding]:
+    if not ctx.imports_module("jax"):
+        return
+    analysis = flow.get(ctx)
+    actx = analysis.ctx
+    parents = actx.parent_map()
+    # donors: decorated defs and names bound to jax.jit(f, donate_...)
+    donate_of: Dict[str, Set[int]] = {}
+    for qual, fr in analysis.functions.items():
+        spec = _jit_spec(fr.info.node)
+        if spec and spec.get("donate_argnums"):
+            donate_of[fr.info.node.name] = spec["donate_argnums"]
+    for node in ast.walk(actx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                isinstance(node.value, ast.Call) and \
+                _is_jit_target(node.value.func):
+            kw = _jit_kwargs(node.value)
+            if kw.get("donate_argnums"):
+                donate_of[node.targets[0].id] = kw["donate_argnums"]
+    if not donate_of:
+        return
+    cfg_cache: Dict[int, cfg_mod.CFG] = {}
+    for fr in analysis.functions.values():
+        for node in ast.walk(fr.info.node):
+            if not isinstance(node, ast.Call):
+                continue
+            fname = call_name(node).rsplit(".", 1)[-1]
+            donated = donate_of.get(fname)
+            if not donated:
+                continue
+            donated_names = [
+                (idx, arg.id) for idx, arg in enumerate(node.args)
+                if idx in donated and isinstance(arg, ast.Name)
+            ]
+            if not donated_names:
+                continue
+            fcfg = cfg_cache.get(id(fr.info.node))
+            if fcfg is None:
+                fcfg = cfg_mod.build(fr.info.node)
+                cfg_cache[id(fr.info.node)] = fcfg
+            call_stmt = _stmt_of(node, parents)
+            if call_stmt is None or call_stmt not in fcfg.succ:
+                continue
+            for idx, name in donated_names:
+                if _rebinds_name(call_stmt, name):
+                    continue  # `buf = donor(buf)`: later reads are fresh
+                hit = _first_read_after(fcfg, call_stmt, name)
+                if hit is not None:
+                    yield ctx.finding(
+                        "jax-donated-after-use", hit,
+                        f"{name!r} was donated to {fname}() on line "
+                        f"{node.lineno} (donate_argnums position "
+                        f"{idx}) and is read again here: the buffer "
+                        "now belongs to XLA -- use the call's result "
+                        "or drop the donation",
+                    )
+
+
+def _first_read_after(fcfg: cfg_mod.CFG, src: ast.stmt,
+                      name: str) -> Optional[ast.stmt]:
+    """First CFG-reachable statement reading ``name`` with no rebind of
+    it on the path (a rebind makes later reads fresh)."""
+    seen: Set[int] = set()
+    frontier: List[object] = list(fcfg.succ.get(src, []))
+    while frontier:
+        node = frontier.pop()
+        if node is cfg_mod.EXIT or id(node) in seen or node is src:
+            continue
+        seen.add(id(node))
+        if _reads_name(node, name):  # type: ignore[arg-type]
+            return node  # type: ignore[return-value]
+        if _rebinds_name(node, name):  # type: ignore[arg-type]
+            continue  # fresh value past this point
+        frontier.extend(fcfg.succ.get(node, []))
+    return None
+
+
+# -- rule: loop-invariant transfers -----------------------------------------
+
+#: explicit H2D spellings (device-producer calls that ship host bytes)
+_H2D_CALLS = {
+    "jax.device_put", "jax.device_put_sharded", "jax.device_put_replicated",
+    "jnp.asarray", "jnp.array", "jax.numpy.asarray", "jax.numpy.array",
+    "residency.device_put", "residency.to_device", "_to_device",
+}
+
+
+def _assigned_names(stmts: List[ast.stmt]) -> Tuple[Set[str], Set[str]]:
+    """(names, self-attrs) stored anywhere under ``stmts``."""
+    names: Set[str] = set()
+    attrs: Set[str] = set()
+    stack: List[ast.AST] = list(stmts)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        if isinstance(node, (ast.Name,)) and isinstance(node.ctx,
+                                                        ast.Store):
+            names.add(node.id)
+        if isinstance(node, ast.Attribute) and \
+                isinstance(node.ctx, ast.Store) and \
+                isinstance(node.value, ast.Name) and \
+                node.value.id == "self":
+            attrs.add(node.attr)
+        stack.extend(ast.iter_child_nodes(node))
+    return names, attrs
+
+
+def _invariant_operand(expr: ast.AST, loop_names: Set[str],
+                       loop_attrs: Set[str]) -> Optional[str]:
+    """Spelling of ``expr`` when it provably does not change across loop
+    iterations: a Name never stored in the loop, or a self.<attr> never
+    stored in the loop."""
+    if isinstance(expr, ast.Name) and expr.id not in loop_names:
+        return expr.id
+    if isinstance(expr, ast.Attribute) and \
+            isinstance(expr.value, ast.Name) and \
+            expr.value.id == "self" and expr.attr not in loop_attrs:
+        return f"self.{expr.attr}"
+    return None
+
+
+@rule(
+    "jax-loop-invariant-transfer", "jax", SEV_WARNING,
+    "an H2D upload (device_put / jnp.asarray) or D2H pull of a value "
+    "that does not change across iterations sits inside a loop (or a "
+    "per-call upload of instance-constant state like jnp.asarray(self.B)"
+    " outside __init__): the same bytes cross the bus every pass.  "
+    "Hoist it out, or route codec matrices through the accounted upload"
+    " cache (ops/pipeline.py accounted_device_matrix)",
+)
+def check_loop_invariant_transfer(ctx: FileContext) -> Iterator[Finding]:
+    if not _in_ceph_tpu(ctx) or not ctx.imports_module("jax"):
+        return
+    analysis = flow.get(ctx)
+    reported: Set[Tuple[int, int]] = set()
+
+    def _once(node: ast.AST) -> bool:
+        mark = (getattr(node, "lineno", 0), getattr(node, "col_offset", 0))
+        if mark in reported:
+            return False
+        reported.add(mark)
+        return True
+
+    for fr in analysis.functions.values():
+        fn_node = fr.info.node
+        # iteration over a device array: per-element D2H of a value the
+        # loop itself does not change (the retired
+        # jax-device-array-iteration class, now lattice-aware)
+        for node in flow.ModuleResidency._own_stmts_and_exprs(fn_node):
+            if isinstance(node, (ast.For, ast.AsyncFor)) and \
+                    analysis.expr_res(fr, node.iter) == DEVICE_LATTICE \
+                    and _once(node):
+                yield ctx.finding(
+                    "jax-loop-invariant-transfer", node,
+                    "Python for-loop iterates a device array element-"
+                    "wise: every element is a separate blocking D2H; "
+                    "pull it to host once (device_get) outside the "
+                    "loop or vectorize the body",
+                )
+        # per-call upload of instance state (no loop needed: the caller
+        # IS the loop -- the mesh-codec self.B class)
+        if fn_node.name not in ("__init__", "__post_init__", "__new__"):
+            for node in flow.ModuleResidency._own_stmts_and_exprs(fn_node):
+                if isinstance(node, ast.Call) and \
+                        call_name(node) in _H2D_CALLS and node.args:
+                    op = node.args[0]
+                    if isinstance(op, ast.Attribute) and \
+                            isinstance(op.value, ast.Name) and \
+                            op.value.id == "self" and _once(node):
+                        yield ctx.finding(
+                            "jax-loop-invariant-transfer", node,
+                            f"per-call H2D of instance state "
+                            f"self.{op.attr}: every call re-ships the "
+                            "same bytes; upload once in __init__ or "
+                            "route through accounted_device_matrix "
+                            "(ops/pipeline.py)",
+                        )
+        # lexical loops: invariant H2D / invariant-device D2H inside
+        for loop in flow.ModuleResidency._own_stmts_and_exprs(fn_node):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            body = list(loop.body) + list(getattr(loop, "orelse", []))
+            loop_names, loop_attrs = _assigned_names(body)
+            if isinstance(loop, (ast.For, ast.AsyncFor)):
+                for n in ast.walk(loop.target):
+                    if isinstance(n, ast.Name):
+                        loop_names.add(n.id)
+            for node in _loop_own_nodes(body):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                name = call_name(node)
+                op = node.args[0]
+                inv = _invariant_operand(op, loop_names, loop_attrs)
+                if inv is None:
+                    continue
+                if name in _H2D_CALLS:
+                    if not _once(node):
+                        continue
+                    yield ctx.finding(
+                        "jax-loop-invariant-transfer", node,
+                        f"H2D upload of loop-invariant {inv} inside a "
+                        f"loop (line {loop.lineno}): the same bytes "
+                        "cross the bus every iteration; hoist the "
+                        "transfer (or the accounted matrix cache) out",
+                    )
+                elif (name in flow.EXPLICIT_D2H_CALLS or
+                        name in flow.IMPLICIT_SINK_CALLS) and \
+                        analysis.expr_res(fr, op) == DEVICE_LATTICE and \
+                        _once(node):
+                    yield ctx.finding(
+                        "jax-loop-invariant-transfer", node,
+                        f"D2H pull of loop-invariant device value {inv} "
+                        f"inside a loop (line {loop.lineno}): pull once"
+                        " outside the loop",
+                    )
+
+
+DEVICE_LATTICE = flow.DEVICE
+
+
+def _loop_own_nodes(body: List[ast.stmt]) -> Iterator[ast.AST]:
+    stack: List[ast.AST] = list(body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))

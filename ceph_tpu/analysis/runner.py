@@ -176,6 +176,56 @@ def changed_files(root: Optional[str] = None) -> List[str]:
     return sorted(out)
 
 
+def to_sarif(result: ScanResult) -> dict:
+    """SARIF 2.1.0 document for CI diff annotation (GitHub code
+    scanning et al. ingest this directly).  Only NEW findings are
+    results -- suppressed/baselined debt is the text/json surface's
+    business, a diff annotator wants exactly what fails the gate."""
+    registry = all_rules()
+    used = sorted({f.rule for f in result.new})
+    rules_meta = []
+    for name in used:
+        r = registry.get(name)
+        rules_meta.append({
+            "id": name,
+            "shortDescription": {
+                "text": (r.description if r is not None
+                         else "cephlint finding")},
+            "defaultConfiguration": {
+                "level": ("error" if (r and r.severity == SEV_ERROR)
+                          else "warning")},
+        })
+    results = []
+    for f in result.new:
+        results.append({
+            "ruleId": f.rule,
+            "level": "error" if f.severity == SEV_ERROR else "warning",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path,
+                                         "uriBaseId": "SRCROOT"},
+                    "region": {"startLine": max(1, f.line),
+                               "startColumn": f.col + 1},
+                }
+            }],
+        })
+    return {
+        "$schema": ("https://raw.githubusercontent.com/oasis-tcs/"
+                    "sarif-spec/master/Schemata/sarif-schema-2.1.0.json"),
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "cephlint",
+                "informationUri": "docs/cephlint.md",
+                "rules": rules_meta,
+            }},
+            "columnKind": "utf16CodeUnits",
+            "results": results,
+        }],
+    }
+
+
 def run(paths: Iterable[str], fmt: str = "text",
         baseline_path: Optional[str] = None,
         root: Optional[str] = None,
@@ -186,6 +236,8 @@ def run(paths: Iterable[str], fmt: str = "text",
                        excludes=excludes, rules=rules)
     if fmt == "json":
         out = json.dumps(result.to_dict(), indent=2)
+    elif fmt == "sarif":
+        out = json.dumps(to_sarif(result), indent=2)
     else:
         lines = [f.format() for f in result.new]
         lines.append(

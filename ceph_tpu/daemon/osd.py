@@ -320,6 +320,12 @@ async def serve(args) -> None:
             shard.tier.status(), name=name,
             modes={p: b.tier_mode for p, b in shard.pools.items()},
         ))
+        def _residency_status(cmd):
+            from ceph_tpu.analysis import residency
+
+            return residency.status()
+
+        asok.register("residency status", _residency_status)
         asok.register("hit_set ls", lambda cmd: shard.hitsets.dump())
         asok.register("hit_set temperature", lambda cmd: {
             "oid": cmd.get("oid", ""),

@@ -178,6 +178,38 @@ def prometheus_text(state: dict) -> str:
                   f"# TYPE ceph_client_{counter} counter",
                   f"ceph_client_{counter} "
                   f"{client_perf.get(counter, 0)}"]
+    # device-residency health (analysis/residency.py): transfers the
+    # storage layer performed through the counted seams and XLA
+    # recompiles.  Process-global (all in-process daemons share the one
+    # device), so these carry no ceph_daemon label -- a rising
+    # jit_retraces under steady traffic means the batch-shape bucketing
+    # regressed; a rising d2h on the write path means residency broke.
+    try:
+        from ceph_tpu.analysis import residency as _residency
+
+        rc = _residency.counters().snapshot()
+        lines += [
+            "# HELP ceph_jit_retraces_total XLA compilations observed "
+            "(one per jit retrace; cache hits emit none)",
+            "# TYPE ceph_jit_retraces_total counter",
+            f"ceph_jit_retraces_total {rc['jit_retraces']}",
+            "# HELP ceph_transfer_bytes_total bytes moved through the "
+            "storage layer's counted transfer seams",
+            "# TYPE ceph_transfer_bytes_total counter",
+            f'ceph_transfer_bytes_total{{direction="h2d"}} '
+            f"{rc['h2d_bytes']}",
+            f'ceph_transfer_bytes_total{{direction="d2h"}} '
+            f"{rc['d2h_bytes']}",
+            "# HELP ceph_transfer_ops_total transfer operations through "
+            "the counted seams",
+            "# TYPE ceph_transfer_ops_total counter",
+            f'ceph_transfer_ops_total{{direction="h2d"}} '
+            f"{rc['h2d_ops']}",
+            f'ceph_transfer_ops_total{{direction="d2h"}} '
+            f"{rc['d2h_ops']}",
+        ]
+    except Exception:  # noqa: BLE001 -- exposition must never fail
+        pass
     lines += ["# HELP ceph_pool_objects logical objects in the pool",
               "# TYPE ceph_pool_objects gauge",
               f"ceph_pool_objects {state['pools']['num_objects']}",

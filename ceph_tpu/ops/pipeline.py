@@ -49,6 +49,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ceph_tpu.analysis import residency
 from ceph_tpu.matrices.bitmatrix import invert_bitmatrix, matrix_to_bitmatrix
 from ceph_tpu.ops.gf import gf
 
@@ -90,6 +91,46 @@ def _release_h2d_entries(cache: "OrderedDict") -> None:
     for _d, nbytes in cache.values():
         acct.release("h2d", nbytes)
     cache.clear()
+
+
+#: content-keyed device uploads of codec matrices, charged to the "h2d"
+#: sub-allocation of the shared HBM ledger.  The engine-level encode
+#: paths (ops/xla_gf.py matrix/packet encode, parallel/distributed.py's
+#: mesh codec) used to re-ship their coding matrix on EVERY call --
+#: the jax-loop-invariant-transfer class tpusan now flags -- because
+#: they had no per-instance stream to hang the upload on.  This seam
+#: gives them one: same bytes -> same device array, LRU-evicted (and
+#: ledger-settled) under budget pressure like the stripe cache.
+_MATRIX_CACHE: "OrderedDict[Tuple, Tuple[object, int]]" = OrderedDict()
+_MATRIX_LOCK = threading.Lock()
+
+
+def accounted_device_matrix(arr: np.ndarray):
+    """Device-resident copy of ``arr``, cached by content and accounted
+    to the DeviceByteAccount ledger (budget: osd_tier_h2d_cache_bytes,
+    capped by osd_tier_hbm_bytes).  Falls back to the host array when
+    no jax backend is importable (callers degrade like the tier)."""
+    a = np.ascontiguousarray(arr)
+    key = (a.shape, str(a.dtype),
+           hashlib.blake2b(a, digest_size=16).digest())
+    with _MATRIX_LOCK:
+        hit = _MATRIX_CACHE.get(key)
+        if hit is not None:
+            _MATRIX_CACHE.move_to_end(key)
+            return hit[0]
+    d = residency.device_put(a)
+    from ceph_tpu.tier.device_tier import (DeviceByteAccount,
+                                           device_byte_account)
+
+    acct = device_byte_account()
+    budget = DeviceByteAccount.h2d_budget()
+    with _MATRIX_LOCK:
+        _MATRIX_CACHE[key] = (d, a.nbytes)
+        acct.charge("h2d", a.nbytes)
+        while _MATRIX_CACHE and acct.used("h2d") > budget:
+            _k, (_old, nb) = _MATRIX_CACHE.popitem(last=False)
+            acct.release("h2d", nb)
+    return d
 
 
 class DeviceStream:
@@ -143,6 +184,7 @@ class DeviceStream:
                 self._mode = "xla_packet"
         # force the upload now so it never lands inside a timed region
         jax.block_until_ready(self._B)
+        residency.note_h2d(int(getattr(self._B, "nbytes", 0) or 0))
 
     # -- host-side layout ---------------------------------------------------
 
@@ -203,9 +245,15 @@ class DeviceStream:
         return self.w * self.packetsize * (4 if self._mode == "pallas_packet" else 1)
 
     def dispatch(self, packed: np.ndarray):
-        """packed [rows_in, cols] -> device out array (async)."""
-        import jax
+        """packed [rows_in, cols] -> device out array (async).
 
+        The whole probe->upload->kernel stretch is a declared
+        device-resident region: the H2D of ``packed`` is the sanctioned
+        explicit upload edge, but nothing in here may pull a value BACK
+        to host (that is :meth:`EncodePipeline._land`'s one designed
+        D2H).  Statically checked by ``jax-d2h-in-resident-section``,
+        dynamically by the tier-1 transfer guard.
+        """
         key = None
         if _h2d_cache_enabled():
             # Collision-resistant content key: this cache sits on the
@@ -213,54 +261,60 @@ class DeviceStream:
             # 32-bit checksum is not acceptable — blake2b-128 is.
             key = (packed.shape,
                    hashlib.blake2b(packed, digest_size=16).digest())
-        with self._lock:
-            hit = self._h2d_cache.get(key) if key is not None else None
-            if hit is not None:
-                self._h2d_cache.move_to_end(key)
-        d = hit[0] if hit is not None else None
-        if d is None:
-            d = jax.device_put(packed)
-            if key is not None:
-                # retention is byte-budgeted against the shared HBM
-                # ledger: LRU entries fall out once the cache's
-                # sub-allocation (osd_tier_h2d_cache_bytes, itself
-                # capped by osd_tier_hbm_bytes) is exceeded across all
-                # streams of this process
-                from ceph_tpu.tier.device_tier import (DeviceByteAccount,
-                                                       device_byte_account)
+        # cephlint: device-resident-section encode-dispatch
+        with residency.resident_section("encode-dispatch"):
+            with self._lock:
+                hit = self._h2d_cache.get(key) if key is not None else None
+                if hit is not None:
+                    self._h2d_cache.move_to_end(key)
+            d = hit[0] if hit is not None else None
+            if d is None:
+                d = residency.device_put(packed)
+                if key is not None:
+                    # retention is byte-budgeted against the shared HBM
+                    # ledger: LRU entries fall out once the cache's
+                    # sub-allocation (osd_tier_h2d_cache_bytes, itself
+                    # capped by osd_tier_hbm_bytes) is exceeded across
+                    # all streams of this process
+                    from ceph_tpu.tier.device_tier import (
+                        DeviceByteAccount, device_byte_account)
 
-                acct = device_byte_account()
-                budget = DeviceByteAccount.h2d_budget()
-                with self._lock:
-                    self._h2d_cache[key] = (d, packed.nbytes)
-                    acct.charge("h2d", packed.nbytes)
-                    while self._h2d_cache and acct.used("h2d") > budget:
-                        _k, (_old, nb) = self._h2d_cache.popitem(last=False)
-                        acct.release("h2d", nb)
+                    acct = device_byte_account()
+                    budget = DeviceByteAccount.h2d_budget()
+                    with self._lock:
+                        self._h2d_cache[key] = (d, packed.nbytes)
+                        acct.charge("h2d", packed.nbytes)
+                        while self._h2d_cache and \
+                                acct.used("h2d") > budget:
+                            _k, (_old, nb) = self._h2d_cache.popitem(
+                                last=False)
+                            acct.release("h2d", nb)
 
-        n4 = packed.shape[1]
-        if self._mode == "pallas8":
-            from ceph_tpu.ops.pallas_gf import _matrix_encode_call
+            n4 = packed.shape[1]
+            if self._mode == "pallas8":
+                from ceph_tpu.ops.pallas_gf import _matrix_encode_call
 
-            return _matrix_encode_call(self._B, d, self.k, self.rows_out,
-                                       min(16384, n4))
-        if self._mode == "pallas16":
-            from ceph_tpu.ops.pallas_gf import _matrix_encode_w16_call
+                return _matrix_encode_call(self._B, d, self.k,
+                                           self.rows_out, min(16384, n4))
+            if self._mode == "pallas16":
+                from ceph_tpu.ops.pallas_gf import _matrix_encode_w16_call
 
-            return _matrix_encode_w16_call(self._B, d, self.k, self.rows_out,
-                                           min(4096, n4))
-        if self._mode == "pallas_packet":
-            from ceph_tpu.ops.pallas_gf import _packet_encode_call
+                return _matrix_encode_w16_call(self._B, d, self.k,
+                                               self.rows_out,
+                                               min(4096, n4))
+            if self._mode == "pallas_packet":
+                from ceph_tpu.ops.pallas_gf import _packet_encode_call
 
-            return _packet_encode_call(self._B, d, self._B.shape[0],
-                                       min(2048, n4))
-        if self._mode == "xla_words":
-            from ceph_tpu.ops.xla_gf import _encode_words_kernel
+                return _packet_encode_call(self._B, d, self._B.shape[0],
+                                           min(2048, n4))
+            if self._mode == "xla_words":
+                from ceph_tpu.ops.xla_gf import _encode_words_kernel
 
-            return _encode_words_kernel(self._B, d, self.w)
-        from ceph_tpu.ops.xla_gf import _encode_packets_kernel
+                return _encode_words_kernel(self._B, d, self.w)
+            from ceph_tpu.ops.xla_gf import _encode_packets_kernel
 
-        return _encode_packets_kernel(self._B, d)
+            return _encode_packets_kernel(self._B, d)
+        # cephlint: end-device-resident-section
 
     def release_h2d(self) -> None:
         """Retire this stream's upload cache (ledger-settling)."""
@@ -351,7 +405,11 @@ class EncodePipeline:
                 self._dispatch_pending()
 
     def _dispatch_pending(self) -> None:
-        # caller holds self._lock
+        # caller holds self._lock.  This is the coalescer's
+        # flush->encode cut: every client op batched this tick lands
+        # here as one fused granule.  From pack to in-flight append the
+        # granule must stay on its way INTO the device -- the one
+        # designed D2H is _land(), outside the declared region below.
         stream = self.stream
         entries = []
         col0 = 0
@@ -359,20 +417,25 @@ class EncodePipeline:
             entries.append((t, col0, b0, blen))
             col0 += stream.cols_of(blen)
         cols = self._rung_cols(col0)
-        buf = np.zeros((stream.rows_in(), cols), dtype=stream._row_dtype())
-        for (t, c0, b0, blen), (_t, data, _b0, _bl) in zip(entries, self._pending):
-            stream.pack_into(buf, c0, data[:, b0:b0 + blen])
-        out = stream.dispatch(buf)
-        DeviceStream.start_d2h(out)
-        self._inflight.append(_Granule(out, entries, cols))
-        self._pending.clear()
-        self._pending_cols = 0
+        # cephlint: device-resident-section granule-flush-encode
+        with residency.resident_section("granule-flush-encode"):
+            buf = np.zeros((stream.rows_in(), cols),
+                           dtype=stream._row_dtype())
+            for (t, c0, b0, blen), (_t, data, _b0, _bl) in zip(
+                    entries, self._pending):
+                stream.pack_into(buf, c0, data[:, b0:b0 + blen])
+            out = stream.dispatch(buf)
+            DeviceStream.start_d2h(out)
+            self._inflight.append(_Granule(out, entries, cols))
+            self._pending.clear()
+            self._pending_cols = 0
+        # cephlint: end-device-resident-section
         while len(self._inflight) > self.depth:
             self._land(self._inflight.popleft())
 
     def _land(self, g: _Granule) -> None:
         # caller holds self._lock
-        host = np.asarray(g.out)  # blocks until D2H completes
+        host = residency.device_get(g.out)  # blocks until D2H completes
         for t, c0, b0, blen in g.entries:
             if t not in self._need:
                 continue  # discarded

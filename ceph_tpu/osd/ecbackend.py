@@ -169,13 +169,25 @@ class ECBackend(PG):
             tier.invalidate(self.pool_name, oid)
             self.perf.inc("tier_stale_drop")
             return None
+        from ceph_tpu.analysis.residency import (device_get,
+                                                 resident_section)
+
         pos = ecutil.data_positions(self.ec)
-        if pos == list(range(self.k)):
-            # the common layout: data rows lead -- D2H only those
-            rows = np.asarray(ent.block[:self.k])
-        else:
-            host = np.asarray(ent.block)  # remapped chunks: whole block
-            rows = np.stack([host[p] for p in pos])
+        # row selection happens ON DEVICE; the declared region pins the
+        # hit path's roofline contract -- exactly one D2H (the seam
+        # below), of only the rows a read needs
+        # cephlint: device-resident-section tier-hit-read
+        with resident_section("tier-hit-read"):
+            if pos == list(range(self.k)):
+                # the common layout: data rows lead -- D2H only those
+                dev_rows = ent.block[:self.k]
+                remap = None
+            else:
+                dev_rows = ent.block  # remapped chunks: whole block
+                remap = pos
+        # cephlint: end-device-resident-section
+        host = device_get(dev_rows)  # the hit path's ONE designed D2H
+        rows = host if remap is None else np.stack([host[p] for p in remap])
         from ceph_tpu.tier.device_tier import reassemble_data_rows
 
         data = reassemble_data_rows(rows, self.sinfo.chunk_size)

@@ -32,15 +32,13 @@ import numpy as np
 
 
 def _to_device(arr: np.ndarray):
-    """One H2D transfer; falls back to the host array when no jax
-    backend is importable (the tier then degrades to a host cache with
-    identical semantics -- tests and codec-only tools keep working)."""
-    try:
-        import jax
+    """One H2D transfer through the counted residency seam; falls back
+    to the host array when no jax backend is importable (the tier then
+    degrades to a host cache with identical semantics -- tests and
+    codec-only tools keep working)."""
+    from ceph_tpu.analysis import residency
 
-        return jax.device_put(arr)
-    except Exception:  # noqa: BLE001 -- no backend: host residency
-        return np.ascontiguousarray(arr)
+    return residency.device_put(arr)
 
 
 class DeviceByteAccount:
@@ -233,20 +231,29 @@ class DeviceTierStore:
             if blk is None or blk.size == 0:
                 continue
             groups.setdefault(blk.shape[0], []).append(it)
+        from ceph_tpu.analysis.residency import resident_section
+
         n = 0
         for grp in groups.values():
             big = np.concatenate(
                 [np.asarray(it[2], dtype=np.uint8) for it in grp], axis=1
             )
-            dev = _to_device(big)
-            col = 0
-            for pool, oid, blk, version, logical_size in grp:
-                width = blk.shape[1]
-                self._insert(pool, oid, dev[:, col:col + width],
-                             version, logical_size, dirty=False,
-                             promoted=True)
-                col += width
-                n += 1
+            # the promote cut: ONE upload per group, then per-object
+            # device slices -- nothing may pull the freshly promoted
+            # block back to host between the transfer and the inserts
+            # (statically + transfer-guard enforced)
+            # cephlint: device-resident-section tier-promote-transfer
+            with resident_section("tier-promote-transfer"):
+                dev = _to_device(big)
+                col = 0
+                for pool, oid, blk, version, logical_size in grp:
+                    width = blk.shape[1]
+                    self._insert(pool, oid, dev[:, col:col + width],
+                                 version, logical_size, dirty=False,
+                                 promoted=True)
+                    col += width
+                    n += 1
+            # cephlint: end-device-resident-section
         if n:
             self.evict_to_budget()
         return n

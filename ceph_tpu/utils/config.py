@@ -179,6 +179,14 @@ OPTIONS: Dict[str, Option] = {
              "loop's task factory checks that no task ever suspends "
              "inside a declared `cephlint: atomic-section` region; "
              "CEPH_TPU_ATOMIC_VERIFY=0 disables the instrumentation"),
+        _opt("residency_verify", str, "1", LEVEL_DEV,
+             "tier-1 runtime device-resident-section verifier "
+             "(analysis/residency.py via tests/conftest.py): declared "
+             "`cephlint: device-resident-section` regions run under "
+             "jax.transfer_guard_device_to_host('disallow') and a seam "
+             "D2H inside one raises.  Values: 1/raise (default), "
+             "record (violations only fail the driving test), 0 (off; "
+             "CEPH_TPU_RESIDENCY_VERIFY=0 is the escape hatch)"),
         _opt("bench_probe_timeout", float, 120.0, LEVEL_DEV,
              "seconds bench.py allows each TPU availability probe"),
         _opt("bench_retry_secs", float, 600.0, LEVEL_DEV,

@@ -43,14 +43,44 @@ if os.environ.get("CEPH_TPU_ATOMIC_VERIFY", "1") != "0":
 
     _ATOMIC_VERIFIER = _atomic_runtime.install()
 
+# -- runtime device-resident-section verifier (analysis/residency.py) ------
+# Declared `cephlint: device-resident-section` regions run under
+# jax.transfer_guard_device_to_host("disallow") and a seam D2H inside
+# one raises at the offending call (raise mode, the default) or is
+# recorded and attributed to the driving test (record mode).  Disable
+# with CEPH_TPU_RESIDENCY_VERIFY=0.
+
+_RESIDENCY_VERIFIER = None
+_residency_mode = os.environ.get("CEPH_TPU_RESIDENCY_VERIFY", "1")
+if _residency_mode not in ("0", "off"):
+    from ceph_tpu.analysis import residency as _residency_runtime
+
+    _RESIDENCY_VERIFIER = _residency_runtime.install(
+        "record" if _residency_mode == "record" else "raise")
+
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
-    """Attribute atomic-section violations to the test whose event
-    loop produced them: the test that drove a task switch through a
-    declared yield-free region fails, right there."""
+    """Attribute atomic-section and residency violations to the test
+    whose run produced them: the test that drove a task switch through
+    a declared yield-free region (or a D2H through a declared
+    device-resident region) fails, right there."""
     before = len(_ATOMIC_VERIFIER.violations) if _ATOMIC_VERIFIER else 0
+    res_before = len(_RESIDENCY_VERIFIER.violations) \
+        if _RESIDENCY_VERIFIER else 0
     yield
+    if _RESIDENCY_VERIFIER is not None:
+        fresh_res = _RESIDENCY_VERIFIER.violations[res_before:]
+        if fresh_res:
+            del _RESIDENCY_VERIFIER.violations[res_before:]
+            rlines = "\n".join(f"  {v!r}" for v in fresh_res)
+            pytest.fail(
+                "D2H transfer inside declared device-resident "
+                "section(s) -- the region is marked transfer-free and "
+                "the storage path's roofline math relies on that "
+                f"invariant:\n{rlines}",
+                pytrace=False,
+            )
     if _ATOMIC_VERIFIER is None:
         return
     fresh = _ATOMIC_VERIFIER.violations[before:]

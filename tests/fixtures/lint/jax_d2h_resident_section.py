@@ -1,0 +1,70 @@
+"""Fixture: jax-d2h-in-resident-section.
+
+A declared device-resident region must not contain a D2H sink -- not
+directly, and not through a helper call (the residency lattice follows
+values interprocedurally).  The clean section shows the contract
+holding: device-side slicing and the explicit H2D upload edge are
+legal; only pulls BACK to host are not.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ceph_tpu.analysis.residency import device_get, resident_section
+
+
+def _helper_syncs_its_arg(block):
+    # the residency lattice marks parameter `block` as synced-to-host:
+    # callers handing a device value here D2H it transitively
+    return np.asarray(block)
+
+
+def _helper_returns_device(host_rows):
+    return jnp.asarray(host_rows)
+
+
+class Pipeline:
+    def violating_section(self, data):
+        d = jax.device_put(data)
+        # cephlint: device-resident-section violating
+        with resident_section("violating"):
+            sliced = d[0:4]
+            host = np.asarray(sliced)  # LINT: jax-d2h-in-resident-section
+            rows = _helper_syncs_its_arg(d)  # LINT: jax-d2h-in-resident-section
+            pulled = device_get(sliced)  # LINT: jax-d2h-in-resident-section
+        # cephlint: end-device-resident-section
+        return host, rows, pulled
+
+    def lattice_through_helper(self, host_rows):
+        # the device value is born inside a HELPER; the lattice carries
+        # its residency through the call into the section's sink
+        dev = _helper_returns_device(host_rows)
+        # cephlint: device-resident-section through-helper
+        with resident_section("through-helper"):
+            scaled = dev + 1
+            flat = scaled.tolist()  # LINT: jax-d2h-in-resident-section
+        # cephlint: end-device-resident-section
+        return flat
+
+    def clean_section(self, data):
+        d = jax.device_put(data)
+        # cephlint: device-resident-section clean
+        with resident_section("clean"):
+            up = jax.device_put(np.zeros(4, dtype=np.uint8))  # H2D: legal
+            sliced = d[0:2] + up[0:2]  # device-side ops: legal
+        # cephlint: end-device-resident-section
+        return device_get(sliced)  # the designed D2H, at the boundary
+
+
+# a declared region with no runtime resident_section() guard is itself
+# a finding: the static markers and the transfer_guard scope must pair
+def unguarded(data):
+    d = jax.device_put(data)
+    # cephlint: device-resident-section unguarded  # LINT: jax-d2h-in-resident-section
+    e = d + 1
+    # cephlint: end-device-resident-section
+    return e
+
+
+# an end marker with no open section is malformed
+# cephlint: end-device-resident-section  # LINT: jax-d2h-in-resident-section

@@ -1,0 +1,42 @@
+"""Fixture: jax-donated-after-use.
+
+donate_argnums hands the argument's buffer to XLA (the in-place
+update optimization); reading it after the call observes freed or
+aliased memory.  The branch case matters: a read on ONE CFG path is
+still a read.  A rebind kills the hazard -- later reads see the fresh
+value.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+_update = jax.jit(lambda buf, delta: buf + delta, donate_argnums=(0,))
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _scaled(buf, f):
+    return buf * f
+
+
+def read_on_one_branch(buf, delta, flag):
+    out = _update(buf, delta)
+    if flag:
+        return out.sum()
+    return buf.sum()  # LINT: jax-donated-after-use
+
+
+def read_after_decorated_donor(buf, f):
+    out = _scaled(buf, f)
+    total = buf.sum() + out.sum()  # LINT: jax-donated-after-use
+    return total
+
+
+def clean_rebind(buf, delta):
+    buf = _update(buf, delta)  # rebinding IS the sanctioned pattern
+    return buf.sum()
+
+
+def clean_result_use(buf, delta):
+    out = _update(buf, delta)
+    return out.sum()  # only the result is read: clean

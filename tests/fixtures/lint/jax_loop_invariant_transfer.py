@@ -1,0 +1,61 @@
+"""Fixture: jax-loop-invariant-transfer (under a ceph_tpu/ops path).
+
+The same bytes must not cross the bus every loop pass (or every method
+call): H2D of a loop-invariant value, iteration over a device array
+(one D2H per element), and the per-call upload of instance-constant
+state (the mesh-codec ``jnp.asarray(self.B)`` class) are all flagged.
+Variant operands and construction-time uploads are clean.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class MeshCodec:
+    def __init__(self, matrix):
+        self.B = matrix
+        self._Bd = jnp.asarray(matrix)  # upload at construction: clean
+
+    def encode(self, words):
+        return jnp.asarray(self.B) @ words  # LINT: jax-loop-invariant-transfer
+
+    def encode_hoisted(self, words):
+        return self._Bd @ words  # uses the construction-time upload
+
+
+def invariant_in_loop(matrix, blocks):
+    outs = []
+    for blk in blocks:
+        B = jax.device_put(matrix)  # LINT: jax-loop-invariant-transfer
+        outs.append(B @ jnp.asarray(blk))
+    return outs
+
+
+def variant_in_loop(blocks):
+    outs = []
+    for blk in blocks:
+        d = jax.device_put(blk)  # the loop target varies: clean
+        outs.append(d)
+    return outs
+
+
+def hoisted(matrix, blocks):
+    B = jax.device_put(matrix)  # before the loop: clean
+    return [B @ jnp.asarray(blk) for blk in blocks]
+
+
+def device_iteration(data):
+    dev = jnp.asarray(data)
+    total = 0
+    for row in dev:  # LINT: jax-loop-invariant-transfer
+        total += int(row.sum())
+    return total
+
+
+def invariant_d2h_in_loop(data, n):
+    dev = jnp.asarray(data)
+    outs = []
+    for i in range(n):
+        host = np.asarray(dev)  # LINT: jax-loop-invariant-transfer
+        outs.append(host[i])
+    return outs

@@ -1,0 +1,52 @@
+"""Fixture: jax-recompile-hazard (presented under a ceph_tpu/ops path).
+
+Three hazard shapes: per-call jax.jit construction, a raw
+shape-derived value fed to a static parameter (one XLA compile per
+distinct size), and a Python scalar literal fed to a traced parameter.
+The negatives show the sanctioned idioms: module-level jit, the
+bucketing-helper / constant-cap routing for static shapes, cached
+builders, and self-attribute caching.
+"""
+import functools
+
+import jax
+import jax.numpy as jnp
+
+
+@functools.partial(jax.jit, static_argnames=("tile",))
+def _kernel(B, d, tile):
+    return (B @ d)[:, :tile]
+
+
+@jax.jit
+def _plain_kernel(B, d):  # module-level jit: compiled once, clean
+    return B @ d
+
+
+def _rung_cols(n):
+    for b in (1 << 14, 1 << 16):
+        if n <= b:
+            return b
+    return 1 << 16
+
+
+class Dispatcher:
+    def __init__(self):
+        self._fn = jax.jit(lambda x: x + 1)  # cached on self: clean
+
+    def _build(self):
+        return jax.jit(lambda x: x * 2)  # builder return: caller caches
+
+    def hazards(self, B, d):
+        out = _kernel(B, d, d.shape[1])  # LINT: jax-recompile-hazard
+        per_call = jax.jit(lambda x: x - 1)  # LINT: jax-recompile-hazard
+        y = _kernel(B, 3, 16384)  # LINT: jax-recompile-hazard
+        kw = _kernel(B, d, tile=len(d))  # LINT: jax-recompile-hazard
+        return out, per_call, y, kw
+
+    def sanctioned(self, B, d):
+        a = _kernel(B, d, min(16384, d.shape[1]))  # capped: clean
+        b = _kernel(B, d, _rung_cols(d.shape[1]))  # bucketed: clean
+        c = _kernel(B, d, 16384)  # constant static: clean
+        e = _plain_kernel(B, d)
+        return a, b, c, e

@@ -38,7 +38,11 @@ def main(argv=None) -> int:
     ap.add_argument("paths", nargs="*", default=None,
                     help="files/directories to scan "
                          "(default: ceph_tpu tools tests)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text",
+                    help="sarif emits a SARIF 2.1.0 document (new "
+                         "findings only) for CI diff annotation; see "
+                         "tools/ci_lint.sh")
     ap.add_argument("--baseline", default=None,
                     help=f"baseline file (default {DEFAULT_BASELINE} "
                          "when it exists)")
@@ -83,6 +87,10 @@ def main(argv=None) -> int:
                 from ceph_tpu.analysis.runner import ScanResult
 
                 print(json.dumps(ScanResult().to_dict(), indent=2))
+            elif args.format == "sarif":
+                from ceph_tpu.analysis.runner import ScanResult, to_sarif
+
+                print(json.dumps(to_sarif(ScanResult()), indent=2))
             else:
                 print("cephlint: no changed files in scope")
             return 0

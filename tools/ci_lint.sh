@@ -1,0 +1,22 @@
+#!/bin/sh
+# cephlint CI wrapper: the two-speed gate.
+#
+#   1. A fast --changed pass renders the diff's findings as SARIF so CI
+#      can annotate the changed lines (GitHub code scanning ingests the
+#      file directly via upload-sarif).
+#   2. The full-tree gate (the exact scan tests/test_cephlint.py pins)
+#      then decides the exit code -- a finding anywhere fails CI, not
+#      just one the diff happened to touch.
+#
+# Usage: tools/ci_lint.sh [sarif-output-path]
+#   CEPHLINT_SARIF_OUT overrides the default cephlint.sarif.
+
+set -eu
+
+cd "$(dirname "$0")/.."
+out="${1:-${CEPHLINT_SARIF_OUT:-cephlint.sarif}}"
+
+python tools/cephlint.py --changed --format sarif > "$out"
+echo "cephlint: wrote diff-scoped SARIF to $out" >&2
+
+exec python tools/cephlint.py ceph_tpu tools tests

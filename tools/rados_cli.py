@@ -93,6 +93,26 @@ async def _run(args) -> int:
             print("no daemons with a tier admin socket", file=sys.stderr)
             return 1
         return 0
+    if args.cmd == "residency" or args.cmd == "residency-status":
+        # device-residency ledger per daemon (analysis/residency.py):
+        # seam transfer counts, jit retraces, verifier mode/violations
+        found = False
+        for sock in _asoks(args.dir):
+            st = await admin_command(sock, "residency status")
+            if "error" in st:
+                continue
+            found = True
+            c = st["counters"]
+            print(f"{sock.rsplit('/', 1)[-1]}\t"
+                  f"h2d {c['h2d_ops']} ops/{c['h2d_bytes']}B\t"
+                  f"d2h {c['d2h_ops']} ops/{c['d2h_bytes']}B\t"
+                  f"retraces {c['jit_retraces']}\tmode {st['mode']}\t"
+                  f"violations {len(st['violations'])}")
+        if not found:
+            print("no daemons with a residency admin socket",
+                  file=sys.stderr)
+            return 1
+        return 0
 
     c = await _connect(args.dir)
     try:
