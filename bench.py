@@ -673,6 +673,17 @@ def main() -> int:
         "storage_path_jit_retraces": (
             stage_residency.get("storage_path_host", {}).get(
                 "jit_retraces")),
+        # the round-13 write-lane contract, straight from the bench's
+        # own steady-state ledger (run_storage_path_bench FAILS the
+        # stage -- sp_host None -- on any steady retrace, so a non-null
+        # 0 here is a passed gate, not a default)
+        "storage_path_steady_jit_retraces": (
+            (sp_host["steady_jit_retraces"]["per_op"] +
+             sp_host["steady_jit_retraces"]["coalesced"])
+            if sp_host else None),
+        "storage_path_write_h2d_per_granule": (
+            sp_host["coalesced"]["residency"]["write"]["h2d_per_granule"]
+            if sp_host else None),
         "platform": jax.devices()[0].platform + (
             "-fallback"
             if os.environ.get("CEPH_TPU_BENCH_FALLBACK")

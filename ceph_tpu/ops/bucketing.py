@@ -1,0 +1,107 @@
+"""Shared batch-shape bucketing: the granule rung ladder.
+
+One XLA program per (matrix, shape) pair is the deal the persistent
+encode pipeline makes with the compiler; feeding it raw, workload-driven
+shapes breaks that deal one retrace at a time (the
+``jax-recompile-hazard`` class).  This module is the single source of
+truth for the sanctioned shape set: a small ladder of power-of-two byte
+rungs.  Every consumer pads its batch UP to the smallest fitting rung --
+padding waste is bounded by ~2x, GF parity is column-independent so
+zero-padding is bit-exact and trimmed on the way out -- and steady state
+therefore runs at **zero retraces** (the bench residency stage gates on
+exactly that number).
+
+Consumers:
+
+* ``ops/pipeline.py`` -- granule dispatch widths (this ladder replaces
+  the old private ``_LADDER_BYTES`` / ``EncodePipeline._rung_cols``);
+* ``osd/ecutil.py`` -- the shard-major helpers pad per-block for codecs
+  that opt in (``ec.shape_bucketing``) but fall outside the batched
+  pipeline;
+* ``plugins/tpu.py`` -- odd blocksizes (``_pipeline_ok`` false) are
+  padded up to an aligned rung so they ride the bucketed pipeline
+  instead of retracing the raw-shape engine kernels.
+
+The ladder is configurable (``osd_ec_shape_rungs``: comma/space
+separated byte counts) so tests can exercise tiny rungs; the parsed
+form is memoized per raw string.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Optional, Tuple
+
+#: default rung ladder: bytes per fused chunk-row, 16 KiB .. 16 MiB.
+#: Each rung is one XLA compilation per matrix shape; small sync writes
+#: (4 KiB EC stripes) land on the 16 KiB rung instead of being inflated
+#: to a fixed granule, and anything past the top rung is split into
+#: column segments by the pipeline (parity is columnwise, so exact).
+DEFAULT_RUNGS: Tuple[int, ...] = (
+    1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24,
+)
+
+_parse_lock = threading.Lock()
+_parsed: dict = {}
+
+
+def ladder() -> Tuple[int, ...]:
+    """The configured rung ladder (``osd_ec_shape_rungs``), ascending;
+    :data:`DEFAULT_RUNGS` when unset/unparseable.  Config access is
+    guarded so codec-only tools with no Config still bucket."""
+    try:
+        from ceph_tpu.utils.config import get_config
+
+        raw = str(get_config().get_val("osd_ec_shape_rungs")).strip()
+    except Exception:  # noqa: BLE001 -- no config layer: default ladder
+        raw = ""
+    if not raw:
+        return DEFAULT_RUNGS
+    with _parse_lock:
+        rungs = _parsed.get(raw)
+        if rungs is None:
+            try:
+                rungs = tuple(sorted({
+                    int(tok) for tok in raw.replace(",", " ").split()
+                    if int(tok) > 0
+                }))
+            except ValueError:
+                rungs = ()
+            rungs = _parsed[raw] = rungs or DEFAULT_RUNGS
+    return rungs
+
+
+def rung_for(nbytes: int, rungs: Optional[Tuple[int, ...]] = None
+             ) -> Optional[int]:
+    """Smallest rung >= ``nbytes``; None when past the top rung (the
+    caller splits into top-rung column segments)."""
+    for b in rungs if rungs is not None else ladder():
+        if nbytes <= b:
+            return b
+    return None
+
+
+def bucket_bytes(nbytes: int, align: int = 1,
+                 rungs: Optional[Tuple[int, ...]] = None) -> int:
+    """Padded byte count for a ``nbytes``-wide block: the smallest rung
+    that fits, rounded up to ``align`` (codec packet/lane granularity).
+    Past the top rung, the next ``align``-ed multiple of the top rung --
+    still a bounded shape set, one program per multiple."""
+    rungs = rungs if rungs is not None else ladder()
+    target = rung_for(nbytes, rungs)
+    if target is None:
+        top = rungs[-1]
+        target = ((nbytes + top - 1) // top) * top
+    return target + (-target) % max(1, align)
+
+
+def bucket_cols(need_cols: int, cols_of: Callable[[int], int],
+                rungs: Optional[Tuple[int, ...]] = None) -> Optional[int]:
+    """Granule width in device columns: the smallest rung (translated
+    through the stream's ``cols_of`` byte->column algebra) that fits
+    ``need_cols``; None past the top rung (caller caps at its max)."""
+    for b in rungs if rungs is not None else ladder():
+        c = cols_of(b)
+        if need_cols <= c:
+            return c
+    return None

@@ -84,8 +84,7 @@ def _matrix_kernel(b_ref, x_ref, o_ref, *, k: int, m: int):
     o_ref[:] = packed
 
 
-@functools.partial(jax.jit, static_argnames=("k", "m", "tile"))
-def _matrix_encode_call(Bp, d32, k: int, m: int, tile: int):
+def _matrix_encode_fn(Bp, d32, k: int, m: int, tile: int):
     n4 = d32.shape[1]
     return pl.pallas_call(
         functools.partial(_matrix_kernel, k=k, m=m),
@@ -97,6 +96,17 @@ def _matrix_encode_call(Bp, d32, k: int, m: int, tile: int):
         ],
         out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
     )(Bp, d32)
+
+
+#: jitted twins: the ``_donated`` form hands the packed data operand's
+#: HBM buffer to XLA (jit-level donation composes with pallas_call; the
+#: runtime frees/reuses the granule instead of double-holding it).  The
+#: donated operand is dead after the call -- pipeline rebinds it.
+_matrix_encode_call = jax.jit(
+    _matrix_encode_fn, static_argnames=("k", "m", "tile"))
+_matrix_encode_call_donated = jax.jit(
+    _matrix_encode_fn, static_argnames=("k", "m", "tile"),
+    donate_argnums=(1,))
 
 
 def matrix_encode_w8(
@@ -174,8 +184,7 @@ def _matrix_kernel_w16(b_ref, x_ref, o_ref, *, k: int, m: int):
     o_ref[:] = packed
 
 
-@functools.partial(jax.jit, static_argnames=("k", "m", "tile"))
-def _matrix_encode_w16_call(Bp, d32, k: int, m: int, tile: int):
+def _matrix_encode_w16_fn(Bp, d32, k: int, m: int, tile: int):
     n4 = d32.shape[1]
     return pl.pallas_call(
         functools.partial(_matrix_kernel_w16, k=k, m=m),
@@ -187,6 +196,13 @@ def _matrix_encode_w16_call(Bp, d32, k: int, m: int, tile: int):
         ],
         out_specs=pl.BlockSpec((m, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
     )(Bp, d32)
+
+
+_matrix_encode_w16_call = jax.jit(
+    _matrix_encode_w16_fn, static_argnames=("k", "m", "tile"))
+_matrix_encode_w16_call_donated = jax.jit(
+    _matrix_encode_w16_fn, static_argnames=("k", "m", "tile"),
+    donate_argnums=(1,))
 
 
 def matrix_encode_w16(
@@ -253,8 +269,7 @@ def _packet_kernel(b_ref, x_ref, o_ref, *, r: int):
     o_ref[:] = out
 
 
-@functools.partial(jax.jit, static_argnames=("r", "tile"))
-def _packet_encode_call(B, rows32, r: int, tile: int):
+def _packet_encode_fn(B, rows32, r: int, tile: int):
     n4 = rows32.shape[1]
     c = rows32.shape[0]
     return pl.pallas_call(
@@ -267,6 +282,13 @@ def _packet_encode_call(B, rows32, r: int, tile: int):
         ],
         out_specs=pl.BlockSpec((r, tile), lambda i: (0, i), memory_space=pltpu.VMEM),
     )(B, rows32)
+
+
+_packet_encode_call = jax.jit(
+    _packet_encode_fn, static_argnames=("r", "tile"))
+_packet_encode_call_donated = jax.jit(
+    _packet_encode_fn, static_argnames=("r", "tile"),
+    donate_argnums=(1,))
 
 
 def packet_encode(

@@ -44,6 +44,7 @@ from __future__ import annotations
 import hashlib
 import os
 import threading
+import warnings
 from collections import OrderedDict, deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -51,16 +52,55 @@ import numpy as np
 
 from ceph_tpu.analysis import residency
 from ceph_tpu.matrices.bitmatrix import invert_bitmatrix, matrix_to_bitmatrix
+from ceph_tpu.ops import bucketing
 from ceph_tpu.ops.gf import gf
 
-# Granule ladder: bytes per fused chunk-row.  Each rung is one XLA
-# compilation per (matrix shape); a dispatch picks the smallest fitting rung
-# so padding waste is bounded by ~2x, and small sync writes (4 KiB EC
-# stripes) land on the 16 KiB rung rather than being inflated to a fixed
-# granule.  Stripes larger than the top rung are split into column segments
-# (parity is columnwise, so the split is exact).
-_LADDER_BYTES = (1 << 14, 1 << 16, 1 << 18, 1 << 20, 1 << 22, 1 << 24)
+# The granule rung ladder moved to ceph_tpu/ops/bucketing.py (shared
+# with the ecutil shard-major helpers and the plugin's odd-shape lanes);
+# a dispatch picks the smallest fitting rung so padding waste is bounded
+# by ~2x and steady state compiles nothing.  Stripes larger than the top
+# rung are split into column segments (parity is columnwise, so the
+# split is exact).
 _DEFAULT_DEPTH = 3
+
+
+# Donation is advisory: XLA backends without aliasing support for a
+# layout (notably XLA:CPU) decline it and fall back to exactly the
+# undonated semantics, warning once per compiled program.  The fallback
+# is the designed cpu-fallback behavior here, so the warning is noise.
+warnings.filterwarnings(
+    "ignore", message="Some donated buffers were not usable")
+
+
+def _pipeline_tuning() -> Tuple[int, bool]:
+    """(overlap slots, donate) from config; safe defaults for codec-only
+    tools running before any Config exists."""
+    try:
+        from ceph_tpu.utils.config import get_config
+
+        cfg = get_config()
+        return (int(cfg.get_val("osd_ec_overlap_depth")),
+                bool(cfg.get_val("osd_ec_donate")))
+    except Exception:  # noqa: BLE001 -- no config layer
+        return 2, True
+
+
+_stats_lock = threading.Lock()
+_granules_dispatched = 0
+
+
+def granules_dispatched() -> int:
+    """Process-wide count of fused granule dispatches -- the residency
+    ledger's denominator: h2d_ops_delta / granules_delta is the
+    "<= 1 H2D per granule" driver-grade number bench gates on."""
+    with _stats_lock:
+        return _granules_dispatched
+
+
+def _note_granule() -> None:
+    global _granules_dispatched
+    with _stats_lock:
+        _granules_dispatched += 1
 
 
 def _backend_is_tpu() -> bool:
@@ -143,7 +183,8 @@ class DeviceStream:
     """
 
     def __init__(self, kind: str, B: np.ndarray, k: int, rows_out: int,
-                 w: int, packetsize: int = 0):
+                 w: int, packetsize: int = 0,
+                 gf_matrix: Optional[np.ndarray] = None):
         import jax
         import jax.numpy as jnp
 
@@ -172,6 +213,15 @@ class DeviceStream:
 
                 self._B = jnp.asarray(prep_matrix_w16(B, k))
                 self._mode = "pallas16"
+            elif w == 8 and gf_matrix is not None:
+                # off-TPU w=8 lane: GF(2^8) row-times-value lookup
+                # tables ([R, k, 256], 2 KiB/entry) beat the words
+                # kernel's 8x bit-plane inflation ~3.5x on a host core;
+                # same bytes, same [k, n] -> [R, n] contract
+                from ceph_tpu.ops.xla_gf import gf8_row_tables
+
+                self._B = jnp.asarray(gf8_row_tables(gf_matrix))
+                self._mode = "xla_bytes"
             else:
                 self._B = jnp.asarray(B)
                 self._mode = "xla_words"
@@ -244,18 +294,25 @@ class DeviceStream:
             return 4
         return self.w * self.packetsize * (4 if self._mode == "pallas_packet" else 1)
 
-    def dispatch(self, packed: np.ndarray):
-        """packed [rows_in, cols] -> device out array (async).
+    def upload(self, packed: np.ndarray, *, cacheable: bool = True):
+        """H2D slot of the two-slot dispatch pipeline: ship the packed
+        granule, optionally through the content-addressed upload cache.
+        Returns ``(device_array, from_cache)``.
 
-        The whole probe->upload->kernel stretch is a declared
-        device-resident region: the H2D of ``packed`` is the sanctioned
-        explicit upload edge, but nothing in here may pull a value BACK
-        to host (that is :meth:`EncodePipeline._land`'s one designed
-        D2H).  Statically checked by ``jax-d2h-in-resident-section``,
-        dynamically by the tier-1 transfer guard.
+        ``cacheable=False`` is the donation mode: the granule will be
+        handed to XLA by :meth:`compute`, so retaining (or even content-
+        hashing) it is wasted work -- donation and content-addressed
+        retention are mutually exclusive by design (``osd_ec_donate``).
+
+        The probe->upload stretch is a declared device-resident region:
+        the H2D of ``packed`` is the sanctioned explicit upload edge,
+        but nothing in here may pull a value BACK to host (that is
+        :meth:`EncodePipeline._land`'s one designed D2H).  Statically
+        checked by ``jax-d2h-in-resident-section``, dynamically by the
+        tier-1 transfer guard.
         """
         key = None
-        if _h2d_cache_enabled():
+        if cacheable and _h2d_cache_enabled():
             # Collision-resistant content key: this cache sits on the
             # durability path (ECBackend writes route through it), so a
             # 32-bit checksum is not acceptable — blake2b-128 is.
@@ -267,54 +324,121 @@ class DeviceStream:
                 hit = self._h2d_cache.get(key) if key is not None else None
                 if hit is not None:
                     self._h2d_cache.move_to_end(key)
-            d = hit[0] if hit is not None else None
-            if d is None:
-                d = residency.device_put(packed)
-                if key is not None:
-                    # retention is byte-budgeted against the shared HBM
-                    # ledger: LRU entries fall out once the cache's
-                    # sub-allocation (osd_tier_h2d_cache_bytes, itself
-                    # capped by osd_tier_hbm_bytes) is exceeded across
-                    # all streams of this process
-                    from ceph_tpu.tier.device_tier import (
-                        DeviceByteAccount, device_byte_account)
+            if hit is not None:
+                return hit[0], True
+            d = residency.device_put(packed)
+            if key is not None:
+                # retention is byte-budgeted against the shared HBM
+                # ledger: LRU entries fall out once the cache's
+                # sub-allocation (osd_tier_h2d_cache_bytes, itself
+                # capped by osd_tier_hbm_bytes) is exceeded across
+                # all streams of this process
+                from ceph_tpu.tier.device_tier import (
+                    DeviceByteAccount, device_byte_account)
 
-                    acct = device_byte_account()
-                    budget = DeviceByteAccount.h2d_budget()
-                    with self._lock:
-                        self._h2d_cache[key] = (d, packed.nbytes)
-                        acct.charge("h2d", packed.nbytes)
-                        while self._h2d_cache and \
-                                acct.used("h2d") > budget:
-                            _k, (_old, nb) = self._h2d_cache.popitem(
-                                last=False)
-                            acct.release("h2d", nb)
-
-            n4 = packed.shape[1]
-            if self._mode == "pallas8":
-                from ceph_tpu.ops.pallas_gf import _matrix_encode_call
-
-                return _matrix_encode_call(self._B, d, self.k,
-                                           self.rows_out, min(16384, n4))
-            if self._mode == "pallas16":
-                from ceph_tpu.ops.pallas_gf import _matrix_encode_w16_call
-
-                return _matrix_encode_w16_call(self._B, d, self.k,
-                                               self.rows_out,
-                                               min(4096, n4))
-            if self._mode == "pallas_packet":
-                from ceph_tpu.ops.pallas_gf import _packet_encode_call
-
-                return _packet_encode_call(self._B, d, self._B.shape[0],
-                                           min(2048, n4))
-            if self._mode == "xla_words":
-                from ceph_tpu.ops.xla_gf import _encode_words_kernel
-
-                return _encode_words_kernel(self._B, d, self.w)
-            from ceph_tpu.ops.xla_gf import _encode_packets_kernel
-
-            return _encode_packets_kernel(self._B, d)
+                acct = device_byte_account()
+                budget = DeviceByteAccount.h2d_budget()
+                with self._lock:
+                    self._h2d_cache[key] = (d, packed.nbytes)
+                    acct.charge("h2d", packed.nbytes)
+                    while self._h2d_cache and \
+                            acct.used("h2d") > budget:
+                        _k, (_old, nb) = self._h2d_cache.popitem(
+                            last=False)
+                        acct.release("h2d", nb)
+            return d, False
         # cephlint: end-device-resident-section
+
+    def compute(self, d, *, donate: bool = False):
+        """Kernel slot: apply the resident GF matrix to uploaded granule
+        ``d`` (async dispatch; nothing blocks until landing).
+
+        ``donate=True`` routes through the ``donate_argnums`` twin: the
+        granule's device buffer belongs to XLA after this call and the
+        caller must drop every reference (the rebind idiom
+        ``jax-donated-after-use`` blesses).  Never donate a cached
+        upload -- the cache entry would alias freed memory.
+        """
+        n4 = d.shape[1]
+        # cephlint: device-resident-section encode-compute
+        with residency.resident_section("encode-compute"):
+            if self._mode == "pallas8":
+                from ceph_tpu.ops.pallas_gf import (
+                    _matrix_encode_call, _matrix_encode_call_donated)
+
+                kern = _matrix_encode_call_donated if donate \
+                    else _matrix_encode_call
+                return kern(self._B, d, self.k, self.rows_out,
+                            min(16384, n4))
+            if self._mode == "pallas16":
+                from ceph_tpu.ops.pallas_gf import (
+                    _matrix_encode_w16_call, _matrix_encode_w16_call_donated)
+
+                kern = _matrix_encode_w16_call_donated if donate \
+                    else _matrix_encode_w16_call
+                return kern(self._B, d, self.k, self.rows_out,
+                            min(4096, n4))
+            if self._mode == "pallas_packet":
+                from ceph_tpu.ops.pallas_gf import (
+                    _packet_encode_call, _packet_encode_call_donated)
+
+                kern = _packet_encode_call_donated if donate \
+                    else _packet_encode_call
+                return kern(self._B, d, self._B.shape[0], min(2048, n4))
+            if self._mode == "xla_bytes":
+                from ceph_tpu.ops.xla_gf import (
+                    _encode_bytes_kernel, _encode_bytes_kernel_donated)
+
+                kern = _encode_bytes_kernel_donated if donate \
+                    else _encode_bytes_kernel
+                return kern(self._B, d)
+            if self._mode == "xla_words":
+                from ceph_tpu.ops.xla_gf import (
+                    _encode_words_kernel, _encode_words_kernel_donated)
+
+                kern = _encode_words_kernel_donated if donate \
+                    else _encode_words_kernel
+                return kern(self._B, d, self.w)
+            from ceph_tpu.ops.xla_gf import (
+                _encode_packets_kernel, _encode_packets_kernel_donated)
+
+            kern = _encode_packets_kernel_donated if donate \
+                else _encode_packets_kernel
+            return kern(self._B, d)
+        # cephlint: end-device-resident-section
+
+    def dispatch(self, packed: np.ndarray):
+        """One-shot compat: upload + compute in lockstep (the pipelined
+        path stages the two slots separately for H2D/matmul overlap)."""
+        d, _cached = self.upload(packed)
+        return self.compute(d)
+
+    def device_block(self, d_in, out, col0: int, blen: int):
+        """Promote-from-encode composition: the ``[k+m, blen]`` uint8
+        device block for the stripe at granule column ``col0`` -- data
+        rows sliced from the packed input, parity rows from the kernel
+        output, concatenated ON DEVICE.  No D2H and no re-upload: this
+        is the block the cache tier keeps instead of round-tripping the
+        host copy through ``put``.  None when the layout's device bytes
+        are not plain shard bytes (packet codes scramble bytes into
+        packet rows) or when the input was donated."""
+        if self.kind != "matrix" or d_in is None or out is None:
+            return None
+        try:
+            import jax
+            import jax.numpy as jnp
+        except Exception:  # noqa: BLE001 -- no backend: host put path
+            return None
+        ncols = self.cols_of(blen)
+        block = jnp.concatenate(
+            [d_in[:, col0:col0 + ncols], out[:, col0:col0 + ncols]],
+            axis=0)
+        if block.dtype != jnp.uint8:
+            # int32-lane (pallas) / w16/w32 word layouts: bitcast the
+            # lanes back to little-endian bytes, still on device
+            block = jax.lax.bitcast_convert_type(
+                block, jnp.uint8).reshape(block.shape[0], -1)
+        return block
 
     def release_h2d(self) -> None:
         """Retire this stream's upload cache (ledger-settling)."""
@@ -330,12 +454,13 @@ class DeviceStream:
 
 
 class _Granule:
-    __slots__ = ("out", "entries", "cols")
+    __slots__ = ("out", "entries", "cols", "d_in")
 
-    def __init__(self, out, entries, cols):
+    def __init__(self, out, entries, cols, d_in=None):
         self.out = out  # device array, in flight
         self.entries = entries  # [(ticket, granule_col0, stripe_b0, seg_bytes)]
         self.cols = cols
+        self.d_in = d_in  # packed input, retained only for keep_device
 
 
 class EncodePipeline:
@@ -349,34 +474,60 @@ class EncodePipeline:
     overlapping H2D / MXU compute / D2H.  Thread-safe; unclaimed results
     are held until result() or discard() — callers that abandon a ticket
     must discard it.
+
+    The dispatch itself is a two-slot pipeline (``osd_ec_overlap_depth``
+    slots): a granule's packed H2D is issued at dispatch time but its GF
+    matmul is deferred until the NEXT granule's upload is in flight, so
+    upload(N+1) rides under compute(N); ``jax.block_until_ready``
+    equivalents are deferred all the way to :meth:`_land`.  With
+    ``donate=True`` (``osd_ec_donate``) fresh granule uploads are handed
+    to XLA by the kernel (no double-held HBM, no content hash); cached
+    uploads and ``keep_device`` granules are never donated.
     """
 
     def __init__(self, stream: DeviceStream, depth: int = _DEFAULT_DEPTH,
-                 max_granule: int = _LADDER_BYTES[-1]):
+                 max_granule: Optional[int] = None,
+                 overlap: Optional[int] = None,
+                 donate: Optional[bool] = None):
         self.stream = stream
         self.depth = depth
+        if max_granule is None:
+            max_granule = bucketing.ladder()[-1]
         align = stream.seg_align_bytes()
         self._max_seg_bytes = max(align, max_granule - max_granule % align)
         self._max_cols = stream.cols_of(self._max_seg_bytes)
+        if overlap is None or donate is None:
+            cfg_overlap, cfg_donate = _pipeline_tuning()
+            overlap = cfg_overlap if overlap is None else overlap
+            donate = cfg_donate if donate is None else donate
+        self.overlap = max(1, int(overlap))
+        self.donate = bool(donate)
         self._lock = threading.RLock()
         self._pending: List[Tuple[int, np.ndarray, int, int]] = []
         self._pending_cols = 0
+        #: uploaded granules whose compute slot has not been issued yet
+        self._staged: deque = deque()
         self._inflight: deque[_Granule] = deque()
         self._parts: Dict[int, Dict[int, np.ndarray]] = {}
         self._need: Dict[int, Tuple[int, int]] = {}  # ticket -> (bs, nsegs)
         self._done: Dict[int, np.ndarray] = {}
+        self._keep: set = set()  # tickets wanting a resident device block
+        self._dev_parts: Dict[int, Dict[int, object]] = {}
+        self._dev_done: Dict[int, object] = {}
         self._next_ticket = 0
 
-    # granule col ladder: one XLA program per rung
+    # granule col ladder (ops/bucketing.py): one XLA program per rung
     def _rung_cols(self, need_cols: int) -> int:
-        for b in _LADDER_BYTES:
-            c = self.stream.cols_of(b)
-            if need_cols <= c:
-                return c
-        return self._max_cols
+        c = bucketing.bucket_cols(need_cols, self.stream.cols_of)
+        return self._max_cols if c is None else min(c, self._max_cols)
 
-    def submit(self, data: np.ndarray) -> int:
-        """data: [k, bs] uint8 (the k prepared data chunks of one stripe)."""
+    def submit(self, data: np.ndarray, keep_device: bool = False) -> int:
+        """data: [k, bs] uint8 (the k prepared data chunks of one stripe).
+
+        ``keep_device=True`` additionally composes the stripe's
+        [k+m, bs] block on device at landing time (promote-from-encode;
+        claim with :meth:`device_result` after :meth:`result`).  Such
+        granules are exempt from donation."""
         with self._lock:
             t = self._next_ticket
             self._next_ticket += 1
@@ -389,6 +540,9 @@ class EncodePipeline:
                 b0 += take
             self._need[t] = (bs, len(segs))
             self._parts[t] = {}
+            if keep_device:
+                self._keep.add(t)
+                self._dev_parts[t] = {}
             for b0, blen in segs:
                 seg_cols = self.stream.cols_of(blen)
                 if self._pending and self._pending_cols + seg_cols > self._max_cols:
@@ -403,12 +557,14 @@ class EncodePipeline:
         with self._lock:
             if self._pending:
                 self._dispatch_pending()
+            while self._staged:
+                self._issue_compute()
 
     def _dispatch_pending(self) -> None:
         # caller holds self._lock.  This is the coalescer's
         # flush->encode cut: every client op batched this tick lands
-        # here as one fused granule.  From pack to in-flight append the
-        # granule must stay on its way INTO the device -- the one
+        # here as one fused granule.  From pack to staged-upload append
+        # the granule must stay on its way INTO the device -- the one
         # designed D2H is _land(), outside the declared region below.
         stream = self.stream
         entries = []
@@ -417,6 +573,7 @@ class EncodePipeline:
             entries.append((t, col0, b0, blen))
             col0 += stream.cols_of(blen)
         cols = self._rung_cols(col0)
+        keep = any(t in self._keep for t, _c0, _b0, _bl in entries)
         # cephlint: device-resident-section granule-flush-encode
         with residency.resident_section("granule-flush-encode"):
             buf = np.zeros((stream.rows_in(), cols),
@@ -424,14 +581,30 @@ class EncodePipeline:
             for (t, c0, b0, blen), (_t, data, _b0, _bl) in zip(
                     entries, self._pending):
                 stream.pack_into(buf, c0, data[:, b0:b0 + blen])
-            out = stream.dispatch(buf)
-            DeviceStream.start_d2h(out)
-            self._inflight.append(_Granule(out, entries, cols))
+            # H2D slot: issue the upload now; the GF matmul slot runs
+            # when the next granule's upload is in flight (or at
+            # flush/claim).  Donation granules skip the content cache.
+            cacheable = not self.donate or keep
+            d, cached = stream.upload(buf, cacheable=cacheable)
+            self._staged.append((d, cached, keep, entries, cols))
             self._pending.clear()
             self._pending_cols = 0
         # cephlint: end-device-resident-section
+        while len(self._staged) >= self.overlap:
+            self._issue_compute()
         while len(self._inflight) > self.depth:
             self._land(self._inflight.popleft())
+
+    def _issue_compute(self) -> None:
+        # caller holds self._lock: compute slot of the two-slot pipeline
+        d, cached, keep, entries, cols = self._staged.popleft()
+        donate = self.donate and not cached and not keep
+        out = self.stream.compute(d, donate=donate)
+        g = _Granule(out, entries, cols, d if keep else None)
+        d = None  # donated (or handed to the granule): dead past here
+        DeviceStream.start_d2h(out)
+        _note_granule()
+        self._inflight.append(g)
 
     def _land(self, g: _Granule) -> None:
         # caller holds self._lock
@@ -441,6 +614,9 @@ class EncodePipeline:
                 continue  # discarded
             parts = self._parts[t]
             parts[b0] = self.stream.unpack(host, c0, blen)
+            if t in self._keep:
+                self._dev_parts[t][b0] = self.stream.device_block(
+                    g.d_in, g.out, c0, blen)
             bs, nsegs = self._need[t]
             if len(parts) == nsegs:
                 if nsegs == 1:
@@ -450,8 +626,24 @@ class EncodePipeline:
                     for pb0, block in parts.items():
                         whole[:, pb0:pb0 + block.shape[1]] = block
                     self._done[t] = whole
+                if t in self._keep:
+                    self._dev_done[t] = self._compose_device(t, nsegs)
                 del self._parts[t]
                 del self._need[t]
+
+    def _compose_device(self, ticket: int, nsegs: int):
+        """Join a keep_device ticket's per-segment device blocks along
+        the byte axis (still on device); None when any segment's layout
+        could not be composed."""
+        dsegs = self._dev_parts.pop(ticket, {})
+        if len(dsegs) != nsegs or any(b is None for b in dsegs.values()):
+            return None
+        if nsegs == 1:
+            return next(iter(dsegs.values()))
+        import jax.numpy as jnp
+
+        return jnp.concatenate(
+            [dsegs[b0] for b0 in sorted(dsegs)], axis=1)
 
     def result(self, ticket: int) -> np.ndarray:
         """Parity/reconstruction rows for the given stripe: [rows_out, bs]."""
@@ -462,12 +654,24 @@ class EncodePipeline:
                 self._land(self._inflight.popleft())
             return self._done.pop(ticket)
 
+    def device_result(self, ticket: int):
+        """Still-resident [k+m, bs] uint8 device block for a
+        ``keep_device`` ticket (promote-from-encode), or None when the
+        stream's layout could not compose one.  Claim after
+        :meth:`result`; single-shot."""
+        with self._lock:
+            self._keep.discard(ticket)
+            return self._dev_done.pop(ticket, None)
+
     def discard(self, ticket: int) -> None:
         """Abandon a ticket: its result will not be retained."""
         with self._lock:
             self._done.pop(ticket, None)
             self._parts.pop(ticket, None)
             self._need.pop(ticket, None)
+            self._keep.discard(ticket)
+            self._dev_parts.pop(ticket, None)
+            self._dev_done.pop(ticket, None)
 
     def drain(self) -> None:
         with self._lock:
@@ -576,7 +780,7 @@ class DeviceCodec:
             if self._encode_stream is None:
                 self._encode_stream = DeviceStream(
                     self.kind, self._enc_B, self.k, self.m, self.w,
-                    self.packetsize,
+                    self.packetsize, gf_matrix=self.matrix,
                 )
             return self._encode_stream
 
@@ -594,7 +798,8 @@ class DeviceCodec:
                 self.matrix, self.k, self.m, self.w, available, erased
             )
             B = matrix_to_bitmatrix(rows, self.w)
-            stream = DeviceStream("matrix", B, self.k, len(erased), self.w)
+            stream = DeviceStream("matrix", B, self.k, len(erased), self.w,
+                                  gf_matrix=rows)
         else:
             sel, rows = bitmatrix_reconstruct_rows(
                 self._enc_B, self.k, self.m, self.w, available, erased
@@ -614,8 +819,14 @@ class DeviceCodec:
     # -- one-shot conveniences (the sync plugin contract) -------------------
 
     def encode(self, data: np.ndarray) -> np.ndarray:
-        """[k, bs] u8 -> [m, bs] u8, single fused dispatch."""
-        pipe = EncodePipeline(self.encode_stream(), depth=0)
+        """[k, bs] u8 -> [m, bs] u8, single fused dispatch.
+
+        One-shot sync contract: donation is off so the content-addressed
+        upload cache keeps eliding repeat-content H2D (tools and engine
+        callers re-encode identical buffers).  The persistent write-lane
+        pipeline (always-fresh granules) is where ``osd_ec_donate``
+        applies."""
+        pipe = EncodePipeline(self.encode_stream(), depth=0, donate=False)
         t = pipe.submit(data)
         return pipe.result(t)
 
@@ -630,7 +841,7 @@ class DeviceCodec:
             raise ValueError("not enough chunks to decode")
         sel, stream = self.decode_stream(available, erased)
         survivors = np.stack([out[c] for c in sel])
-        pipe = EncodePipeline(stream, depth=0)
+        pipe = EncodePipeline(stream, depth=0, donate=False)
         rec = pipe.result(pipe.submit(survivors))
         for i, e in enumerate(erased):
             out[e] = rec[i]

@@ -43,8 +43,7 @@ _WORD_DTYPE = {8: np.uint8, 16: np.uint16, 32: np.uint32}
 # ---------------------------------------------------------------------------
 
 
-@functools.partial(jax.jit, static_argnames=("w",))
-def _encode_words_kernel(B: jax.Array, words: jax.Array, w: int) -> jax.Array:
+def _encode_words(B: jax.Array, words: jax.Array, w: int) -> jax.Array:
     """[R, k*w] bitmatrix x [k, n] w-bit words -> [R//w, n] words.
 
     Unpack word bit-planes -> MXU matmul -> mod 2 -> repack.  All three
@@ -69,8 +68,50 @@ def _encode_words_kernel(B: jax.Array, words: jax.Array, w: int) -> jax.Array:
     return packed.astype(words.dtype)
 
 
-@jax.jit
-def _encode_packets_kernel(B: jax.Array, rows: jax.Array) -> jax.Array:
+#: the jitted programs: one traced per (matrix shape, rung) pair.  The
+#: ``_donated`` twins additionally hand the data operand's buffer to XLA
+#: (``donate_argnums``): the packed granule stops double-holding HBM the
+#: moment the kernel takes it.  Callers MUST treat the donated operand
+#: as dead after the call (the ``jax-donated-after-use`` contract; the
+#: pipeline rebinds it to None at the call site).
+_encode_words_kernel = jax.jit(_encode_words, static_argnames=("w",))
+_encode_words_kernel_donated = jax.jit(
+    _encode_words, static_argnames=("w",), donate_argnums=(1,))
+
+
+def gf8_row_tables(matrix: np.ndarray) -> np.ndarray:
+    """[R, k] GF(2^8) coding matrix -> [R, k, 256] uint8 row-times-value
+    lookup tables (``tab[r, c, v] == matrix[r, c] * v`` in GF(2^8))."""
+    from ceph_tpu.ops.gf import gf
+
+    m = np.asarray(matrix, dtype=np.uint32) & 0xFF
+    return np.asarray(gf(8).mul_table, dtype=np.uint8)[m]
+
+
+def _encode_bytes(tab: jax.Array, data: jax.Array) -> jax.Array:
+    """[R, k, 256] GF(2^8) row tables x [k, n] bytes -> [R, n] bytes.
+
+    CPU-fallback lane for w=8 matrix codes: on a host core the words
+    kernel's 8x bit-plane inflation loses badly to one L1-resident
+    table gather per (row, chunk) pair (~3.5x at 16 KiB granules); the
+    MXU prefers the opposite trade, so the pallas/words modes keep the
+    TPU path and this lane is only selected off-TPU.
+    """
+    R, k = tab.shape[0], tab.shape[1]
+    g = tab[jnp.arange(R, dtype=jnp.int32)[:, None, None],
+            jnp.arange(k, dtype=jnp.int32)[None, :, None],
+            data[None, :, :]]  # [R, k, n] gathered products
+    out = g[:, 0, :]
+    for c in range(1, k):
+        out = out ^ g[:, c, :]
+    return out
+
+
+_encode_bytes_kernel = jax.jit(_encode_bytes)
+_encode_bytes_kernel_donated = jax.jit(_encode_bytes, donate_argnums=(1,))
+
+
+def _encode_packet_bits(B: jax.Array, rows: jax.Array) -> jax.Array:
     """[R, C] bitmatrix x [C, nbytes] packet rows -> [R, nbytes] bytes.
 
     Bytes are XOR-combined, which is 8 independent GF(2) systems (one per
@@ -92,6 +133,11 @@ def _encode_packets_kernel(B: jax.Array, rows: jax.Array) -> jax.Array:
         obits << shifts[None, None, :], axis=2
     )
     return packed.astype(jnp.uint8)
+
+
+_encode_packets_kernel = jax.jit(_encode_packet_bits)
+_encode_packets_kernel_donated = jax.jit(
+    _encode_packet_bits, donate_argnums=(1,))
 
 
 # ---------------------------------------------------------------------------

@@ -87,7 +87,7 @@ class BatchCoalescer:
         """Queue one work item; resolves with its dispatch result."""
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
-        self._pending.append((item, fut))
+        self._pending.append((item, fut, nbytes))
         self._pending_bytes += nbytes
         if (
             len(self._pending) >= self.max_batch
@@ -126,7 +126,7 @@ class BatchCoalescer:
 
     async def _run_batch(self, batch: List[tuple]) -> None:
         async with self._sem:
-            items = [item for item, _fut in batch]
+            items = [item for item, _fut, _nb in batch]
             try:
                 results = self._dispatch_many(items)
                 if asyncio.iscoroutine(results):
@@ -135,7 +135,7 @@ class BatchCoalescer:
                 raise
             except Exception as e:  # noqa: BLE001 -- each waiter gets the
                 # failure; the coalescer itself stays serviceable
-                for _item, fut in batch:
+                for _item, fut, _nb in batch:
                     if not fut.done():
                         fut.set_exception(
                             type(e)(*e.args) if e.args else IOError(str(e))
@@ -144,9 +144,11 @@ class BatchCoalescer:
             if self.perf is not None:
                 self.perf.inc(self._counter)
                 self.perf.inc(f"{self._counter}_items", len(batch))
+                self.perf.inc(f"{self._counter}_bytes",
+                              sum(nb for _i, _f, nb in batch))
                 if len(batch) > 1:
                     self.perf.inc(f"{self._counter}_batched",
                                   len(batch))
-            for (_item, fut), res in zip(batch, results):
+            for (_item, fut, _nb), res in zip(batch, results):
                 if not fut.done():
                     fut.set_result(res)

@@ -124,21 +124,34 @@ class ECBackend(PG):
 
     # -- batched codec dispatch (the stripe-batching pipeline seam) --------
 
-    def _encode_dispatch(self, blocks):
-        return ecutil.encode_shard_major_many(self.ec, blocks,
-                                              range(self.km))
+    def _encode_dispatch(self, items):
+        """items: (shard-major block, want_resident) pairs from
+        :meth:`_encode_op`; one fused, bucketed pipeline dispatch covers
+        the whole batch.  Returns (chunk_map, device_block) per item --
+        the device block is the still-resident [k+m, bs] encode output
+        for stripes the tier wants hot (promote-from-encode)."""
+        blocks = [b for b, _keep in items]
+        keep = [keep for _b, keep in items]
+        encs, devs = ecutil.encode_shard_major_many_resident(
+            self.ec, blocks, range(self.km), keep)
+        return list(zip(encs, devs))
 
     def _decode_dispatch(self, maps):
         return ecutil.decode_concat_many(self.sinfo, self.ec, maps)
 
-    async def _encode_op(self, buf) -> dict:
+    async def _encode_op(self, buf, want_resident: bool = False):
         """Client-op encode: the transpose runs per op (cheap host view
         work), the codec dispatch batches with every other client op in
-        flight this tick."""
-        if self._enc_coalescer is None:
-            return ecutil.encode(self.sinfo, self.ec, buf, range(self.km))
+        flight this tick.  Returns ``(chunk_map, device_block)`` --
+        the device block is None unless ``want_resident`` and the codec
+        composed one on device."""
         block = ecutil.to_shard_major(self.sinfo, self.k, buf)
-        return await self._enc_coalescer.submit(block, block.nbytes)
+        if self._enc_coalescer is None:
+            encs, devs = ecutil.encode_shard_major_many_resident(
+                self.ec, [block], range(self.km), [want_resident])
+            return encs[0], devs[0]
+        return await self._enc_coalescer.submit(
+            (block, want_resident), block.nbytes)
 
     async def _decode_op(self, chunks) -> bytes:
         """Client-op decode: stripes sharing an erasure signature ride
@@ -150,12 +163,16 @@ class ECBackend(PG):
 
     # -- device cache tier (ceph_tpu/tier/) --------------------------------
 
-    def _tier_read(self, oid: str) -> Optional[bytes]:
+    def _tier_read(self, oid: str, offset: Optional[int] = None,
+                   length: Optional[int] = None) -> Optional[bytes]:
         """Hit path: serve the logical bytes straight from the resident
         shard-major device block -- one D2H of the data rows + the
         logical transpose; no sub-read fan-out, no frombuffer ingest,
         and no decode even when the acting set is degraded (all km
-        positions are resident).  None = miss / tier off / stale."""
+        positions are resident).  With ``offset``/``length`` the column
+        selection ALSO happens on device: only the covering stripes'
+        chunk columns cross the bus, and the returned bytes are exactly
+        the requested extent.  None = miss / tier off / stale."""
         tier = self._tier
         if tier is None or self.tier_mode not in ("writeback", "readproxy"):
             return None
@@ -172,10 +189,22 @@ class ECBackend(PG):
         from ceph_tpu.analysis.residency import (device_get,
                                                  resident_section)
 
+        start = 0
+        if offset is not None:
+            if offset >= ent.logical_size:
+                return b""
+            length = min(length, ent.logical_size - offset)
+            start, span = self.sinfo.offset_len_to_stripe_bounds(
+                offset, length)
+            chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(
+                start)
+            chunk_len = (span // self.sinfo.stripe_width) * \
+                self.sinfo.chunk_size
         pos = ecutil.data_positions(self.ec)
-        # row selection happens ON DEVICE; the declared region pins the
-        # hit path's roofline contract -- exactly one D2H (the seam
-        # below), of only the rows a read needs
+        # row (and, for extents, chunk-column) selection happens ON
+        # DEVICE; the declared region pins the hit path's roofline
+        # contract -- exactly one D2H (the seam below), of only the
+        # bytes a read needs
         # cephlint: device-resident-section tier-hit-read
         with resident_section("tier-hit-read"):
             if pos == list(range(self.k)):
@@ -185,6 +214,9 @@ class ECBackend(PG):
             else:
                 dev_rows = ent.block  # remapped chunks: whole block
                 remap = pos
+            if offset is not None:
+                hi = min(chunk_off + chunk_len, dev_rows.shape[1])
+                dev_rows = dev_rows[:, chunk_off:hi]
         # cephlint: end-device-resident-section
         host = device_get(dev_rows)  # the hit path's ONE designed D2H
         rows = host if remap is None else np.stack([host[p] for p in remap])
@@ -192,7 +224,10 @@ class ECBackend(PG):
 
         data = reassemble_data_rows(rows, self.sinfo.chunk_size)
         self.perf.inc("tier_hit_read")
-        return data[:ent.logical_size]
+        if offset is None:
+            return data[:ent.logical_size]
+        lo = offset - start
+        return data[lo:lo + length]
 
     def _tier_hot(self, oid: str) -> bool:
         if self._hitset_temp is None:
@@ -203,14 +238,33 @@ class ECBackend(PG):
             get_config().get_val("osd_tier_promote_temp")
         )
 
+    def _promote_from_encode_on(self) -> bool:
+        """Promote-from-encode toggle: hand the tier the still-resident
+        encode output instead of re-uploading the host copy."""
+        if self._tier is None or self.tier_mode != "writeback":
+            return False
+        from ceph_tpu.utils.config import get_config
+
+        return bool(get_config().get_val("osd_tier_promote_from_encode"))
+
+    def _want_resident(self, oid: str, logical: int) -> bool:
+        """Should this write's encode keep its device block for the
+        tier?  Mirrors :meth:`_tier_write_update`'s put predicate so the
+        block is composed exactly when it will be inserted."""
+        return bool(logical) and self._promote_from_encode_on() and (
+            self._tier.contains(self.pool_name, oid) or self._tier_hot(oid)
+        )
+
     def _tier_write_update(self, oid: str, encoded, version,
-                           logical: int) -> bool:
+                           logical: int, dev_block=None) -> bool:
         """Write-through tier update: in writeback mode a hot (or
         already-resident) object's freshly encoded block -- the very
         arrays the coalescer's batched dispatch just produced -- replaces
         the resident copy, marked DIRTY until the fan-out commits
-        (promote-on-write, no extra gather or transfer beyond the
-        eventual device_put).  Any other resident copy is invalidated
+        (promote-on-write).  With ``dev_block`` (promote-from-encode)
+        the insert is the encode pipeline's still-resident [k+m, bs]
+        device output: ZERO re-upload -- otherwise the host arrays ride
+        one device_put.  Any other resident copy is invalidated
         (readproxy/cold writes must not serve pre-write bytes)."""
         tier = self._tier
         if tier is None or self.tier_mode == "none":
@@ -219,6 +273,10 @@ class ECBackend(PG):
         if self.tier_mode == "writeback" and logical and (
             resident or self._tier_hot(oid)
         ):
+            if dev_block is not None:
+                tier.put(self.pool_name, oid, dev_block, version, logical,
+                         dirty=True, resident_origin=True)
+                return True
             block = np.stack([
                 np.asarray(encoded[s], dtype=np.uint8)
                 for s in range(self.km)
@@ -252,8 +310,13 @@ class ECBackend(PG):
 
         span = trace.new_trace("ec write")
         span.event("start_rmw")
+        dev_block = None
         if padded_len:
-            encoded = await self._encode_op(buf)
+            # decide promote-from-encode BEFORE dispatch so the pipeline
+            # composes the [k+m, bs] device block exactly when the tier
+            # will insert it (and exempts that granule from donation)
+            encoded, dev_block = await self._encode_op(
+                buf, self._want_resident(oid, logical))
         else:
             # zero-byte object (S3 markers, touch): no stripes to encode
             encoded = [np.zeros(0, dtype=np.uint8) for _ in range(self.km)]
@@ -303,7 +366,8 @@ class ECBackend(PG):
         self.perf.inc("write")
         # write-through tier update BEFORE the fan-out: the block rides
         # dirty (unreadable) until the commit below confirms it
-        tier_put = self._tier_write_update(oid, encoded, version, logical)
+        tier_put = self._tier_write_update(oid, encoded, version, logical,
+                                           dev_block)
         try:
             await self._fanout_commit(
                 oid, tid, subs, {f"osd.{acting[s]}" for s in up},
@@ -369,12 +433,13 @@ class ECBackend(PG):
         ECBackend.cc:1021-1037 fragmented shard reads)."""
         if self._hitset_record is not None:
             self._hitset_record(oid)
-        cached = self._tier_read(oid)
+        cached = self._tier_read(oid, offset, length)
         if cached is not None:
             # whole-object residency serves any extent without a stat
-            # round-trip (logical_size already bounds the slice)
+            # round-trip; the stripe/chunk column selection happened ON
+            # DEVICE, so only the covering stripes' bytes crossed the bus
             self.perf.inc("read_range")
-            return cached[offset:offset + length]
+            return cached
         size, _ = await self._stat(oid)
         if offset >= size:
             return b""
@@ -438,7 +503,8 @@ class ECBackend(PG):
             data, dtype=np.uint8
         )
 
-        encoded = await self._encode_op(buf)
+        # an RMW's resident block is dropped below, so never keep one
+        encoded, _dev = await self._encode_op(buf)
         chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
 
         if plan.is_append and hinfo_d is not None and chunk_off == (

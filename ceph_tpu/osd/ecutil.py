@@ -16,11 +16,12 @@ Reference: src/osd/ECUtil.{h,cc}.
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ceph_tpu.native.gf_native import crc32c
+from ceph_tpu.native.gf_native import crc32c, crc32c_rows
+from ceph_tpu.ops import bucketing
 
 
 class StripeInfo:
@@ -110,25 +111,65 @@ def encode_shard_major_many(
     """ONE batched codec dispatch covering many shard-major [k, bs]
     blocks -- the write-path coalescer's dispatch function.
 
-    Pipeline-backed plugins fuse the whole set into granules
-    (``encode_batch``: one H2D + dispatch + D2H ladder covers every
-    block, bounded in-flight depth); other codecs fall back to one
-    encode per block.  Same bytes either way: each block's flattening is
-    exactly the per-shard chunk split the codec's own algebra performs.
+    Pipeline-backed plugins fuse the whole set into granules (one H2D +
+    dispatch + D2H ladder covers every block, bounded in-flight depth);
+    other codecs fall back to one encode per block.  Same bytes either
+    way: each block's flattening is exactly the per-shard chunk split
+    the codec's own algebra performs.
     """
+    encs, _devs = encode_shard_major_many_resident(ec, blocks, want, None)
+    return encs
+
+
+def encode_shard_major_many_resident(
+    ec,
+    blocks: List[np.ndarray],
+    want: Iterable[int],
+    keep_device: Optional[Sequence[bool]] = None,
+) -> Tuple[List[Dict[int, np.ndarray]], List[Optional[object]]]:
+    """:func:`encode_shard_major_many` plus the device-resident write
+    lane: ``keep_device[i]`` asks the codec to ALSO hand back stripe
+    i's still-resident ``[k+m, bs]`` device block (promote-from-encode
+    -- the cache tier inserts it with zero re-upload).  The second list
+    holds those blocks, None wherever the codec/layout cannot compose
+    one (callers fall back to the host put path).
+
+    Codecs advertising ``shape_bucketing`` get their blocks padded up
+    the shared rung ladder (``ops/bucketing.py``) on the per-block
+    fallback path, so even non-batched dispatch compiles a bounded
+    shape set; the batched lanes bucket at granule level inside the
+    pipeline."""
     want = list(want)
     km = ec.get_chunk_count()
+    devs: List[Optional[object]] = [None] * len(blocks)
+    if hasattr(ec, "encode_shard_major_batch") and \
+            all(b.shape[1] for b in blocks):
+        encs, devs = ec.encode_shard_major_batch(blocks, keep_device)
+        return [{i: enc[i] for i in want} for enc in encs], devs
     if hasattr(ec, "encode_batch") and all(b.shape[1] for b in blocks):
         encs = ec.encode_batch([b.reshape(-1) for b in blocks])
-        return [{i: enc[i] for i in want} for enc in encs]
+        return [{i: enc[i] for i in want} for enc in encs], devs
     out = []
+    bucket = bool(getattr(ec, "shape_bucketing", False))
+    align = getattr(ec, "bucket_align", lambda: 1)() if bucket else 1
     for b in blocks:
-        if b.shape[1] == 0:
+        bs = b.shape[1]
+        if bs == 0:
             out.append({i: np.zeros(0, dtype=np.uint8) for i in want})
             continue
+        if bucket:
+            # pad the column axis up the rung ladder (GF parity is
+            # columnwise: zero columns encode to zero and trim exactly)
+            target = bucketing.bucket_bytes(bs, align)
+            if target != bs:
+                padded = np.zeros((b.shape[0], target), dtype=np.uint8)
+                padded[:, :bs] = b
+                enc = ec.encode(set(range(km)), padded.reshape(-1))
+                out.append({i: enc[i][:bs] for i in want})
+                continue
         enc = ec.encode(set(range(km)), b.reshape(-1))
         out.append({i: enc[i] for i in want})
-    return out
+    return out, devs
 
 
 def encode_many(
@@ -225,17 +266,26 @@ class HashInfo:
     def append(self, old_size: int, to_append: Dict[int, np.ndarray]) -> None:
         assert old_size == self.total_chunk_size
         appended = 0
-        for shard, chunk in sorted(to_append.items()):
-            appended = len(chunk)
-            if self.cumulative_shard_hashes:
-                # hashes survive only on pure-append histories; once an
-                # overwrite cleared them (ec_overwrites semantics,
-                # reference ECUtil.cc hinfo reset) later appends track
-                # sizes only -- indexing the empty list was a crash on
-                # the append-after-overwrite path
-                self.cumulative_shard_hashes[shard] = crc32c(
-                    chunk, self.cumulative_shard_hashes[shard]
-                )
+        if self.cumulative_shard_hashes and to_append:
+            # hashes survive only on pure-append histories; once an
+            # overwrite cleared them (ec_overwrites semantics,
+            # reference ECUtil.cc hinfo reset) later appends track
+            # sizes only -- indexing the empty list was a crash on
+            # the append-after-overwrite path.  One batched FFI loop
+            # over the k+m chunks (crc32c_rows): at 2 KiB chunks the
+            # per-call wrapper cost ~4x the crc itself on the hot
+            # commit path
+            shards = sorted(to_append)
+            chunks = [to_append[s] for s in shards]
+            appended = len(chunks[-1])
+            hashes = crc32c_rows(
+                chunks, [self.cumulative_shard_hashes[s] for s in shards]
+            )
+            for s, h in zip(shards, hashes):
+                self.cumulative_shard_hashes[s] = h
+        else:
+            for _shard, chunk in to_append.items():
+                appended = len(chunk)
         self.total_chunk_size += appended
 
     def get_chunk_hash(self, shard: int) -> int:

@@ -24,7 +24,7 @@ from typing import Dict, List, Sequence
 
 import numpy as np
 
-from ceph_tpu.ops import xla_gf
+from ceph_tpu.ops import bucketing, xla_gf
 from ceph_tpu.ops.pipeline import DeviceCodec, EncodePipeline
 from ceph_tpu.plugins import jerasure as jer
 from ceph_tpu.plugins import registry as registry_mod
@@ -48,6 +48,10 @@ class _TpuMixin:
     _device_codec: DeviceCodec | None = None
     _mesh_codec = None
     _mesh_spec = (1, 1, 1)
+    #: the shard-major helpers may pad this codec's blocks up the shared
+    #: rung ladder (ops/bucketing.py): its engine kernels compile per
+    #: shape, so bucketing is what keeps steady state at zero retraces
+    shape_bucketing = True
 
     def _engine(self):
         return xla_gf  # fallback path for shapes the pipeline can't take
@@ -151,6 +155,30 @@ class _TpuMixin:
             )
         return self._device_codec
 
+    _shared_pipe: EncodePipeline | None = None
+
+    def _pipe(self) -> EncodePipeline:
+        """The PERSISTENT encode pipeline of this codec instance: one
+        jitted program per rung shared by every batched entry point
+        (encode_batch / encode_async / the shard-major lane), so steady
+        state never constructs pipeline state per call and the overlap
+        slots span calls.  Tickets are claimed within each call, so
+        reuse is state-free."""
+        if self._shared_pipe is None:
+            self._shared_pipe = EncodePipeline(self._dc().encode_stream())
+        return self._shared_pipe
+
+    def bucket_align(self) -> int:
+        """Zero-padding granularity that keeps a padded blocksize both
+        bit-exact (whole words / whole packet groups) and acceptable to
+        the pipeline's lane kernels."""
+        import math
+
+        if getattr(self, "matrix", None) is not None:
+            return math.lcm(4, self.w // 8)
+        return math.lcm(self.w * max(1, getattr(self, "packetsize", 0)),
+                        4 * self.w)
+
     def _pipeline_ok(self, blocksize: int) -> bool:
         """The packed-lane kernels want int32 lanes (matrix codes) or whole
         packet groups (bitmatrix codes); odd sizes fall back to the plain
@@ -165,8 +193,18 @@ class _TpuMixin:
     def jerasure_encode(self, data: np.ndarray) -> np.ndarray:
         if self._mesh_active():
             return self._mesh_encode_many([np.ascontiguousarray(data)])[0]
-        if self._pipeline_ok(data.shape[1]):
+        bs = data.shape[1]
+        if self._pipeline_ok(bs):
             return self._dc().encode(np.ascontiguousarray(data))
+        # odd blocksize: zero-pad the column axis up the shared rung
+        # ladder (whole words / packet groups, so parity of the padded
+        # block is the original parity plus zero columns) and ride the
+        # bucketed pipeline instead of retracing a raw-shape kernel
+        target = bucketing.bucket_bytes(bs, self.bucket_align())
+        if self._pipeline_ok(target):
+            padded = np.zeros((data.shape[0], target), dtype=np.uint8)
+            padded[:, :bs] = data
+            return self._dc().encode(padded)[:, :bs]
         return super().jerasure_encode(data)
 
     def jerasure_decode(self, have, blocksize):
@@ -188,6 +226,17 @@ class _TpuMixin:
             return out
         if self._pipeline_ok(blocksize):
             return self._dc().decode(have, blocksize)
+        target = bucketing.bucket_bytes(blocksize, self.bucket_align())
+        if self._pipeline_ok(target):
+            # reconstruction is columnwise too: decode the zero-padded
+            # survivors, trim every chunk back to the true blocksize
+            padded_have = {}
+            for c, arr in have.items():
+                buf = np.zeros(target, dtype=np.uint8)
+                buf[:blocksize] = np.asarray(arr, dtype=np.uint8)
+                padded_have[c] = buf
+            out = self._dc().decode(padded_have, target)
+            return {c: arr[:blocksize] for c, arr in out.items()}
         return super().jerasure_decode(have, blocksize)
 
     # -- batched / async API (TPU extension) -------------------------------
@@ -222,13 +271,15 @@ class _TpuMixin:
             out = []
             for p in prepared:
                 data = np.stack([p[j] for j in range(k)])
-                coding = super().jerasure_encode(data)
+                # self.jerasure_encode buckets odd blocksizes up the
+                # rung ladder into the pipeline (zero steady retraces)
+                coding = self.jerasure_encode(data)
                 enc = dict(p)
                 for i in range(m):
                     enc[k + i][:] = coding[i]
                 out.append(enc)
             return out
-        pipe = EncodePipeline(self._dc().encode_stream())
+        pipe = self._pipe()
         tickets = [
             pipe.submit(np.stack([p[j] for j in range(k)])) for p in prepared
         ]
@@ -241,6 +292,54 @@ class _TpuMixin:
                 enc[k + i] = coding[i]
             out.append(enc)
         return out
+
+    def encode_shard_major_batch(
+        self,
+        blocks: Sequence[np.ndarray],
+        keep_device: Sequence[bool] | None = None,
+    ):
+        """Shard-major fast lane for the ecutil write-path helpers:
+        ``[k, bs]`` uint8 blocks in, ``(chunk_maps, device_blocks)``
+        out.  The blocks ARE the prepared chunk rows, so this skips the
+        flatten -> encode_prepare -> restack round-trip of
+        :meth:`encode_batch` (one full-granule copy plus k+m chunk
+        allocations per stripe).  ``keep_device[i]`` asks for stripe
+        i's still-resident ``[k+m, bs]`` device block
+        (promote-from-encode); entries are None when the layout cannot
+        compose one."""
+        k, m = self.k, self.m
+        keep = list(keep_device) if keep_device is not None \
+            else [False] * len(blocks)
+        out: List = [None] * len(blocks)
+        devs: List = [None] * len(blocks)
+        pipe_idx = [
+            i for i, b in enumerate(blocks)
+            if not self._mesh_active() and self._pipeline_ok(b.shape[1])
+        ]
+        if pipe_idx:
+            pipe = self._pipe()
+            tickets = [
+                pipe.submit(np.asarray(blocks[i], dtype=np.uint8),
+                            keep_device=keep[i])
+                for i in pipe_idx
+            ]
+            pipe.flush()
+            for i, t in zip(pipe_idx, tickets):
+                coding = pipe.result(t)
+                enc = {self.chunk_index(j): blocks[i][j] for j in range(k)}
+                for j in range(m):
+                    enc[self.chunk_index(k + j)] = coding[j]
+                out[i] = enc
+                if keep[i]:
+                    devs[i] = pipe.device_result(t)
+        rest = [i for i in range(len(blocks)) if out[i] is None]
+        if rest:
+            # mesh / odd shapes: the generic batched path (mesh shards
+            # the dispatch; odd shapes bucket inside jerasure_encode)
+            encs = self.encode_batch([blocks[i].reshape(-1) for i in rest])
+            for i, enc in zip(rest, encs):
+                out[i] = enc
+        return out, devs
 
     def encode_async(self, data: bytes | np.ndarray):
         """Submit one stripe for encoding; returns a zero-arg callable that
@@ -260,9 +359,7 @@ class _TpuMixin:
         if not self._pipeline_ok(blocksize):
             result = self.encode(set(range(k + m)), data)
             return lambda: result
-        if getattr(self, "_shared_pipe", None) is None:
-            self._shared_pipe = EncodePipeline(self._dc().encode_stream())
-        pipe = self._shared_pipe
+        pipe = self._pipe()
         ticket = pipe.submit(np.stack([prepared[j] for j in range(k)]))
 
         def wait() -> Dict[int, np.ndarray]:

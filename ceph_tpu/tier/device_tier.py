@@ -209,12 +209,21 @@ class DeviceTierStore:
     # -- insertion / promotion ---------------------------------------------
 
     def put(self, pool: Optional[str], oid: str, block, version: tuple,
-            logical_size: int, dirty: bool = False) -> TierEntry:
+            logical_size: int, dirty: bool = False,
+            resident_origin: bool = False) -> TierEntry:
         """Insert/replace one object's shard-major block (host blocks are
         transferred; device arrays from ``put_many`` slicing are taken
-        as-is), then evict to budget."""
+        as-is), then evict to budget.
+
+        ``resident_origin=True`` marks a promote-from-encode insert: the
+        block is the encode pipeline's still-device-resident [km, bs]
+        output, so this put moves ZERO bytes over the bus (counted
+        separately -- ``tier_promote_from_encode`` is the write lane's
+        "no re-upload" proof counter)."""
         if isinstance(block, np.ndarray):
             block = _to_device(block)
+        elif resident_origin and self.perf is not None:
+            self.perf.inc("tier_promote_from_encode")
         ent = self._insert(pool, oid, block, version, logical_size, dirty)
         self.evict_to_budget()
         return ent

@@ -55,6 +55,35 @@ OPTIONS: Dict[str, Option] = {
              "gather concurrent client-op EC codec work into batched "
              "dispatches (the per-PG encode/decode coalescer; client "
              "ops only, recovery/scrub stay per-call)"),
+        _opt("osd_ec_donate", bool, True, LEVEL_ADVANCED,
+             "donate the packed encode granule's device buffer to XLA "
+             "(jit donate_argnums): encode stops double-holding the "
+             "input in HBM and skips the content-hash of the upload "
+             "cache.  Donation and content-addressed upload caching "
+             "are mutually exclusive retention modes -- set false to "
+             "restore the cached-upload behavior (re-encoding "
+             "byte-identical content then elides the H2D again)",
+             see_also=("osd_tier_h2d_cache_bytes", "no_h2d_cache")),
+        _opt("osd_ec_shape_rungs", str, "", LEVEL_ADVANCED,
+             "batch-shape bucketing ladder for the persistent encode "
+             "pipeline: comma/space-separated byte rungs (ascending); "
+             "batches pad up to the smallest fitting rung so steady "
+             "state runs at zero XLA retraces (ops/bucketing.py).  "
+             "Empty = the built-in 16KiB..16MiB power-of-two ladder"),
+        _opt("osd_ec_overlap_depth", int, 2, LEVEL_ADVANCED,
+             "encode pipeline H2D/compute overlap slots: granule N+1's "
+             "packed upload is issued while up to this many earlier "
+             "granules are still in the GF matmul (double-buffering at "
+             "2).  1 restores upload-then-compute-in-lockstep; the "
+             "in-flight D2H depth is bounded separately"),
+        _opt("osd_tier_promote_from_encode", bool, True, LEVEL_ADVANCED,
+             "hand the cache tier the still-device-resident encode "
+             "output when a written object should be hot (writeback "
+             "promote-on-write composes the [k+m, shard] block ON "
+             "device from the granule input and parity output: zero "
+             "re-upload) instead of re-uploading the host copy.  "
+             "Granules carrying such objects are never donated",
+             see_also=("osd_ec_donate", "osd_tier_promote_temp")),
         _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
              "max bytes per recovery window"),
         _opt("osd_pg_log_dups_tracked", int, 3000, LEVEL_ADVANCED,

@@ -1,0 +1,49 @@
+"""Fixture: jax-recompile-hazard -- the shared bucketing idiom
+(presented under a ceph_tpu/ops path).
+
+``ops/bucketing.py`` is the single source of truth for sanctioned
+shapes: batches pad UP to a small rung ladder, so one XLA program per
+rung covers every workload shape.  The negatives are the blessed
+spellings the write lane now uses everywhere (``bucket_cols`` /
+``bucket_bytes`` routed into static/shape positions, zero-padding to a
+rung then trimming); the positives are the raw workload-shape
+spellings the ladder exists to forbid.
+"""
+import functools
+
+import jax
+import numpy as np
+
+from ceph_tpu.ops import bucketing
+
+
+@functools.partial(jax.jit, static_argnames=("cols",))
+def _granule_kernel(B, d, cols):
+    return (B @ d)[:, :cols]
+
+
+def dispatch_raw_shape(B, d):
+    # one XLA compile per distinct batch width: the hazard class
+    return _granule_kernel(B, d, d.shape[1])  # LINT: jax-recompile-hazard
+
+
+def dispatch_bucketed(B, d, need_cols):
+    cols = bucketing.bucket_cols(need_cols, lambda b: b)
+    return _granule_kernel(B, d, cols)  # rung-routed: clean
+
+
+def pad_to_rung(ec, block, align):
+    # the ecutil shard-major idiom: zero-pad the column axis up the
+    # ladder (GF parity is columnwise, padding trims exactly), encode
+    # the bounded shape set, slice back
+    bs = block.shape[1]
+    target = bucketing.bucket_bytes(bs, align)
+    padded = np.zeros((block.shape[0], target), dtype=np.uint8)
+    padded[:, :bs] = block
+    enc = ec.encode(padded)
+    return enc[:, :bs]
+
+
+def per_call_program(d):
+    fn = jax.jit(lambda x: x + 1)  # LINT: jax-recompile-hazard
+    return fn(d)

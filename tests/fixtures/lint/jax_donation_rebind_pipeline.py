@@ -1,0 +1,53 @@
+"""Fixture: jax-donated-after-use -- the PR-13 write-lane seams.
+
+The persistent encode pipeline ships every granule through jitted
+donation twins (``*_donated = jax.jit(fn, donate_argnums=(1,))``): the
+packed upload's HBM buffer belongs to XLA after the kernel call.  The
+sanctioned idioms are the ones ``ops/pipeline.py`` uses at its
+two-slot dispatch seam: rebind the operand name (to the result, or to
+None when staging hands the reference to a granule record) before any
+later read.  The positives are exactly what the seam must never do:
+touch the donated granule after the kernel has it -- even on only one
+CFG path (the keep_device/compose branch).
+"""
+import jax
+
+_encode_call = jax.jit(lambda B, d: B @ d)
+_encode_call_donated = jax.jit(lambda B, d: B @ d, donate_argnums=(1,))
+
+
+def compose_after_donation(B, d, keep):
+    out = _encode_call_donated(B, d)
+    if keep:
+        # promote-from-encode must slice the INPUT too -- which is why
+        # the real pipeline exempts keep_device granules from donation
+        return out, d[:, :4]  # LINT: jax-donated-after-use
+    return out, None
+
+
+def ledger_after_donation(B, d):
+    out = _encode_call_donated(B, d)
+    nbytes = d.nbytes  # LINT: jax-donated-after-use
+    return out, nbytes
+
+
+def clean_rebind_to_result(B, d):
+    d = _encode_call_donated(B, d)  # the blessed rebind idiom
+    return d
+
+
+def clean_rebind_to_none(B, d, granules):
+    out = _encode_call_donated(B, d)
+    d = None  # staged-dispatch idiom: reference dies at the call site
+    granules.append(out)
+    return d
+
+
+def clean_keep_device_twin(B, d, keep):
+    # the pipeline's twin selection: keep_device granules route through
+    # the UNdonated program, so composing from d afterwards is fine
+    if keep:
+        out = _encode_call(B, d)
+        return out, d[:, :4]
+    out = _encode_call_donated(B, d)
+    return out, None

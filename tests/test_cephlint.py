@@ -42,6 +42,9 @@ FIXTURES = {
     "jax_d2h_resident_section.py": None,
     "jax_recompile_hazard.py": "ceph_tpu/ops/_fixture_recompile.py",
     "jax_donated_after_use.py": None,
+    # PR-13 write-lane idioms: donation-rebind + shared rung bucketing
+    "jax_donation_rebind_pipeline.py": None,
+    "jax_bucketing_pipeline.py": "ceph_tpu/ops/_fixture_bucketing.py",
     "jax_loop_invariant_transfer.py": "ceph_tpu/ops/_fixture_loopinv.py",
     "ceph_config_undeclared.py": None,
     "async_rmw_across_await.py": None,

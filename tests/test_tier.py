@@ -456,3 +456,80 @@ def test_prometheus_exports_tier_gauges():
         await c.shutdown()
 
     asyncio.run(main())
+
+
+# -- round 13: promote-from-encode (the device-resident write lane) ---------
+
+
+def test_promote_from_encode_inserts_resident_encode_output():
+    """A hot writeback write hands the tier the encode pipeline's
+    still-device-resident [k+m, bs] block instead of re-uploading the
+    host copy: the tier_promote_from_encode counter moves, the entry
+    serves reads, and with the toggle off the host put path is used
+    (counter still)."""
+
+    async def main():
+        PerfCounters.reset_all()
+        # the tpu plugin's pipeline is what composes device blocks;
+        # aligned payloads keep every write on the whole-stripe path
+        c = ECCluster(4, {"plugin": "tpu", "k": "2", "m": "1",
+                          "technique": "reed_sol_van"})
+        c.set_tier_mode("writeback")
+        v1 = bytes(range(256)) * 32
+        v2 = bytes(reversed(range(256))) * 32
+        await c.write("obj", v1)
+        for _ in range(2):
+            await c.read("obj")
+        await _tick_all(c)
+        shard, _ = _primary_shard(c, "obj")
+        assert shard.tier.contains(c.pool, "obj")
+        before = shard.perf.snapshot().get("tier_promote_from_encode", 0)
+        # resident + writeback => _want_resident: this write's encode
+        # keeps its device block and the tier put moves zero bus bytes
+        await c.write("obj", v2)
+        after = shard.perf.snapshot().get("tier_promote_from_encode", 0)
+        assert after == before + 1, (before, after)
+        ent = shard.tier.lookup(c.pool, "obj")
+        assert ent is not None and not ent.dirty
+        assert ent.logical_size == len(v2)
+        assert await c.read("obj") == v2
+        # extents ride the on-device column selection of the hit path
+        assert await c.read_range("obj", 1000, 500) == v2[1000:1500]
+        # toggle off: the write still write-promotes, via the host path
+        with config_vals(osd_tier_promote_from_encode=False):
+            await c.write("obj", v1)
+            final = shard.perf.snapshot().get(
+                "tier_promote_from_encode", 0)
+            assert final == after
+            assert await c.read("obj") == v1
+        await c.shutdown()
+
+    asyncio.run(main())
+
+
+def test_tier_range_read_extents_on_device():
+    """Range reads against a resident entry slice the covering stripes'
+    chunk columns ON DEVICE: every extent shape (stripe-interior,
+    stripe-crossing, tail, past-size) returns exactly the payload
+    slice."""
+
+    async def main():
+        PerfCounters.reset_all()
+        c = ECCluster(4, dict(PROFILE))
+        c.set_tier_mode("readproxy")
+        payload = np.random.RandomState(3).randint(
+            0, 256, size=5000, dtype=np.uint8).tobytes()
+        await c.write("obj", payload)
+        for _ in range(3):
+            await c.read("obj")
+        await _tick_all(c)
+        shard, _ = _primary_shard(c, "obj")
+        assert shard.tier.contains(c.pool, "obj")
+        for off, ln in ((0, 10), (1, 1), (100, 4000), (4990, 10),
+                        (4990, 500), (0, 5000), (2500, 2500)):
+            got = await c.read_range("obj", off, ln)
+            assert got == payload[off:off + ln], (off, ln)
+        assert await c.read_range("obj", 6000, 10) == b""
+        await c.shutdown()
+
+    asyncio.run(main())

@@ -1,15 +1,20 @@
 #!/bin/sh
-# cephlint CI wrapper: the two-speed gate.
+# cephlint CI wrapper: the two-speed gate, plus the transfer smoke.
 #
 #   1. A fast --changed pass renders the diff's findings as SARIF so CI
 #      can annotate the changed lines (GitHub code scanning ingests the
 #      file directly via upload-sarif).
-#   2. The full-tree gate (the exact scan tests/test_cephlint.py pins)
+#   2. A smoke-shape storage-path --profile run emits the per-stage
+#      transfer ledger (h2d/d2h ops+bytes, jit retraces) as JSON and
+#      FAILS on any steady-state retrace -- transfer regressions
+#      surface here, in CI, not in the next bench round.
+#   3. The full-tree gate (the exact scan tests/test_cephlint.py pins)
 #      then decides the exit code -- a finding anywhere fails CI, not
 #      just one the diff happened to touch.
 #
 # Usage: tools/ci_lint.sh [sarif-output-path]
 #   CEPHLINT_SARIF_OUT overrides the default cephlint.sarif.
+#   CEPHLINT_NO_SMOKE=1 skips the transfer smoke (lint-only runners).
 
 set -eu
 
@@ -18,5 +23,12 @@ out="${1:-${CEPHLINT_SARIF_OUT:-cephlint.sarif}}"
 
 python tools/cephlint.py --changed --format sarif > "$out"
 echo "cephlint: wrote diff-scoped SARIF to $out" >&2
+
+if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
+    python tools/ec_benchmark.py --plugin tpu --workload storage-path \
+        -P k=4 -P m=2 --objects 16 --size 4096 --writers 4 \
+        --iterations 2 --profile
+    echo "cephlint: storage-path transfer smoke passed" >&2
+fi
 
 exec python tools/cephlint.py ceph_tpu tools tests

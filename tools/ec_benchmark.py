@@ -58,6 +58,13 @@ def parse_args(argv):
                    help="concurrent writers for --workload storage-path")
     p.add_argument("--objects", type=int, default=64,
                    help="objects per storage-path pass")
+    p.add_argument("--profile", action="store_true",
+                   help="storage-path only: print the per-stage transfer "
+                        "ledger (h2d/d2h ops+bytes, jit retraces, granules, "
+                        "h2d-per-granule) as one JSON object instead of the "
+                        "full result -- the CI transfer-regression probe "
+                        "(tools/ci_lint.sh smoke mode).  Exits nonzero on "
+                        "any steady-state retrace (the harness gate)")
     p.add_argument("--payload", default="X", choices=["X", "random"],
                    help="payload contents: 'X' matches the reference tool "
                         "(ceph_erasure_code_benchmark.cc:173); 'random' "
@@ -161,6 +168,26 @@ def main(argv=None) -> int:
             ec, n_objects=args.objects, obj_bytes=args.size,
             writers=args.writers, iters=max(1, args.iterations),
         )
+        if args.profile:
+            # the transfer-ledger cut of the result: what CI diffs to
+            # catch residency regressions (a steady-state retrace
+            # already raised inside the harness -> nonzero exit)
+            print(json.dumps({
+                "workload": "storage-path",
+                "k": result["k"], "m": result["m"],
+                "n_objects": result["n_objects"],
+                "obj_bytes": result["obj_bytes"],
+                "bit_exact": result["bit_exact"],
+                "steady_jit_retraces": result["steady_jit_retraces"],
+                "ledger": {
+                    mode: result[mode]["residency"]
+                    for mode in ("per_op", "coalesced")
+                },
+                "write_h2d_per_granule": (
+                    result["coalesced"]["residency"]["write"]
+                    ["h2d_per_granule"]),
+            }))
+            return 0
         print(json.dumps(result))
         print(
             f"storage-path k={result['k']} m={result['m']} "
