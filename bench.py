@@ -561,6 +561,24 @@ def main() -> int:
 
     fo_host = _staged("failover_path_host", _failover_path_host)
 
+    def _recovery_path_host():
+        """Round-14 robustness metric: rebuild of two wiped OSDs' shards
+        through the batched background data plane (per-PG recovery
+        coalescer, fused decode, corked multi-push bursts, mClock-
+        admitted) vs the per-object windowed baseline, with a CONCURRENT
+        client workload on the same mClock queues.  Correctness-gated:
+        bit-exact reads after rebuild, byte-identical rebuilt stores
+        across modes, recovery_ops_batched > 0, and the client p99
+        during the batched rebuild must stay under the configured bound
+        (ceph_tpu/osd/recovery_bench.py)."""
+        from ceph_tpu.osd.recovery_bench import run_recovery_path_bench
+
+        return run_recovery_path_bench(
+            n_osds=8, n_objects=96, obj_bytes=32 << 10
+        )
+
+    rp_host = _staged("recovery_path_host", _recovery_path_host)
+
     def _lint_stage():
         """Static-health trend metrics: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json) as
@@ -656,6 +674,16 @@ def main() -> int:
         "failover_path_host_steady_p99_ms": (
             fo_host["steady_p99_ms"] if fo_host else None),
         "failover_path_host": fo_host,
+        "recovery_path_host_rebuild_speedup": (
+            rp_host["rebuild_speedup"] if rp_host else None),
+        "recovery_path_host_time_to_clean_s": (
+            rp_host["batched"]["time_to_clean_s"] if rp_host else None),
+        "recovery_path_host_client_p99_ms": (
+            rp_host["batched"]["client_p99_ms"] if rp_host else None),
+        "recovery_path_host_ops_batched": (
+            rp_host["batched"]["counters"]["recovery_ops_batched"]
+            if rp_host else None),
+        "recovery_path_host": rp_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
             lint_stage["by_rule"] if lint_stage else None),

@@ -339,3 +339,67 @@ def check_sync_lock_await(ctx: FileContext) -> Iterator[Finding]:
                     "asyncio lock (`async with`) across await points",
                 )
                 break  # one finding per with-block is enough
+
+
+#: await targets that move background data (recovery pushes, scrub /
+#: gather reads, fan-out commits) -- exact attr-name match
+_BG_IO_ATTRS = {
+    "send_message", "send_messages", "_fanout_commit", "_read_shards",
+    "_gather_consistent", "batched_sub_reads", "batched_pushes",
+}
+#: awaited attrs that count as admission/pacing between batches
+#: (substring match: throttle.admit, _recovery_pace, asyncio.sleep,
+#: wait/wait_for parks, semaphore.acquire)
+_BG_PACING_MARKS = ("admit", "pace", "sleep", "throttle", "wait",
+                    "acquire")
+#: function names that mark background-class work
+_BG_NAME_MARKS = ("recover", "scrub", "backfill", "background")
+
+
+@rule(
+    "async-background-unthrottled", "async", SEV_WARNING,
+    "background-class loop (recovery/backfill/scrub) issues pushes or "
+    "gather reads with no opqueue admit and no awaited pacing between "
+    "batches: a rebuild storm then competes unboundedly with client "
+    "traffic and starves client p99 -- admit through the "
+    "BackgroundThrottle (osd/recovery.py) or await pacing "
+    "(osd_recovery_sleep) once per batch",
+)
+def check_background_unthrottled(ctx: FileContext) -> Iterator[Finding]:
+    from ceph_tpu.analysis.core import enclosing_functions
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        lname = fn.name.lower()
+        if not any(mark in lname for mark in _BG_NAME_MARKS):
+            continue
+        for loop in ast.walk(fn):
+            if not isinstance(loop, (ast.For, ast.AsyncFor, ast.While)):
+                continue
+            holder = enclosing_functions(ctx, loop)
+            if not holder or holder[-1] is not fn:
+                continue  # a nested def's loop is its own scope
+            first_io = None
+            paced = False
+            for inner in ast.walk(loop):
+                # code inside a nested def does not run under this loop
+                if enclosing_functions(ctx, inner) != holder:
+                    continue
+                if isinstance(inner, ast.Await) and \
+                        isinstance(inner.value, ast.Call):
+                    attr = call_attr(inner.value)
+                    if attr in _BG_IO_ATTRS and first_io is None:
+                        first_io = inner
+                    elif any(m in attr.lower() for m in _BG_PACING_MARKS):
+                        paced = True
+                elif isinstance(inner, ast.Call) and \
+                        call_attr(inner) == "enqueue":
+                    paced = True  # admitted through an op queue
+            if first_io is not None and not paced:
+                yield ctx.finding(
+                    "async-background-unthrottled", first_io,
+                    f"loop in background function {fn.name}() awaits "
+                    f"{call_attr(first_io.value)}(...) with no throttle "
+                    "admit or awaited pacing in the loop body",
+                )

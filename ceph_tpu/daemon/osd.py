@@ -326,6 +326,40 @@ async def serve(args) -> None:
             return residency.status()
 
         asok.register("residency status", _residency_status)
+
+        def _recovery_status(cmd):
+            # background data-plane health (osd/recovery.py): batched
+            # rebuild counters, scrub cursor progress, throttle
+            # preemptions, per-pool dirty (pg_missing) depth and knobs
+            snap = shard.perf.snapshot()
+            return {
+                "name": name,
+                "batched": bool(get_config().get_val(
+                    "osd_recovery_batched")),
+                "counters": {
+                    key: snap.get(key, 0)
+                    for key in ("recovery_ops_batched", "recovery_bytes",
+                                "recovery_batches", "recovery_preempted",
+                                "recover", "recover_window",
+                                "recover_failed", "scrub_chunks",
+                                "tier_promote_from_recovery")
+                },
+                "client_ops_queued": shard._client_ops_queued,
+                "dirty_objects": {
+                    pool: len(b._dirty) + len(b._dirty_meta)
+                    for pool, b in shard.pools.items()
+                },
+                "knobs": {
+                    key: get_config().get_val(key)
+                    for key in ("osd_recovery_max_active",
+                                "osd_recovery_batch_bytes",
+                                "osd_recovery_sleep",
+                                "osd_scrub_chunk_max",
+                                "osd_tier_promote_on_recovery")
+                },
+            }
+
+        asok.register("recovery status", _recovery_status)
         asok.register("hit_set ls", lambda cmd: shard.hitsets.dump())
         asok.register("hit_set temperature", lambda cmd: {
             "oid": cmd.get("oid", ""),

@@ -171,6 +171,28 @@ def prometheus_text(state: dict) -> str:
     for name, s in sorted(state["osd_stats"].items()):
         lines.append(f'ceph_osd_dup_op_hit{{ceph_daemon="{name}"}} '
                      f"{s['perf'].get('dup_op_hit', 0)}")
+    # background data plane health (osd/recovery.py): batched rebuild
+    # volume, scrub cursor progress, throttle preemptions, and the
+    # promote-on-recovery proof counter -- a rebuild storm that starves
+    # clients shows up here as recovery_bytes rising with
+    # recovery_preempted flat (the throttle not engaging)
+    for counter, help_text in (
+        ("recovery_bytes", "bytes re-pushed by shard recovery"),
+        ("recovery_ops_batched",
+         "objects rebuilt through the batched recovery coalescer"),
+        ("scrub_chunks",
+         "batched deep-scrub read-cursor rounds issued"),
+        ("recovery_preempted",
+         "background batches that backed off for client traffic"),
+        ("tier_promote_from_recovery",
+         "rebuilt objects landed hot in the device tier by "
+         "promote-on-recovery"),
+    ):
+        lines += [f"# HELP ceph_osd_{counter} {help_text}",
+                  f"# TYPE ceph_osd_{counter} counter"]
+        for name, s in sorted(state["osd_stats"].items()):
+            lines.append(f'ceph_osd_{counter}{{ceph_daemon="{name}"}} '
+                         f"{s['perf'].get(counter, 0)}")
     client_perf = state["pools"].get("client_perf", {})
     for counter in ("op_resend", "backoff_received"):
         lines += [f"# HELP ceph_client_{counter} client-side {counter} "

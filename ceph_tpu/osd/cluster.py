@@ -253,6 +253,32 @@ class ECCluster:
         self.messenger.mark_down(f"osd.{osd_id}")
         self._notify_peering()
 
+    def wipe_osd(self, osd_id: int) -> None:
+        """Replacement-disk semantics: empty the OSD's object store and
+        device tier (its PG log survives -- the daemon kept running,
+        the disk was swapped), and reset every peer engine's watermark
+        for it (the new-incarnation signal an osdmap epoch bump carries
+        in the reference).  The next peering pass then takes the
+        backfill path and discovers every shard the OSD lost, which is
+        exactly the 'rebuild a killed OSD' scenario the recovery-path
+        bench and thrash tests drive."""
+        from ceph_tpu.osd.types import Transaction
+
+        osd = self.osds[osd_id]
+        txn = Transaction()
+        for stored in osd.store.list_objects():
+            txn.remove(stored)
+        osd.store.queue_transaction(txn)
+        osd._applied_version.clear()
+        osd.tier.clear()
+        osd._store_nonempty = False
+        osd._scrub_bases = None
+        for other in self.osds:
+            for backend in other.pools.values():
+                backend._peer_seq.pop(osd.name, None)
+                backend._peer_dup_seq.pop(osd.name, None)
+        self._notify_peering()
+
     def revive_osd(self, osd_id: int) -> None:
         self.messenger.mark_up(f"osd.{osd_id}")
         self._notify_peering()

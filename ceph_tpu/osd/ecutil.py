@@ -256,6 +256,36 @@ def decode_shards(
     return ec.decode(set(want), available)
 
 
+def decode_shards_many(
+    ec,
+    maps: List[Dict[int, np.ndarray]],
+    wants: List[Iterable[int]],
+) -> List[Dict[int, np.ndarray]]:
+    """Batched shard reconstruction -- the RECOVERY coalescer's fused
+    dispatch (peer of :func:`decode_concat_many` on the read path).
+
+    Many objects' source-chunk maps ride one batched codec call;
+    ``decode_batch`` groups maps sharing an erasure signature onto one
+    reconstruction stream (and the pipeline's rung-bucketed granules),
+    so a rebuild of N same-signature objects costs one fused dispatch,
+    not N.  Returns per map a dict covering at least ``wants[i]`` (the
+    batched path reconstructs every missing position; recovery uses
+    the extras for promote-on-recovery's full-block insert).  Codecs
+    without the batched API decode per map."""
+    results: List[Dict[int, np.ndarray]] = [{}] * len(maps)
+    need = [i for i, m in enumerate(maps)
+            if m and len(next(iter(m.values()))) > 0]
+    if not need:
+        return results
+    if hasattr(ec, "decode_batch"):
+        outs = ec.decode_batch([maps[i] for i in need])
+    else:
+        outs = [ec.decode(set(wants[i]), dict(maps[i])) for i in need]
+    for i, out in zip(need, outs):
+        results[i] = out
+    return results
+
+
 class HashInfo:
     """Per-shard cumulative crc32c + total per-shard size."""
 

@@ -128,10 +128,16 @@ _OP_REQID: "contextvars.ContextVar[Optional[tuple]]" = \
     contextvars.ContextVar("ceph_tpu_op_reqid", default=None)
 
 #: mclock_opclass-style defaults: (reservation, weight, limit) items/sec;
-#: clients get a floor and most of the weight, background work is capped
+#: clients get a floor and most of the weight.  Recovery carries NO hard
+#: limit since round 14: a degraded cluster must re-reach full
+#: redundancy as fast as spare capacity allows (time degraded == the
+#: data-loss risk window), so contention control is the 10:1
+#: client:recovery weight here plus the primary-side BackgroundThrottle
+#: (osd/recovery.py) backing batches off while the client queue is
+#: saturated.  Scrub keeps its cap: it is periodic and never urgent.
 MCLOCK_DEFAULTS = {
     "client": (1000.0, 100.0, 0.0),
-    "recovery": (100.0, 10.0, 2000.0),
+    "recovery": (100.0, 10.0, 0.0),
     "scrub": (50.0, 5.0, 1000.0),
 }
 
@@ -1312,14 +1318,35 @@ class PG:
         consistency (``_scrub_verify``: parity re-encode for EC, copy
         comparison for replicated) -- the deep-scrub role (reference: PG
         scrub + backend-specific checks; inconsistency report shape
-        follows ScrubStore's per-object errors)."""
-        acting = self.acting_set(oid)
-        up = [
-            s
-            for s in range(self.km)
-            if self._shard_up(acting, s)
-        ]
-        replies = await self._read_shards(oid, up, acting, op_class="scrub")
+        follows ScrubStore's per-object errors).  Since round 14 the
+        reads ride the batched background lane with a chunked cursor
+        (see :meth:`deep_scrub_many`)."""
+        return (await self.deep_scrub_many([oid]))[oid]
+
+    async def deep_scrub_many(self, oids: List[str]) -> Dict[str, dict]:
+        """Batched deep scrub: every object's shard reads ride the
+        chunked background cursor (``osd_scrub_chunk_max`` bytes per
+        shard per round, one corked multi-read burst per round for the
+        WHOLE set -- osd/recovery.py scrub_read_many) instead of one
+        whole-shard fan-out per object; verification is per object as
+        before.  Returns {oid: report}."""
+        from ceph_tpu.osd.recovery import scrub_read_many
+
+        gathered = await scrub_read_many(self, list(oids))
+        reports = {}
+        for oid in oids:
+            acting = self.acting_set(oid)
+            up = [
+                s for s in range(self.km) if self._shard_up(acting, s)
+            ]
+            reports[oid] = self._scrub_report(
+                oid, up, gathered.get(oid, {}))
+        return reports
+
+    def _scrub_report(self, oid: str, up: List[int],
+                      shards: Dict[int, dict]) -> dict:
+        """Classify one object's gathered shard cuts into the scrub
+        report (shared by the batched and single-object entry points)."""
         report = {
             "oid": oid,
             "crc_errors": [],
@@ -1330,15 +1357,16 @@ class PG:
         chunks: Dict[int, np.ndarray] = {}
         seen_versions = set()
         for s in up:
-            reply = replies.get(s)
-            if reply is None or oid in (reply.errors if reply else {}):
-                (report["crc_errors"] if reply else report["missing"]).append(s)
+            slot = shards.get(s)
+            if slot is None:
+                report["missing"].append(s)  # the shard never answered
                 continue
-            attrs = reply.attrs_read.get(oid) or {}
-            seen_versions.add(vt(attrs.get(VERSION_KEY)))
-            bufs = reply.buffers_read.get(oid)
-            if bufs:
-                chunks[s] = np.frombuffer(bufs[0][1], dtype=np.uint8)
+            if slot.get("error") is not None:
+                report["crc_errors"].append(s)
+                continue
+            seen_versions |= slot.get("versions") or {vt(None)}
+            if slot.get("had_buf"):
+                chunks[s] = np.frombuffer(slot["data"], dtype=np.uint8)
             else:
                 report["missing"].append(s)
         if len(seen_versions) > 1:
@@ -1394,6 +1422,34 @@ class PG:
         return repaired
 
     # -- recovery ----------------------------------------------------------
+
+    def _recovery(self):
+        """Lazy per-PG RecoveryCoalescer (the batched background data
+        plane, osd/recovery.py); shared by recovery and the scrub
+        cursor so one throttle governs all background I/O."""
+        rc = getattr(self, "_recovery_coalescer", None)
+        if rc is None:
+            from ceph_tpu.osd.recovery import RecoveryCoalescer
+
+            rc = self._recovery_coalescer = RecoveryCoalescer(self)
+        return rc
+
+    def _use_batched_recovery(self) -> bool:
+        """Batched recovery serves EC engines (the codec's fused decode
+        is the win); replicated pools keep the per-object path."""
+        from ceph_tpu.utils.config import get_config
+
+        return getattr(self, "ec", None) is not None and bool(
+            get_config().get_val("osd_recovery_batched"))
+
+    async def _recovery_pace(self) -> None:
+        """Awaited pacing between background recovery windows
+        (osd_recovery_sleep; 0 still yields so client ops interleave
+        -- the async-background-unthrottled discipline)."""
+        from ceph_tpu.utils.config import get_config
+
+        await asyncio.sleep(
+            float(get_config().get_val("osd_recovery_sleep")))
 
     async def recover_shard(
         self, oid: str, shard: int, target_osd: int, rollback: bool = False
@@ -1507,6 +1563,7 @@ class PG:
             self.perf.inc("recover_window")
             if last:
                 return True
+            await self._recovery_pace()
             off += len(piece)
             chunks, _, _, v2 = await self._gather_consistent(
                 oid, src, acting, extents=[(off, win)], op_class="recovery",
@@ -1867,6 +1924,13 @@ class PG:
                 meta_actions.append((oid, stale))
 
         failed: set = set()
+        if actions and self._use_batched_recovery():
+            # the batched background data plane (osd/recovery.py):
+            # corked multi-read gather, fused decode, corked multi-push
+            # -- throttled against client traffic; objects it cannot
+            # prove consistent fall back to the per-object path inside
+            failed |= await self._recovery().recover_actions(actions)
+            actions = []
         if actions or meta_actions:
             sem = asyncio.Semaphore(max_active)
 

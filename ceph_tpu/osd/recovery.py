@@ -1,0 +1,607 @@
+"""Batched background data plane: recovery coalescing + scrub cursors.
+
+Rounds 6-13 made the CLIENT write lane batched (per-PG coalescer),
+corked (multi-submit messenger bursts) and device-resident, but
+background data movement -- recovery pushes, backfill, deep-scrub reads
+-- still flowed one object, one message at a time (ROADMAP item 5).
+Online-EC studies show recovery I/O dominates degraded-mode cost
+(arXiv "Understanding System Characteristics of Online Erasure
+Coding...", "Exploring Fault-Tolerant Erasure Codes..."), so a rebuild
+storm was both slow AND able to starve client p99.
+
+This module routes background movement through the same batched
+shard-major plane the client path uses:
+
+* **RecoveryCoalescer** -- groups a peering pass's missing objects into
+  batches: ONE corked multi-read burst gathers every batch object's
+  source chunks (one ``ECSubRead`` per (source OSD, shard position)
+  covering all its objects), one fused ``decode_shards_many`` dispatch
+  reconstructs every lost shard (signature-grouped, riding the
+  rung-bucketed pipeline from PR 8), and ONE corked multi-push burst
+  ships the rebuilt shards (``ECSubWrite`` op_class="recovery").
+  Objects the batch cannot prove consistent (version races, oversized
+  shards past the per-object byte share) fall back to the per-object
+  windowed path -- correctness never rides the fast lane.
+* **promote-on-recovery** -- a rebuilt hot (or previously-resident)
+  object's FULL [km, shard_len] block is already in hand after the
+  fused decode; in writeback mode it lands straight in the device tier
+  (``tier_promote_from_recovery``), so the rebuilt object serves its
+  next read from HBM instead of going cold and waiting for the agent
+  to re-gather it from the shards it was just pushed to.
+* **BackgroundThrottle** -- every batch is admitted against an active
+  budget (``osd_recovery_max_active`` concurrent batches,
+  ``osd_recovery_batch_bytes`` gathered bytes each) and backs off while
+  the hosting OSD's client queue is saturated (``recovery_preempted``),
+  with bounded preemption so degraded objects that BLOCK client ops
+  still make forced progress; ``osd_recovery_sleep`` paces between
+  batches.  Receiving OSDs additionally queue every sub-op under the
+  mClock/WPQ ``recovery``/``scrub`` op classes as before.
+* **scrub_read_many** -- deep scrub's reads ride the same batched lane
+  with a chunked cursor (``osd_scrub_chunk_max`` bytes per shard per
+  round, ``scrub_chunks`` counted), instead of one whole-shard read
+  fan-out per object.
+
+cephlint's ``async-background-unthrottled`` rule pins the discipline:
+a background-class loop issuing pushes/reads must admit through the
+throttle or await pacing between batches.
+"""
+
+from __future__ import annotations
+
+import asyncio
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ceph_tpu.osd import ecutil
+from ceph_tpu.osd.pg import (SIZE_KEY, SNAPSET_KEY, VERSION_KEY,
+                             WHITEOUT_KEY, shard_oid, vt)
+from ceph_tpu.osd.types import ECSubRead, ECSubWrite, Transaction
+
+#: queued client ops above which background batches back off (the
+#: saturation signal; _cop_sem bounds execution at 64, so half a
+#: worker's width of queued-but-unserved clients means contention)
+CLIENT_PRESSURE_OPS = 16
+#: preemption rounds before a batch is forced through anyway: a
+#: degraded object can BLOCK the very client ops saturating the queue
+#: (reads needing the missing shard), so recovery must never be
+#: starved forever by the load it exists to unblock
+MAX_PREEMPT_ROUNDS = 20
+#: objects per batched dispatch (the byte budget is the real bound;
+#: this caps the per-batch fan-out bookkeeping)
+MAX_BATCH_OBJECTS = 32
+
+
+def _cfg():
+    from ceph_tpu.utils.config import get_config
+
+    return get_config()
+
+
+class BackgroundThrottle:
+    """Primary-side admission for background batches (recovery, scrub).
+
+    Bounds concurrent batches (``osd_recovery_max_active``) and backs
+    off while the hosting OSD's client queue is saturated; preemption
+    is bounded (forced progress) and every backoff round is counted
+    (``recovery_preempted``)."""
+
+    def __init__(self, backend):
+        self._backend = backend
+        self._sem: Optional[asyncio.Semaphore] = None
+        self._sem_width = 0
+
+    def _semaphore(self) -> asyncio.Semaphore:
+        width = max(1, int(_cfg().get_val("osd_recovery_max_active")))
+        if self._sem is None or width != self._sem_width:
+            self._sem = asyncio.Semaphore(width)
+            self._sem_width = width
+        return self._sem
+
+    def _client_pressure(self) -> bool:
+        shard = getattr(self._backend, "_host_shard", None)
+        if shard is None:
+            return False
+        return getattr(shard, "_client_ops_queued", 0) > CLIENT_PRESSURE_OPS
+
+    async def admit(self, force: bool = False) -> None:
+        """Claim one background-batch slot, backing off while client
+        traffic is saturated (bounded: forced progress after
+        MAX_PREEMPT_ROUNDS so degraded objects blocking client ops
+        still recover)."""
+        await self._semaphore().acquire()
+        rounds = 0
+        while not force and rounds < MAX_PREEMPT_ROUNDS \
+                and self._client_pressure():
+            self._backend.perf.inc("recovery_preempted")
+            rounds += 1
+            await asyncio.sleep(max(
+                0.005, float(_cfg().get_val("osd_recovery_sleep"))))
+
+    def release(self) -> None:
+        if self._sem is not None:
+            self._sem.release()
+
+    async def pace(self) -> None:
+        """Awaited pacing between batches (osd_recovery_sleep; 0 still
+        yields once so queued client ops interleave)."""
+        await asyncio.sleep(float(_cfg().get_val("osd_recovery_sleep")))
+
+
+# -- batched sub-op transport helpers ------------------------------------
+#
+# One pending-state entry per message, ONE send_messages submit for the
+# whole set: the TCP messenger's per-peer cork queues gather each peer's
+# share into a single scatter-gather burst (the PR-3 corked wire,
+# previously reserved for client fan-outs).
+
+async def batched_sub_reads(
+    backend,
+    reads: List[Tuple[str, int, Dict[str, list], List[str]]],
+    op_class: str,
+    timeout: float,
+) -> List[Optional[object]]:
+    """``reads``: (osd_name, from_shard, {oid: extents}, attrs_to_read)
+    per message.  Returns one ECSubReadReply (or None on loss/timeout)
+    per entry, in order."""
+    loop = asyncio.get_event_loop()
+    pend = []
+    subs = []
+    for osd_name, s, to_read, attrs in reads:
+        tid = backend._new_tid()
+        done = loop.create_future()
+        backend._pending[tid] = {
+            "replies": {}, "outstanding": {s}, "done": done,
+        }
+        pend.append((tid, s, done))
+        subs.append((osd_name, ECSubRead(
+            from_shard=s, tid=tid,
+            to_read={oid: list(ext) for oid, ext in to_read.items()},
+            attrs_to_read=list(attrs), op_class=op_class,
+        )))
+    await backend.messenger.send_messages(backend.name, subs)
+    if pend:
+        try:
+            await asyncio.wait_for(
+                asyncio.gather(*(d for _t, _s, d in pend)), timeout)
+        except asyncio.TimeoutError:
+            pass  # missing replies surface as None below
+    out = []
+    for tid, s, _done in pend:
+        state = backend._pending.pop(tid, None)
+        out.append(state["replies"].get(s) if state else None)
+    return out
+
+
+async def batched_pushes(
+    backend,
+    pushes: List[Tuple[str, ECSubWrite]],
+    timeout: float,
+) -> List[bool]:
+    """Ship every (target osd, sub-write) as ONE corked multi-submit
+    burst; returns per-push commit success, in order."""
+    loop = asyncio.get_event_loop()
+    pend = []
+    for target, _sub in pushes:
+        done = loop.create_future()
+        backend._pending[_sub.tid] = {
+            "committed": set(), "expected": {target}, "done": done,
+        }
+        pend.append((_sub.tid, done))
+    await backend.messenger.send_messages(backend.name, pushes)
+    if pend:
+        try:
+            # return_exceptions: one refused push must not abandon the
+            # rest of the burst's accounting
+            await asyncio.wait_for(
+                asyncio.gather(*(d for _t, d in pend),
+                               return_exceptions=True), timeout)
+        except asyncio.TimeoutError:
+            pass
+    out = []
+    for tid, done in pend:
+        state = backend._pending.pop(tid, None)
+        ok = bool(state and state["committed"])
+        if done.done() and not done.cancelled() and \
+                done.exception() is not None:
+            ok = False
+        out.append(ok)
+    return out
+
+
+# -- the recovery coalescer ----------------------------------------------
+
+class RecoveryCoalescer:
+    """Per-PG batched recovery driver (the background analogue of the
+    client-op BatchCoalescer).  Owned lazily by the PG engine; all
+    state is per-call, so concurrent peering passes just share the
+    throttle."""
+
+    def __init__(self, backend):
+        self.backend = backend
+        self.throttle = BackgroundThrottle(backend)
+
+    # -- entry point from the peering pass --------------------------------
+
+    async def recover_actions(self, actions: List[tuple]) -> set:
+        """Run a peering pass's recovery actions (oid, shard, target,
+        authoritative, rollback) through the batched plane; returns the
+        oids whose recovery failed (kept dirty for the next pass)."""
+        backend = self.backend
+        failed: set = set()
+        plain: Dict[str, List[tuple]] = {}
+        for oid, s, target, authoritative, rb in actions:
+            if rb and await backend._try_log_rollback(
+                oid, s, target, authoritative
+            ):
+                continue  # the shard healed itself from its own log
+            if tuple(authoritative) == (0, ""):
+                # torn copy with no assemblable object behind it: the
+                # rollback target is non-existence (rare; per-object)
+                try:
+                    await backend._remove_shard_copy(oid, s, target)
+                except asyncio.CancelledError:
+                    raise
+                except Exception:  # noqa: BLE001 -- retried next pass
+                    backend.perf.inc("recover_failed")
+                    failed.add(oid)
+                continue
+            plain.setdefault(oid, []).append((s, target, rb))
+
+        oids = sorted(plain)
+        for i in range(0, len(oids), MAX_BATCH_OBJECTS):
+            group = {oid: plain[oid] for oid in oids[i:i + MAX_BATCH_OBJECTS]}
+            await self.throttle.admit()
+            try:
+                fell_back = await self._recover_batch(group)
+            finally:
+                self.throttle.release()
+            # objects the batch could not prove consistent (version
+            # races, oversized shards, too few sources) take the
+            # windowed per-object path -- correctness over speed
+            for oid in fell_back:
+                for s, target, rb in group[oid]:
+                    try:
+                        await backend.recover_shard(
+                            oid, s, target, rollback=rb)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 -- next pass retries
+                        backend.perf.inc("recover_failed")
+                        failed.add(oid)
+            await self.throttle.pace()
+        return failed
+
+    # -- one batch ---------------------------------------------------------
+
+    async def _recover_batch(self, group: Dict[str, List[tuple]]) -> set:
+        """Gather + fused rebuild + corked push for one object group;
+        returns oids that must fall back to the per-object path.  Holds
+        every batch object's write lock (sorted acquisition order) so
+        client writes queue briefly behind the push instead of racing
+        it -- the same pinning recover_shard does, batch-wide."""
+        from contextlib import AsyncExitStack
+
+        backend = self.backend
+        async with AsyncExitStack() as stack:
+            for oid in sorted(group):
+                await stack.enter_async_context(backend._object_lock(oid))
+            return await self._recover_batch_locked(group)
+
+    async def _recover_batch_locked(self,
+                                    group: Dict[str, List[tuple]]) -> set:
+        backend = self.backend
+        cfg = _cfg()
+        fall_back: set = set()
+        # per-object source plan: chunk window sized so the whole
+        # batch's gathered bytes stay under osd_recovery_batch_bytes
+        batch_bytes = max(1, int(cfg.get_val("osd_recovery_batch_bytes")))
+        cs = backend.sinfo.chunk_size
+        share = batch_bytes // max(1, len(group)) // max(1, backend.k)
+        win = max(cs, share // cs * cs)
+
+        plans: Dict[str, dict] = {}
+        reads: Dict[Tuple[str, int], Dict[str, list]] = {}
+        attr_reads: Dict[Tuple[str, int], Dict[str, list]] = {}
+        for oid, jobs in group.items():
+            acting = backend.acting_set(oid)
+            want = sorted({s for s, _t, _rb in jobs})
+            up = [
+                s for s in range(backend.km)
+                if s not in want and backend._shard_up(acting, s)
+            ]
+            try:
+                src = backend._min_sources(want, up)
+            except Exception:  # noqa: BLE001 -- unassemblable right now
+                fall_back.add(oid)
+                continue
+            plans[oid] = {"acting": acting, "want": want, "src": src}
+            for s in src:
+                key = (f"osd.{acting[s]}", s)
+                reads.setdefault(key, {})[oid] = [(0, win)]
+            for s in up:
+                if s in src:
+                    continue
+                # attr-only round from the remaining up shards: the
+                # minimum source set alone cannot prove the
+                # authoritative version (same rule as _gather_consistent)
+                key = (f"osd.{acting[s]}", s)
+                attr_reads.setdefault(key, {})[oid] = [(0, 0)]
+
+        read_list = [
+            (osd, s, to_read, sorted(to_read))
+            for (osd, s), to_read in list(reads.items())
+            + list(attr_reads.items())
+        ]
+        timeout = float(cfg.get_val("osd_read_gather_timeout"))
+        replies = await batched_sub_reads(
+            backend, read_list, "recovery", timeout)
+
+        # collate per (oid, shard): chunks / versions / sizes / attrs
+        per_oid: Dict[str, dict] = {
+            oid: {"chunks": {}, "versions": {}, "sizes": {}, "attrs": {}}
+            for oid in plans
+        }
+        for (osd, s, to_read, _attrs), reply in zip(read_list, replies):
+            if reply is None:
+                continue
+            for oid in to_read:
+                if oid not in per_oid or oid in reply.errors:
+                    continue
+                slot = per_oid[oid]
+                bufs = reply.buffers_read.get(oid)
+                if bufs and len(bufs[0][1]):
+                    slot["chunks"][s] = np.frombuffer(
+                        bufs[0][1], dtype=np.uint8)
+                attrs = reply.attrs_read.get(oid) or {}
+                if attrs:
+                    slot["attrs"][s] = attrs
+                    if attrs.get(SIZE_KEY) is not None:
+                        slot["sizes"][s] = attrs[SIZE_KEY]
+                    slot["versions"][s] = vt(attrs.get(VERSION_KEY))
+
+        # -- per-object consistency election, then ONE fused decode ------
+        maps: List[Dict[int, np.ndarray]] = []
+        wants: List[List[int]] = []
+        ready: List[str] = []
+        for oid, plan in plans.items():
+            slot = per_oid[oid]
+            if not slot["versions"]:
+                fall_back.add(oid)
+                continue
+            target_v = max(slot["versions"].values())
+            holders = [s for s, v in slot["versions"].items()
+                       if v == target_v]
+            have = {s: slot["chunks"][s] for s in holders
+                    if s in slot["chunks"]}
+            size = next((slot["sizes"][s] for s in holders
+                         if slot["sizes"].get(s) is not None), None)
+            zero_len = size == 0 and not have
+            if size is None or (len(have) < backend.k and not zero_len):
+                # stale mix / missing size / newest version not
+                # assemblable from this cut: the windowed path's full
+                # version-authoritative gather decides
+                fall_back.add(oid)
+                continue
+            chunk_total = backend._shard_bytes_total(size)
+            if have and len(next(iter(have.values()))) < chunk_total:
+                # object larger than the batch's per-object window:
+                # recover it windowed (bounded batch memory)
+                fall_back.add(oid)
+                continue
+            plan["version"] = target_v
+            plan["size"] = size
+            plan["chunk_total"] = chunk_total
+            plan["attrs"] = next(
+                (slot["attrs"][s] for s in holders if s in slot["attrs"]),
+                {},
+            )
+            plan["have"] = have
+            if chunk_total:
+                # promote-on-recovery wants the FULL km block; the
+                # fused dispatch reconstructs every missing position in
+                # the same pass when the tier will take it
+                missing = [s for s in range(backend.km) if s not in have]
+                rebuild = missing if self._want_promote(oid, size) \
+                    else sorted(set(plan["want"]) - set(have))
+                maps.append(dict(have))
+                wants.append(rebuild)
+                ready.append(oid)
+        if maps:
+            decoded = ecutil.decode_shards_many(backend.ec, maps, wants)
+        else:
+            decoded = []
+
+        # -- corked multi-push burst --------------------------------------
+        pushes: List[Tuple[str, ECSubWrite]] = []
+        push_oids: List[str] = []
+        full: Dict[str, Dict[int, np.ndarray]] = {}
+        for oid, rebuilt in zip(ready, decoded):
+            plan = plans[oid]
+            chunks = dict(plan["have"])
+            chunks.update(rebuilt)
+            full[oid] = chunks
+            for s, target, rb in group[oid]:
+                piece = chunks[s].tobytes() if plan["chunk_total"] else b""
+                pushes.append((f"osd.{target}", self._push_sub(
+                    oid, s, piece, plan, rb)))
+                push_oids.append(oid)
+        for oid in plans:
+            if oid not in ready and oid not in fall_back \
+                    and plans[oid].get("chunk_total") == 0:
+                # zero-byte object: attrs-only push, no codec involved
+                plan = plans[oid]
+                for s, target, rb in group[oid]:
+                    pushes.append((f"osd.{target}", self._push_sub(
+                        oid, s, b"", plan, rb)))
+                    push_oids.append(oid)
+                full[oid] = {}
+        commit_t = float(cfg.get_val("osd_client_op_commit_timeout"))
+        results = await batched_pushes(backend, pushes, commit_t)
+
+        ok_oids: set = set()
+        bad_oids: set = set()
+        nbytes = 0
+        for oid, (target, sub), ok in zip(push_oids, pushes, results):
+            if ok:
+                ok_oids.add(oid)
+                for top in sub.transaction.ops:
+                    if top.op == "write":
+                        nbytes += len(top.data)
+            else:
+                bad_oids.add(oid)
+        ok_oids -= bad_oids
+        fall_back |= bad_oids
+        if ok_oids:
+            backend.perf.inc("recovery_ops_batched", len(ok_oids))
+            backend.perf.inc("recovery_batches")
+            backend.perf.inc("recover", len(ok_oids))
+        if nbytes:
+            backend.perf.inc("recovery_bytes", nbytes)
+
+        # -- promote-on-recovery ------------------------------------------
+        for oid in sorted(ok_oids):
+            plan = plans.get(oid)
+            if plan is None or not plan.get("chunk_total"):
+                continue
+            chunks = full.get(oid)
+            if chunks and len(chunks) == backend.km and \
+                    self._want_promote(oid, plan["size"]):
+                block = np.stack([
+                    np.asarray(chunks[s], dtype=np.uint8)
+                    for s in range(backend.km)
+                ])
+                backend._tier.put(
+                    backend.pool_name, oid, block, plan["version"],
+                    plan["size"], dirty=False, promote_from_recovery=True,
+                )
+        return fall_back
+
+    def _want_promote(self, oid: str, logical: int) -> bool:
+        """Promote-on-recovery predicate: writeback tier, toggle on,
+        and the object is hot or was resident (mirrors the write lane's
+        ``_want_resident``)."""
+        backend = self.backend
+        if not logical or backend._tier is None or \
+                backend.tier_mode != "writeback":
+            return False
+        if not bool(_cfg().get_val("osd_tier_promote_on_recovery")):
+            return False
+        return backend._tier.contains(backend.pool_name, oid) or \
+            backend._tier_hot(oid)
+
+    def _push_sub(self, oid: str, s: int, piece: bytes, plan: dict,
+                  rollback: bool) -> ECSubWrite:
+        """Full-shard recovery push transaction: bytes + truncate +
+        the authoritative attr re-stamp (version, size, hinfo, snapset,
+        whiteout, pool tag) -- the single-window analogue of the
+        windowed path's final window."""
+        backend = self.backend
+        soid = shard_oid(oid, s)
+        attrs = plan["attrs"] or {}
+        txn = Transaction().write(soid, 0, piece)
+        txn = backend._pool_stamp(
+            txn.truncate(soid, plan["chunk_total"])
+            .setattr(soid, ecutil.HINFO_KEY, attrs.get(ecutil.HINFO_KEY))
+            .setattr(soid, SIZE_KEY, plan["size"])
+            .setattr(soid, VERSION_KEY, plan["version"])
+            .setattr(soid, SNAPSET_KEY, attrs.get(SNAPSET_KEY))
+            .setattr(soid, WHITEOUT_KEY, attrs.get(WHITEOUT_KEY)),
+            soid,
+        )
+        return ECSubWrite(
+            from_shard=s, tid=backend._new_tid(), oid=oid,
+            transaction=txn, at_version=plan["version"],
+            op_class="recovery", rollback=rollback,
+        )
+
+
+# -- batched deep-scrub reads (chunked cursor) ---------------------------
+
+async def scrub_read_many(
+    backend, oids: List[str],
+) -> Dict[str, Dict[int, dict]]:
+    """Chunked, batched deep-scrub read of many objects: each round
+    reads ``osd_scrub_chunk_max`` bytes per shard for every object
+    still in progress as ONE corked multi-read burst (op_class
+    "scrub"), so a scrub slice costs one burst per round instead of one
+    whole-shard fan-out per object.
+
+    Returns {oid: {shard: {"data": bytes|None, "attrs": dict,
+    "error": int|None}}} over every up shard (shards whose OSD never
+    answered are absent -- the caller classifies them missing)."""
+    cfg = _cfg()
+    chunk_max = max(backend.sinfo.chunk_size,
+                    int(cfg.get_val("osd_scrub_chunk_max")))
+    chunk_max = chunk_max // backend.sinfo.chunk_size * \
+        backend.sinfo.chunk_size
+    timeout = float(cfg.get_val("osd_read_gather_timeout"))
+    state: Dict[str, Dict[int, dict]] = {}
+    plans: Dict[str, dict] = {}
+    for oid in oids:
+        acting = backend.acting_set(oid)
+        up = [s for s in range(backend.km)
+              if backend._shard_up(acting, s)]
+        plans[oid] = {"acting": acting, "up": up, "off": 0,
+                      "total": None}
+        state[oid] = {}
+
+    throttle = backend._recovery().throttle
+    pending = set(plans)
+    first = True
+    while pending:
+        reads: Dict[Tuple[str, int], Dict[str, list]] = {}
+        attr_want: Dict[Tuple[str, int], List[str]] = {}
+        for oid in sorted(pending):
+            plan = plans[oid]
+            for s in plan["up"]:
+                key = (f"osd.{plan['acting'][s]}", s)
+                reads.setdefault(key, {})[oid] = [
+                    (plan["off"], chunk_max)]
+                attr_want.setdefault(key, []).append(oid)
+        read_list = [
+            (osd, s, to_read, attr_want[(osd, s)])
+            for (osd, s), to_read in reads.items()
+        ]
+        replies = await batched_sub_reads(
+            backend, read_list, "scrub", timeout)
+        backend.perf.inc("scrub_chunks")
+        for (osd, s, to_read, _attrs), reply in zip(read_list, replies):
+            if reply is None:
+                continue  # never answered: the shard reads as missing
+            for oid in to_read:
+                slot = state[oid].setdefault(
+                    s, {"data": b"", "attrs": {}, "error": None})
+                if oid in reply.errors:
+                    slot["error"] = reply.errors[oid]
+                    slot["data"] = None
+                    continue
+                attrs = reply.attrs_read.get(oid) or {}
+                if attrs:
+                    # re-read each round: a version that MOVES between
+                    # chunks marks a mid-scrub write (deferral, not a
+                    # false parity error)
+                    slot.setdefault("versions", set())
+                    slot["versions"].add(vt(attrs.get(VERSION_KEY)))
+                    if first:
+                        slot["attrs"] = attrs
+                    total = plans[oid]["total"]
+                    if attrs.get(SIZE_KEY) is not None and total is None:
+                        plans[oid]["total"] = backend._shard_bytes_total(
+                            attrs[SIZE_KEY])
+                bufs = reply.buffers_read.get(oid)
+                if bufs is not None and slot["data"] is not None:
+                    slot["data"] += bufs[0][1]
+                    slot["had_buf"] = True
+        done = set()
+        for oid in pending:
+            plan = plans[oid]
+            plan["off"] += chunk_max
+            total = plan["total"]
+            if total is None or plan["off"] >= total:
+                done.add(oid)
+        pending -= done
+        first = False
+        if pending and throttle is not None:
+            await throttle.pace()  # chunk-cursor pacing between rounds
+    return state

@@ -166,6 +166,10 @@ class OSDShard:
         #: thread-count role)
         self._cop_sem = asyncio.Semaphore(64)
         self._cop_seq = 0
+        #: queued-or-executing client ops (the background throttle's
+        #: saturation signal: recovery/scrub batches back off while
+        #: this is high -- osd/recovery.py BackgroundThrottle)
+        self._client_ops_queued = 0
         messenger.register(self.name, self.dispatch)
         messenger.adopt_task(
             f"{self.name}.opwq",
@@ -219,6 +223,10 @@ class OSDShard:
         backend._tier = self.tier
         backend._hitset_record = lambda oid: self.hitsets.record(oid)
         backend._hitset_temp = lambda oid: self.hitsets.temperature(oid)
+        # background-throttle hookup: the engine's recovery/scrub
+        # batches consult THIS daemon's client-queue depth to back off
+        # under saturation (osd/recovery.py BackgroundThrottle)
+        backend._host_shard = self
         self.pools[pool] = backend
         return backend
 
@@ -370,15 +378,14 @@ class OSDShard:
         repaired = 0
         scanned = 0
         n = len(bases)
+        # phase 1 -- candidate collection (no awaits: the cursor walk
+        # stays consistent); the slice's objects then ride ONE batched
+        # chunk-cursor read per backend instead of one whole-shard
+        # fan-out each (the round-14 background data plane)
+        slices: Dict[object, list] = {}
         for _ in range(n):
             if scanned >= limit:
                 break
-            # advance from the LIVE cursor each step (not a start-of-
-            # round snapshot): the deep_scrub awaits below yield, and a
-            # concurrent tick deriving positions from a stale snapshot
-            # would re-walk this round's objects (asyncsan
-            # rmw-across-await); live advance makes overlapping rounds
-            # cooperate instead
             base = bases[self._scrub_cursor % n]
             self._scrub_cursor = (self._scrub_cursor % n + 1) % n
             base_tag = getattr(self, "_scrub_pool_tags", {}).get(base)
@@ -394,17 +401,30 @@ class OSDShard:
                 if primary != self.name:
                     continue
                 scanned += 1
+                slices.setdefault(id(backend), (backend, []))[1].append(
+                    base)
+                break
+        # phase 2 -- batched scrub + repair per backend
+        for backend, oids in slices.values():
+            try:
+                reports = await backend.deep_scrub_many(oids)
+            except asyncio.CancelledError:
+                raise
+            except Exception:  # noqa: BLE001 -- scrub must not kill
+                # the tick (e.g. a degraded object mid-recovery)
+                self.perf.inc("scrub_failed")
+                continue
+            for base in oids:
+                report = reports.get(base)
+                if report is None or report["ok"]:
+                    continue
                 try:
-                    report = await backend.deep_scrub(base)
+                    repaired += await backend.scrub_repair(base, report)
                 except asyncio.CancelledError:
                     raise
-                except Exception:  # noqa: BLE001 -- scrub must not kill
-                    # the tick (e.g. a degraded object mid-recovery)
+                except Exception:  # noqa: BLE001 -- a failed repair
+                    # stays in scrub_errors; the next slice retries
                     self.perf.inc("scrub_failed")
-                    break
-                if not report["ok"]:
-                    repaired += await backend.scrub_repair(base, report)
-                break
         return repaired
 
     async def tier_tick(self) -> int:
@@ -499,6 +519,8 @@ class OSDShard:
                     self.opq.enqueue(
                         OP_PRIORITY["client"], cost, (src, msg)
                     )
+                self._client_ops_queued += 1
+                msg["_client_gauge"] = True
                 self.perf.inc("queued_client_op")
                 self._op_event.set()
                 return
@@ -954,6 +976,8 @@ class OSDShard:
                         release = dropped.pop("_budget_release", None)
                         if release is not None:
                             release()
+                        if dropped.pop("_client_gauge", None):
+                            self._client_ops_queued -= 1
                     continue
                 src, msg = item
                 try:
@@ -1010,6 +1034,8 @@ class OSDShard:
             release = msg.pop("_budget_release", None)
             if release is not None:
                 release()  # claimed messenger dispatch-throttle budget
+            if msg.pop("_client_gauge", None):
+                self._client_ops_queued -= 1
 
     async def _run_client_op_inner(self, src: str, msg: dict, op,
                                    reply: dict) -> None:
@@ -1200,8 +1226,16 @@ class OSDShard:
         # device-tier coherence: an applied sub-write proves any resident
         # copy stale UNLESS it belongs to this very write (the primary's
         # own write-through put carries the same version and survives;
-        # a racing primary's write carries a different one and evicts)
-        self.tier.invalidate_oid(msg.oid, keep_version=new_vt)
+        # a racing primary's write carries a different one and evicts).
+        # A same-versioned RECOVERY push is a refresh, not a mutation:
+        # the shard is being rebuilt toward the version the resident
+        # copy already holds, so the copy stays valid AND in-flight
+        # promotions of the rebuilt object must not be dropped (the
+        # rebuilt-object-goes-cold bug: the unconditional invalidate
+        # notified the agent's watchers even when the entry survived)
+        if not (msg.op_class in ("recovery", "scrub")
+                and self.tier.recovery_refresh(msg.oid, new_vt)):
+            self.tier.invalidate_oid(msg.oid, keep_version=new_vt)
         # log_operation before queue_transactions (reference order,
         # ECBackend.cc:922): snapshot the pre-apply state so a torn write
         # can be rolled back locally (divergent-entry rollback) and give

@@ -210,7 +210,8 @@ class DeviceTierStore:
 
     def put(self, pool: Optional[str], oid: str, block, version: tuple,
             logical_size: int, dirty: bool = False,
-            resident_origin: bool = False) -> TierEntry:
+            resident_origin: bool = False,
+            promote_from_recovery: bool = False) -> TierEntry:
         """Insert/replace one object's shard-major block (host blocks are
         transferred; device arrays from ``put_many`` slicing are taken
         as-is), then evict to budget.
@@ -219,7 +220,13 @@ class DeviceTierStore:
         block is the encode pipeline's still-device-resident [km, bs]
         output, so this put moves ZERO bytes over the bus (counted
         separately -- ``tier_promote_from_encode`` is the write lane's
-        "no re-upload" proof counter)."""
+        "no re-upload" proof counter).  ``promote_from_recovery=True``
+        marks the background plane's promote-on-recovery insert: the
+        block was already assembled by the rebuild's fused decode, so
+        the promote costs no extra shard reads (counted as
+        ``tier_promote_from_recovery``, the recovery lane's twin)."""
+        if promote_from_recovery and self.perf is not None:
+            self.perf.inc("tier_promote_from_recovery")
         if isinstance(block, np.ndarray):
             block = _to_device(block)
         elif resident_origin and self.perf is not None:
@@ -227,6 +234,26 @@ class DeviceTierStore:
         ent = self._insert(pool, oid, block, version, logical_size, dirty)
         self.evict_to_budget()
         return ent
+
+    def recovery_refresh(self, oid: str, version: tuple) -> bool:
+        """Coherence check for a same-versioned RECOVERY push: True iff
+        every resident copy of ``oid`` already holds ``version`` (then
+        their recency is bumped and -- crucially -- NO invalidation is
+        noted to the agent's watchers: a recovery push propagates an
+        existing version, so an in-flight promotion gather of the
+        rebuilt object stays valid; dropping it on every push window
+        was the rebuilt-object-goes-cold bug).  Vacuously True with
+        nothing resident.  False (a stale copy exists) sends the caller
+        down the normal invalidate path."""
+        with self._lock:
+            ents = [self._entries[k] for k in self._entries
+                    if k[1] == oid]
+            if any(e.version != tuple(version) for e in ents):
+                return False
+            for e in ents:
+                self._seq += 1
+                e.last_access = self._seq
+        return True
 
     def put_many(self, items: List[tuple]) -> int:
         """Batched promotion: ``items`` = [(pool, oid, host_block,

@@ -86,6 +86,39 @@ OPTIONS: Dict[str, Option] = {
              see_also=("osd_ec_donate", "osd_tier_promote_temp")),
         _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
              "max bytes per recovery window"),
+        _opt("osd_recovery_batched", bool, True, LEVEL_ADVANCED,
+             "route recovery pushes through the batched background data "
+             "plane (osd/recovery.py): per-PG recovery coalescer, fused "
+             "decode dispatches, corked multi-push messenger bursts.  "
+             "False restores the per-object windowed path (kept as the "
+             "recovery-path bench baseline)",
+             see_also=("osd_recovery_batch_bytes",
+                       "osd_recovery_max_active")),
+        _opt("osd_recovery_batch_bytes", int, 8 << 20, LEVEL_ADVANCED,
+             "byte budget per batched recovery dispatch: a batch's "
+             "gathered source chunks stay under this, and an object "
+             "whose shards exceed the per-object share falls back to "
+             "the windowed per-object path (bounded primary memory)",
+             see_also=("osd_recovery_batched",)),
+        _opt("osd_recovery_sleep", float, 0.0, LEVEL_ADVANCED,
+             "seconds of awaited pacing between background recovery/"
+             "scrub batches (the osd_recovery_sleep role); 0 still "
+             "yields the event loop once per batch so client ops "
+             "interleave",
+             see_also=("osd_recovery_batched",)),
+        _opt("osd_scrub_chunk_max", int, 512 << 10, LEVEL_ADVANCED,
+             "deep-scrub read-cursor chunk bytes per shard per round: "
+             "scrub walks objects in chunks of this size through the "
+             "batched read lane instead of one whole-shard read per "
+             "object (bounded memory, paced I/O)"),
+        _opt("osd_tier_promote_on_recovery", bool, True, LEVEL_ADVANCED,
+             "land a rebuilt hot (or previously-resident) object's full "
+             "shard block in the device tier as part of recovery itself "
+             "(promote-on-recovery): the batch already holds every "
+             "chunk, so the promote costs no extra shard reads.  The "
+             "insert is counted as tier_promote_from_recovery",
+             see_also=("osd_tier_promote_temp",
+                       "osd_tier_promote_from_encode")),
         _opt("osd_pg_log_dups_tracked", int, 3000, LEVEL_ADVANCED,
              "reqid dup entries retained per OSD PG log for client-op "
              "replay detection; kept past trim() like the reference's "

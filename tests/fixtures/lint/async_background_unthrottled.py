@@ -1,0 +1,64 @@
+"""Fixture: async-background-unthrottled.
+
+Background-class loops (recovery / backfill / scrub) issuing pushes or
+gather reads must admit through a throttle or await pacing between
+batches -- otherwise a rebuild storm competes unboundedly with client
+traffic (the round-14 background-data-plane discipline)."""
+
+import asyncio
+
+
+class _Throttle:
+    async def admit(self):
+        pass
+
+    async def pace(self):
+        pass
+
+
+class Engine:
+    def __init__(self, messenger, throttle, opq):
+        self.messenger = messenger
+        self.throttle = throttle
+        self.opq = opq
+        self.name = "osd.0"
+
+    async def recover_storm(self, batches):
+        # push burst per batch, nothing paces between them: a full-shard
+        # rebuild here starves client p99
+        for subs in batches:
+            await self.messenger.send_messages(self.name, subs)  # LINT: async-background-unthrottled
+
+    async def scrub_walk(self, oids):
+        while oids:
+            oid = oids.pop()
+            await self._read_shards(oid)  # LINT: async-background-unthrottled
+
+    async def recover_admitted(self, batches):
+        # throttle admission per batch: clean
+        for subs in batches:
+            await self.throttle.admit()
+            await self.messenger.send_messages(self.name, subs)
+
+    async def scrub_paced(self, oids):
+        # awaited pacing (osd_recovery_sleep role): clean
+        while oids:
+            await self._read_shards(oids.pop())
+            await asyncio.sleep(0.01)
+
+    async def backfill_queued(self, items):
+        # admitted through an op queue: clean
+        for prio, cost, item in items:
+            self.opq.enqueue(prio, cost, item)
+            await self._fanout_commit(item)
+
+    async def push_all(self, batches):
+        # not background-named: the client fan-out path stays clean
+        for subs in batches:
+            await self.messenger.send_messages(self.name, subs)
+
+    async def _read_shards(self, oid):
+        return oid
+
+    async def _fanout_commit(self, item):
+        return item

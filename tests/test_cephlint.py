@@ -49,6 +49,8 @@ FIXTURES = {
     "ceph_config_undeclared.py": None,
     "async_rmw_across_await.py": None,
     "async_lock_across_await.py": None,
+    # PR-14 background data plane: recovery/scrub loops must admit/pace
+    "async_background_unthrottled.py": None,
     "async_atomic_section.py": None,
     "wire_symmetry.py": None,
     "suppressions.py": None,

@@ -38,7 +38,8 @@ def parse_args(argv):
                    help="erasure code plugin name")
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
-                            "cluster-path", "tier-path"])
+                            "cluster-path", "tier-path",
+                            "recovery-path"])
     p.add_argument("-e", "--erasures", type=int, default=1,
                    help="number of erasures when decoding")
     p.add_argument("--erased", type=int, action="append", default=[],
@@ -224,6 +225,35 @@ def main(argv=None) -> int:
             f"stage {result['wire_write_speedup']}x "
             f"({wc['frames_per_burst']} frames/burst, "
             f"{wc['ack_piggyback_ratio']} acks piggybacked)",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "recovery-path":
+        # Background data-plane stage (round 14): rebuild two wiped
+        # OSDs' shards through the batched recovery coalescer vs the
+        # per-object windowed path, with a concurrent client workload
+        # on the mClock queues; bit-exactness + cross-mode shard bytes
+        # + client-p99 bound gated before any number is printed.
+        # Prints one JSON line (the shape bench.py records as
+        # recovery_path_host_*).  The cluster profile is fixed (k=4
+        # m=2 tpu plugin, cpu-fallback safe); --objects/--size scale
+        # the rebuilt set.
+        import json
+
+        from ceph_tpu.osd.recovery_bench import run_recovery_path_bench
+
+        result = run_recovery_path_bench(
+            n_objects=args.objects, obj_bytes=args.size,
+        )
+        print(json.dumps(result))
+        print(
+            f"recovery-path {args.objects}x{args.size}B: batched "
+            f"time-to-clean {result['batched']['time_to_clean_s']:.3f}s "
+            f"({result['rebuild_speedup']}x per-object), client p99 "
+            f"{result['batched']['client_p99_ms']}ms during rebuild, "
+            f"{result['batched']['counters']['recovery_ops_batched']} "
+            f"objects through the batched lane",
             file=sys.stderr,
         )
         return 0

@@ -16,6 +16,7 @@ Usage:
   rados_cli.py --dir RUN ls
   rados_cli.py --dir RUN df
   rados_cli.py --dir RUN tier status
+  rados_cli.py --dir RUN recovery status
   rados_cli.py --dir RUN setomapval <obj> <key> <value>
   rados_cli.py --dir RUN listomapvals <obj>
 """
@@ -91,6 +92,32 @@ async def _run(args) -> int:
                   f"miss {st['miss']}\tmodes {json.dumps(st['modes'])}")
         if not found:
             print("no daemons with a tier admin socket", file=sys.stderr)
+            return 1
+        return 0
+    if args.cmd == "recovery" or args.cmd == "recovery-status":
+        # background data-plane status per daemon (admin-socket backed):
+        # batched rebuild counters, scrub cursor rounds, throttle
+        # preemptions and dirty-object depth (osd/recovery.py)
+        found = False
+        for sock in _asoks(args.dir):
+            st = await admin_command(sock, "recovery status")
+            if "error" in st:
+                continue
+            found = True
+            c = st["counters"]
+            dirty = sum(st["dirty_objects"].values())
+            print(f"{st['name']}\tbatched={st['batched']}\t"
+                  f"recovered {c['recover']} "
+                  f"({c['recovery_ops_batched']} batched, "
+                  f"{c['recovery_bytes']}B)\t"
+                  f"scrub_chunks {c['scrub_chunks']}\t"
+                  f"preempted {c['recovery_preempted']}\t"
+                  f"promote_from_recovery "
+                  f"{c['tier_promote_from_recovery']}\t"
+                  f"dirty {dirty}")
+        if not found:
+            print("no daemons with a recovery admin socket",
+                  file=sys.stderr)
             return 1
         return 0
     if args.cmd == "residency" or args.cmd == "residency-status":
