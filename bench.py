@@ -579,6 +579,27 @@ def main() -> int:
 
     rp_host = _staged("recovery_path_host", _recovery_path_host)
 
+    def _mesh_path_host():
+        """Round-15 tentpole metric: the full TCP cluster path vs mesh
+        shard count (osd_mesh_data_plane, ceph_tpu/parallel/
+        mesh_plane.py) -- PG-sliced SPMD encode dispatch + in-collective
+        chunk delivery for mesh-bound OSDs vs the TCP-only baseline,
+        swept over 1/2/4/8 mesh devices.  Correctness-gated: bit-exact
+        read-back in every cycle, byte-identical stored shards across
+        every configuration, wire-bytes-avoided monotone in mesh size,
+        ZERO steady-state retraces in the timed pass (the PR-8 ledger
+        contract).  On the cpu-fallback harness the virtual devices
+        share one core, so encode scaling reads flat there -- the
+        wire-bytes-avoided trend is the hardware-independent signal
+        (ceph_tpu/msg/mesh_bench.py)."""
+        from ceph_tpu.msg.mesh_bench import run_mesh_path_bench
+
+        return run_mesh_path_bench(
+            n_objects=48, obj_bytes=32 << 10, writers=8, iters=2
+        )
+
+    mp_host = _staged("mesh_path_host", _mesh_path_host)
+
     def _lint_stage():
         """Static-health trend metrics: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json) as
@@ -684,6 +705,17 @@ def main() -> int:
             rp_host["batched"]["counters"]["recovery_ops_batched"]
             if rp_host else None),
         "recovery_path_host": rp_host,
+        "mesh_path_speedup_4x": (
+            mp_host["speedup_4x"] if mp_host else None),
+        "mesh_path_speedup_max": (
+            mp_host["speedup_max"] if mp_host else None),
+        "mesh_path_wire_bytes_avoided": (
+            mp_host["wire_bytes_avoided"] if mp_host else None),
+        "mesh_path_encode_GiBs": (
+            mp_host["encode_GiBs"] if mp_host else None),
+        "mesh_path_steady_jit_retraces": (
+            mp_host["steady_jit_retraces"] if mp_host else None),
+        "mesh_path_host": mp_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
             lint_stage["by_rule"] if lint_stage else None),
@@ -737,7 +769,10 @@ def main() -> int:
         f"{tp_host['read_speedup'] if tp_host else '?'}x cold decode, "
         f"failover ttfs "
         f"{fo_host['ttfs_mean_ms'] if fo_host else '?'}ms / thrash p99 "
-        f"{fo_host['thrash_p99_ms'] if fo_host else '?'}ms on "
+        f"{fo_host['thrash_p99_ms'] if fo_host else '?'}ms, mesh-path "
+        f"{mp_host['speedup_max'] if mp_host else '?'}x at max mesh "
+        f"(wire avoided "
+        f"{mp_host['wire_bytes_avoided'] if mp_host else '?'}) on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

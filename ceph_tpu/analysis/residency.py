@@ -52,7 +52,7 @@ class ResidencyCounters:
     """Process-wide transfer/retrace ledger (thread-safe)."""
 
     __slots__ = ("_lock", "h2d_ops", "h2d_bytes", "d2h_ops", "d2h_bytes",
-                 "jit_retraces")
+                 "jit_retraces", "mesh_axes")
 
     def __init__(self):
         self._lock = threading.Lock()
@@ -61,6 +61,11 @@ class ResidencyCounters:
         self.d2h_ops = 0
         self.d2h_bytes = 0
         self.jit_retraces = 0
+        #: per-mesh-axis sharded-dispatch accounting (the mesh data
+        #: plane's slice of the ledger): axis name -> [dispatches,
+        #: bytes placed along that axis].  Keyed dynamically so new
+        #: axes (pg/shard/sub) need no schema change.
+        self.mesh_axes: Dict[str, List[int]] = {}
 
     def note_h2d(self, nbytes: int) -> None:
         with self._lock:
@@ -76,15 +81,29 @@ class ResidencyCounters:
         with self._lock:
             self.jit_retraces += 1
 
+    def note_mesh(self, axis: str, nbytes: int) -> None:
+        """One sharded dispatch placing ``nbytes`` along mesh ``axis``
+        (the mesh plane calls this per axis of every SPMD encode/decode
+        dispatch, so "how much work rides each mesh axis" is a ledger
+        number like the transfer counters)."""
+        with self._lock:
+            ent = self.mesh_axes.setdefault(axis, [0, 0])
+            ent[0] += 1
+            ent[1] += int(nbytes)
+
     def snapshot(self) -> Dict[str, int]:
         with self._lock:
-            return {
+            out = {
                 "h2d_ops": self.h2d_ops,
                 "h2d_bytes": self.h2d_bytes,
                 "d2h_ops": self.d2h_ops,
                 "d2h_bytes": self.d2h_bytes,
                 "jit_retraces": self.jit_retraces,
             }
+            for axis, (ops, nbytes) in self.mesh_axes.items():
+                out[f"mesh_{axis}_dispatches"] = ops
+                out[f"mesh_{axis}_bytes"] = nbytes
+            return out
 
     @staticmethod
     def delta(before: Dict[str, int], after: Dict[str, int]) -> Dict[str, int]:

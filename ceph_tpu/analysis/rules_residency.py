@@ -585,6 +585,75 @@ def check_loop_invariant_transfer(ctx: FileContext) -> Iterator[Finding]:
 DEVICE_LATTICE = flow.DEVICE
 
 
+# -- rule: per-call Mesh / NamedSharding / PartitionSpec construction -------
+
+
+#: jax placement-object constructors (plus the repo's make_mesh helper);
+#: ImportFrom aliases (``PartitionSpec as P``) are resolved per file
+_SHARDING_CTORS = {"Mesh", "NamedSharding", "PartitionSpec", "make_mesh"}
+
+
+def _sharding_aliases(ctx: FileContext) -> Set[str]:
+    names = set(_SHARDING_CTORS)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name in _SHARDING_CTORS and a.asname:
+                    names.add(a.asname)
+    return names
+
+
+@rule(
+    "jax-percall-sharding-construction", "jax", SEV_WARNING,
+    "a Mesh / NamedSharding / PartitionSpec (or make_mesh) is "
+    "constructed inside a loop or inside a jitted dispatch path: "
+    "placement objects are dispatch-invariant, and rebuilding one per "
+    "call re-hashes device lists and defeats jax's C++ dispatch cache "
+    "(the mesh analogue of jax-loop-invariant-transfer).  Build once "
+    "and cache content-keyed -- the mesh plane's sharding()/pspec() "
+    "caches (parallel/mesh_plane.py) are the blessed seam",
+)
+def check_percall_sharding_construction(
+    ctx: FileContext,
+) -> Iterator[Finding]:
+    if not _in_ceph_tpu(ctx) or not ctx.imports_module("jax"):
+        return
+    names = _sharding_aliases(ctx)
+    parents = ctx.parent_map()
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        if call_name(node).rsplit(".", 1)[-1] not in names:
+            continue
+        # ancestry walk: the nearest enclosing loop or jitted function
+        # decides; a construction in plain builder code (codec
+        # __init__, cache-miss fill) is the sanctioned shape
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, (ast.For, ast.AsyncFor, ast.While)):
+                yield ctx.finding(
+                    "jax-percall-sharding-construction", node,
+                    f"{call_name(node)} constructed inside a loop "
+                    f"(line {cur.lineno}): placement objects are "
+                    "loop-invariant -- build once outside (or through "
+                    "a content-keyed cache like mesh_plane.sharding())",
+                )
+                break
+            if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                if _jit_spec(cur) is not None:
+                    yield ctx.finding(
+                        "jax-percall-sharding-construction", node,
+                        f"{call_name(node)} constructed inside jitted "
+                        f"function {cur.name}: sharding objects belong "
+                        "outside the traced computation -- close over "
+                        "a cached instance instead",
+                    )
+                # a function boundary ends the ancestry either way: an
+                # enclosing loop re-runs the DEF, not the body
+                break
+            cur = parents.get(cur)
+
+
 def _loop_own_nodes(body: List[ast.stmt]) -> Iterator[ast.AST]:
     stack: List[ast.AST] = list(body)
     while stack:

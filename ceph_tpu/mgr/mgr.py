@@ -230,6 +230,30 @@ def prometheus_text(state: dict) -> str:
             f'ceph_transfer_ops_total{{direction="d2h"}} '
             f"{rc['d2h_ops']}",
         ]
+        # per-mesh-axis sharded-dispatch ledger (the mesh data plane)
+        axes = sorted(k[len("mesh_"):-len("_bytes")] for k in rc
+                      if k.startswith("mesh_") and k.endswith("_bytes"))
+        if axes:
+            lines += [
+                "# HELP ceph_mesh_dispatch_bytes_total bytes placed "
+                "along each mesh axis by sharded dispatches",
+                "# TYPE ceph_mesh_dispatch_bytes_total counter",
+            ]
+            for ax in axes:
+                lines.append(
+                    f'ceph_mesh_dispatch_bytes_total{{axis="{ax}"}} '
+                    f"{rc[f'mesh_{ax}_bytes']}")
+        from ceph_tpu.parallel import mesh_plane as _mesh_mod
+
+        plane = _mesh_mod.current_plane()
+        if plane is not None:
+            lines += [
+                "# HELP ceph_mesh_wire_bytes_avoided_total chunk bytes "
+                "delivered in-collective instead of over the wire",
+                "# TYPE ceph_mesh_wire_bytes_avoided_total counter",
+                f"ceph_mesh_wire_bytes_avoided_total "
+                f"{plane.counters['mesh_wire_bytes_avoided']}",
+            ]
     except Exception:  # noqa: BLE001 -- exposition must never fail
         pass
     lines += ["# HELP ceph_pool_objects logical objects in the pool",

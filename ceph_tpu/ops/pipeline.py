@@ -145,20 +145,28 @@ _MATRIX_CACHE: "OrderedDict[Tuple, Tuple[object, int]]" = OrderedDict()
 _MATRIX_LOCK = threading.Lock()
 
 
-def accounted_device_matrix(arr: np.ndarray):
+def accounted_device_matrix(arr: np.ndarray, sharding=None):
     """Device-resident copy of ``arr``, cached by content and accounted
     to the DeviceByteAccount ledger (budget: osd_tier_h2d_cache_bytes,
     capped by osd_tier_hbm_bytes).  Falls back to the host array when
-    no jax backend is importable (callers degrade like the tier)."""
+    no jax backend is importable (callers degrade like the tier).
+
+    ``sharding`` (a ``jax.sharding.Sharding``, e.g. the mesh plane's
+    cached replicated ``NamedSharding``) places the upload across a
+    device mesh; it joins the content key so the same bytes on two
+    different placements are two cache entries, and steady state never
+    re-places (or re-ships) either."""
     a = np.ascontiguousarray(arr)
     key = (a.shape, str(a.dtype),
-           hashlib.blake2b(a, digest_size=16).digest())
+           hashlib.blake2b(a, digest_size=16).digest(),
+           None if sharding is None else repr(sharding))
     with _MATRIX_LOCK:
         hit = _MATRIX_CACHE.get(key)
         if hit is not None:
             _MATRIX_CACHE.move_to_end(key)
             return hit[0]
-    d = residency.device_put(a)
+    d = residency.device_put(a) if sharding is None else \
+        residency.device_put(a, sharding)
     from ceph_tpu.tier.device_tier import (DeviceByteAccount,
                                            device_byte_account)
 

@@ -10,7 +10,11 @@ Characteristics of Online Erasure Coding" documents: once the codec is
 fast, datapath overheads dominate.
 
 This module is the seam that closes the gap: concurrent in-flight client
-ops on one PG gather their codec work into batched dispatches.
+ops on one PG gather their codec work into batched dispatches.  It is
+also the mesh data plane's dispatch seam (``osd_mesh_data_plane``): the
+fused batch a tick gathers here is exactly what
+``parallel/mesh_plane.py`` places PG-sliced over the device mesh, so
+batching and mesh parallelism compose without a second queue.
 
 Flush policy (documented in docs/ec-storage-path.md):
 
@@ -149,6 +153,11 @@ class BatchCoalescer:
                 if len(batch) > 1:
                     self.perf.inc(f"{self._counter}_batched",
                                   len(batch))
+                # largest fused batch this coalescer ever dispatched:
+                # the mesh data plane slices a batch over the pg axis,
+                # so this is the "how much parallelism did one tick
+                # actually gather" number the mesh bench reads
+                self.perf.hwm(f"{self._counter}_batch_hwm", len(batch))
             for (_item, fut, _nb), res in zip(batch, results):
                 if not fut.done():
                     fut.set_result(res)

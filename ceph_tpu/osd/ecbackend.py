@@ -124,34 +124,73 @@ class ECBackend(PG):
 
     # -- batched codec dispatch (the stripe-batching pipeline seam) --------
 
+    def _mesh_plane(self):
+        """The process mesh data plane, iff gated on AND this pool's
+        codec can ride it (matrix technique, w=8) -- the coalescer then
+        dispatches its fused batches PG-sliced over the mesh instead of
+        single-device (ceph_tpu/parallel/mesh_plane.py)."""
+        from ceph_tpu.parallel import mesh_plane as mesh_mod
+
+        plane = mesh_mod.current_plane()
+        if plane is None or not plane.can_encode(self.ec):
+            return None
+        return plane
+
     def _encode_dispatch(self, items):
-        """items: (shard-major block, want_resident) pairs from
+        """items: (shard-major block, want_resident, pgid) triples from
         :meth:`_encode_op`; one fused, bucketed pipeline dispatch covers
         the whole batch.  Returns (chunk_map, device_block) per item --
         the device block is the still-resident [k+m, bs] encode output
-        for stripes the tier wants hot (promote-from-encode)."""
-        blocks = [b for b, _keep in items]
-        keep = [keep for _b, keep in items]
+        for stripes the tier wants hot (promote-from-encode).  With the
+        mesh data plane up, the batch instead rides ONE PG-sliced SPMD
+        dispatch (each stripe placed on the mesh device owning its PG,
+        parity scattered in-collective where the backend allows)."""
+        blocks = [b for b, _keep, _pg in items]
+        plane = self._mesh_plane()
+        if plane is not None:
+            # a coalesced batch belongs to THIS primary: encode it on
+            # the primary's own mesh slot (different primaries' batches
+            # land on different devices and overlap); an unbound
+            # primary (client-side engine) spreads by PG ownership
+            encs = plane.encode_shard_major_many(
+                self.ec, blocks, [pg for _b, _keep, pg in items],
+                slot=plane.slot_of(self.name))
+            return [(enc, None) for enc in encs]
+        keep = [keep for _b, keep, _pg in items]
         encs, devs = ecutil.encode_shard_major_many_resident(
             self.ec, blocks, range(self.km), keep)
         return list(zip(encs, devs))
 
     def _decode_dispatch(self, maps):
+        plane = self._mesh_plane()
+        if plane is not None:
+            return plane.decode_concat_many(
+                self.sinfo, self.ec, maps,
+                slot=plane.slot_of(self.name))
         return ecutil.decode_concat_many(self.sinfo, self.ec, maps)
 
-    async def _encode_op(self, buf, want_resident: bool = False):
+    def _pg_of(self, oid: str) -> int:
+        """The object's PG id (the mesh plane's slice-ownership key);
+        0 without CRUSH placement (mod-placement clusters slice by
+        batch order instead)."""
+        if self.placement is None:
+            return 0
+        return self.placement.pg_of(oid)
+
+    async def _encode_op(self, buf, want_resident: bool = False,
+                         oid: str = ""):
         """Client-op encode: the transpose runs per op (cheap host view
         work), the codec dispatch batches with every other client op in
         flight this tick.  Returns ``(chunk_map, device_block)`` --
         the device block is None unless ``want_resident`` and the codec
         composed one on device."""
         block = ecutil.to_shard_major(self.sinfo, self.k, buf)
+        pgid = self._pg_of(oid) if oid else 0
         if self._enc_coalescer is None:
-            encs, devs = ecutil.encode_shard_major_many_resident(
-                self.ec, [block], range(self.km), [want_resident])
-            return encs[0], devs[0]
+            items = [(block, want_resident, pgid)]
+            return self._encode_dispatch(items)[0]
         return await self._enc_coalescer.submit(
-            (block, want_resident), block.nbytes)
+            (block, want_resident, pgid), block.nbytes)
 
     async def _decode_op(self, chunks) -> bytes:
         """Client-op decode: stripes sharing an erasure signature ride
@@ -273,16 +312,24 @@ class ECBackend(PG):
         if self.tier_mode == "writeback" and logical and (
             resident or self._tier_hot(oid)
         ):
+            # resident blocks are keyed by the mesh slice owning the
+            # object's PG (None off-plane): the tier's per-slice
+            # accounting is how "which device holds what" stays a
+            # ledger fact once the plane shards ownership
+            plane = self._mesh_plane()
+            mesh_slice = plane.owner_slot(self._pg_of(oid)) \
+                if plane is not None else None
             if dev_block is not None:
                 tier.put(self.pool_name, oid, dev_block, version, logical,
-                         dirty=True, resident_origin=True)
+                         dirty=True, resident_origin=True,
+                         mesh_slice=mesh_slice)
                 return True
             block = np.stack([
                 np.asarray(encoded[s], dtype=np.uint8)
                 for s in range(self.km)
             ])
             tier.put(self.pool_name, oid, block, version, logical,
-                     dirty=True)
+                     dirty=True, mesh_slice=mesh_slice)
             return True
         if resident:
             tier.invalidate(self.pool_name, oid)
@@ -316,7 +363,7 @@ class ECBackend(PG):
             # composes the [k+m, bs] device block exactly when the tier
             # will insert it (and exempts that granule from donation)
             encoded, dev_block = await self._encode_op(
-                buf, self._want_resident(oid, logical))
+                buf, self._want_resident(oid, logical), oid=oid)
         else:
             # zero-byte object (S3 markers, touch): no stripes to encode
             encoded = [np.zeros(0, dtype=np.uint8) for _ in range(self.km)]
@@ -504,7 +551,7 @@ class ECBackend(PG):
         )
 
         # an RMW's resident block is dropped below, so never keep one
-        encoded, _dev = await self._encode_op(buf)
+        encoded, _dev = await self._encode_op(buf, oid=oid)
         chunk_off = self.sinfo.aligned_logical_offset_to_chunk_offset(start)
 
         if plan.is_append and hinfo_d is not None and chunk_off == (

@@ -227,6 +227,16 @@ class OSDShard:
         # batches consult THIS daemon's client-queue depth to back off
         # under saturation (osd/recovery.py BackgroundThrottle)
         backend._host_shard = self
+        # mesh data plane membership (osd_mesh_data_plane): bind this
+        # daemon to a mesh device slot so its PG-shard slice lives on
+        # (and its inbound chunks are delivered through) the device
+        # plane; daemons past the device count stay out-of-mesh and
+        # keep the wire path
+        from ceph_tpu.parallel import mesh_plane as mesh_mod
+
+        plane = mesh_mod.current_plane()
+        if plane is not None:
+            plane.bind(self.name)
         self.pools[pool] = backend
         return backend
 
@@ -1160,6 +1170,25 @@ class OSDShard:
     async def handle_sub_write(self, src: str, msg: ECSubWrite) -> None:
         """reference ECBackend::handle_sub_write (:922): log the operation,
         then apply the transaction (log_operation + queue_transactions)."""
+        if any(op.op == "write_ref" for op in msg.transaction.ops):
+            # mesh-delivered payload (osd_mesh_data_plane): the chunk
+            # bytes rode the device plane and the frame carried board
+            # references -- claim them back (crc-checked) before the
+            # version gate sees the transaction.  A failed claim
+            # (evicted / foreign reference) refuses the sub-write:
+            # no ack, no apply; peering recovery repairs the shard.
+            from ceph_tpu.parallel import mesh_plane as mesh_mod
+
+            plane = mesh_mod.current_plane()
+            if plane is None or \
+                    not plane.resolve_transaction(msg.transaction):
+                self.perf.inc("mesh_claim_miss")
+                await self.messenger.send_message(
+                    self.name, src, ECSubWriteReply(
+                        from_shard=msg.from_shard, tid=msg.tid,
+                        committed=False, applied=False,
+                    ))
+                return
         soid = shard_oid(msg.oid, msg.from_shard)
         new_vt = vt(msg.at_version)
         cur_vt = self._applied_version.get(soid)

@@ -1,0 +1,14 @@
+"""Multi-chip (mesh) erasure coding: the SPMD codec and the OSD data
+plane built on it.
+
+* ``distributed`` -- :class:`DistributedCodec`: a matrix code compiled
+  for SPMD execution over a ``jax.sharding.Mesh`` (psum / psum_scatter
+  parity, sharded reconstruction).
+* ``mesh_plane`` -- :class:`MeshDataPlane`: PG-slice ownership over the
+  local mesh, the coalescer's sharded encode dispatch, and the
+  in-collective delivery board (``osd_mesh_data_plane``).
+
+Submodules import lazily: ``distributed`` needs a jax backend at import
+time, and the OSD layer must keep degrading (plane off, wire delivery)
+when none exists.
+"""

@@ -16,6 +16,14 @@ XLA collectives over ICI:
 
 Everything here is shard_map'd and jit-compiled: one program, SPMD over the
 mesh, collectives riding ICI instead of the reference's TCP messenger.
+
+Since round 15 this codec is no longer a standalone plugin surface: the
+OSD data plane proper routes through it via
+``ceph_tpu/parallel/mesh_plane.py`` (``osd_mesh_data_plane``) -- the
+per-PG coalescer's fused batches are placed PG-sliced over the mesh,
+``encode_scatter`` is the in-collective parity delivery half, and
+:meth:`DistributedCodec.parity_owner_slots` tells the delivery split
+which shard-axis device each parity slice is born on.
 """
 
 from __future__ import annotations
@@ -171,6 +179,19 @@ class DistributedCodec:
         if self._encode_scatter_fn is None:
             raise ValueError("m must divide the shard axis size")
         return self._encode_scatter_fn(self._B_dev(), words)
+
+    def parity_owner_slots(self) -> Sequence[int]:
+        """Shard-axis device index each parity row is BORN on under the
+        :meth:`encode_scatter` layout (``psum_scatter`` tiles the m*w
+        output rows across the shard axis, so parity row j lands on
+        device ``j // (m / n_shard)``).  The mesh data plane's delivery
+        split uses this to decide which chunks are already resident on
+        their owner and can skip the wire."""
+        n_shard = self.mesh.shape["shard"]
+        if self.m % n_shard:
+            raise ValueError("m must divide the shard axis size")
+        per = self.m // n_shard
+        return [j // per for j in range(self.m)]
 
     # -- scrub: recompute parity, compare against stored (deep-scrub role) --
 

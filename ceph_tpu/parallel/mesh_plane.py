@@ -1,0 +1,723 @@
+"""Mesh-sharded OSD data plane: PG-sliced encode + in-collective delivery.
+
+ROADMAP item 1: the ``mesh_shard`` codec profile and the
+``parallel/distributed.py`` psum_scatter parity placement existed only at
+the plugin surface -- the cluster path (coalescer -> encode -> tier ->
+messenger fan-out) ran single-device.  This module is the data-plane
+half: a process-wide :class:`MeshDataPlane` over the local
+``jax.sharding.Mesh`` that
+
+* **slices PG ownership over the mesh's ``pg`` axis** -- each device
+  hosts the PG-shard slice of one in-mesh OSD (``bind``/``owner_slot``)
+  and the per-PG coalescer's fused encode batches are placed with a
+  cached ``NamedSharding`` so every device encodes the stripes of the
+  PGs it owns, mesh-locally (`"Large Scale Distributed Linear Algebra
+  With TPUs"`: express the partitioning as sharding specs, not host
+  loops);
+* **scatters parity in-collective where the backend supports it** --
+  with ``osd_mesh_scatter`` on (or a TPU backend), the GF(2)
+  contraction additionally shards the chunk axis over the mesh's
+  ``shard`` axis and ``psum_scatter`` lands each parity slice on its
+  owner device (``parallel/distributed.py`` ``encode_scatter``), so
+  parity is *born* on the device that will store it;
+* **delivers in-mesh chunk payloads off the wire** -- a sub-write whose
+  destination OSD is mesh-bound carries a tiny board reference instead
+  of the chunk bytes (the bytes already live on the owner's device
+  slice); the TCP messenger still frames/orders/replays the sub-op,
+  but the payload never crosses a socket ("Understanding System
+  Characteristics of Online Erasure Coding": the wire fan-out, not the
+  coding kernel, dominates online EC at cluster scale).  Out-of-mesh
+  peers keep the full wire path, chosen per-chunk from CRUSH placement.
+
+Gated by ``osd_mesh_data_plane`` (default off -- the single-device path
+is the A/B baseline).  Steady state constructs ZERO sharding objects
+per dispatch: ``NamedSharding``/``PartitionSpec`` instances are cached
+content-keyed (:meth:`MeshDataPlane.sharding`), coding tables ride the
+accounted matrix cache (``ops/pipeline.py``), and batch/width shapes
+are bucketed (pow2 rows per device x the shared rung ladder) so the
+jit program set is bounded -- the PR-8 zero-retrace contract, enforced
+by the mesh bench and the ``jax-percall-sharding-construction`` lint
+rule.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ceph_tpu.native.gf_native import crc32c
+
+#: payloads below this stay inline on the wire: a board round-trip
+#: (deposit + claim + crc) costs more than serializing a few bytes
+MIN_DETACH_BYTES = 1024
+
+
+def _pow2ceil(n: int) -> int:
+    p = 1
+    while p < n:
+        p <<= 1
+    return p
+
+
+class DeliveryBoard:
+    """Process-wide in-collective chunk handoff between in-mesh OSDs.
+
+    The primary deposits a chunk's bytes (conceptually: the slice the
+    collective left on the owner's device) and the sub-write frame
+    carries only ``(key, nbytes, crc32c)``; the receiving OSD claims the
+    bytes at apply time.  Byte-bounded (``osd_mesh_board_bytes``):
+    beyond the cap the oldest unclaimed deposits drop and the affected
+    sub-write fails over to recovery -- the same lossy-bound stance the
+    messenger takes on its lossless backlog."""
+
+    def __init__(self, cap_bytes: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._entries: "Dict[int, bytes]" = {}
+        self._order: List[int] = []
+        self._bytes = 0
+        self._next_key = 0
+        self._cap = cap_bytes
+        self.deposits = 0
+        self.claims = 0
+        self.claimed_bytes = 0
+        self.misses = 0
+        self.evictions = 0
+
+    def _cap_bytes(self) -> int:
+        if self._cap is not None:
+            return self._cap
+        try:
+            from ceph_tpu.utils.config import get_config
+
+            return int(get_config().get_val("osd_mesh_board_bytes"))
+        except Exception:  # noqa: BLE001 -- no config layer
+            return 64 << 20
+
+    def deposit(self, data) -> Tuple[int, int, int]:
+        """Park one chunk payload; returns ``(key, nbytes, crc32c)`` --
+        the reference the mesh-delivery frame carries instead of the
+        bytes."""
+        buf = bytes(data)
+        crc = crc32c(buf)
+        with self._lock:
+            self._next_key += 1
+            key = self._next_key
+            self._entries[key] = buf
+            self._order.append(key)
+            self._bytes += len(buf)
+            self.deposits += 1
+            cap = self._cap_bytes()
+            while self._bytes > cap and self._order:
+                old = self._order.pop(0)
+                dropped = self._entries.pop(old, None)
+                if dropped is not None:
+                    self._bytes -= len(dropped)
+                    self.evictions += 1
+        return key, len(buf), crc
+
+    def claim(self, key: int) -> Optional[bytes]:
+        """Pop a deposited payload (single-shot); None when evicted or
+        never deposited in this process (an out-of-mesh replay)."""
+        with self._lock:
+            buf = self._entries.pop(key, None)
+            if buf is None:
+                self.misses += 1
+                return None
+            self._bytes -= len(buf)
+            self.claims += 1
+            self.claimed_bytes += len(buf)
+        return buf
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            return {
+                "deposits": self.deposits,
+                "claims": self.claims,
+                "claimed_bytes": self.claimed_bytes,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "pending_bytes": self._bytes,
+            }
+
+
+class _PoolCodec:
+    """Per-(coding matrix) jitted SPMD programs on the plane's mesh.
+
+    Local mode (the default off-TPU): the batch axis is sharded over
+    BOTH mesh axes (pure PG slicing) and each device runs the GF(2^8)
+    row-table gather kernel (``ops/xla_gf`` byte lane) on its slice --
+    encode is entirely mesh-local, no collective.  Scatter mode
+    (``osd_mesh_scatter``): the chunk axis shards over the ``shard``
+    axis and parity is reduce-scattered to its owner device
+    (``DistributedCodec.encode_scatter`` -- half the ICI traffic of an
+    all-reduce and the natural layout when parity shards live on
+    distinct devices)."""
+
+    def __init__(self, plane: "MeshDataPlane", matrix: np.ndarray,
+                 k: int, m: int, w: int):
+        import jax
+        from ceph_tpu.ops.xla_gf import gf8_row_tables
+        from ceph_tpu.parallel.distributed import shard_map
+
+        self.plane = plane
+        self.k, self.m, self.w = k, m, w
+        self.matrix = np.asarray(matrix, dtype=np.uint32)
+        #: [m, k, 256] GF(2^8) row-times-value tables, uploaded once
+        #: through the accounted cache, replicated over the mesh
+        self._enc_tab = gf8_row_tables(self.matrix)
+        self._scatter_codec = None
+
+        def _apply(tab, words):
+            # words [b_loc, k, n] u8; tab [rows, k, 256]
+            from ceph_tpu.ops.xla_gf import _encode_bytes
+
+            b, kk, n = words.shape
+            flat = words.transpose(1, 0, 2).reshape(kk, b * n)
+            out = _encode_bytes(tab, flat)  # [rows, b*n]
+            return out.reshape(tab.shape[0], b, n).transpose(1, 0, 2)
+
+        # two dispatch lanes, one program each per codec instance (jit
+        # caches per bucketed-shape after that):
+        # * fused -- a FULL balanced batch rides one shard_map program,
+        #   placed with the cached NamedSharding over (pg, shard);
+        # * slot -- a partial/skewed batch dispatches per owner slot
+        #   onto that slot's device alone (mesh-LOCAL encode: no
+        #   cross-slot zero padding, and the per-device launches are
+        #   async so distinct slots overlap on real silicon)
+        self._fused_fn = jax.jit(shard_map(
+            _apply,
+            mesh=plane.mesh,
+            in_specs=(plane.pspec(None, None, None),
+                      plane.pspec(("pg", "shard"), None, None)),
+            out_specs=plane.pspec(("pg", "shard"), None, None),
+        ))
+        self._slot_fn = jax.jit(_apply)
+
+    def _tab_dev(self, tab: np.ndarray):
+        from ceph_tpu.ops.pipeline import accounted_device_matrix
+
+        return accounted_device_matrix(
+            tab, sharding=self.plane.sharding(None, None, None))
+
+    def _tab_on_slot(self, tab: np.ndarray, slot: int):
+        from ceph_tpu.ops.pipeline import accounted_device_matrix
+
+        return accounted_device_matrix(
+            tab, sharding=self.plane.devices[slot])
+
+    def scatter_codec(self):
+        """The psum_scatter path (``parallel/distributed.py``) on the
+        plane's collective mesh; None when k/m do not divide the shard
+        axis (the local path covers those pools)."""
+        if self._scatter_codec is None:
+            mesh = self.plane.collective_mesh
+            ns = mesh.shape["shard"]
+            if self.k % ns or self.m % ns:
+                return None
+            from ceph_tpu.parallel.distributed import DistributedCodec
+
+            self._scatter_codec = DistributedCodec(
+                self.matrix, self.w, mesh)
+        return self._scatter_codec
+
+    # -- dispatch ----------------------------------------------------------
+
+    def apply_fused(self, tab: np.ndarray, stacks: np.ndarray) -> np.ndarray:
+        """Run ``tab`` ([rows, k, 256]) over ``stacks`` ([B, k, n] u8,
+        B pre-bucketed to the mesh batch granularity) -- one fused
+        sharded dispatch, PG-sliced over the mesh."""
+        from ceph_tpu.analysis import residency
+
+        plane = self.plane
+        arr = residency.device_put(
+            stacks, plane.sharding(("pg", "shard"), None, None))
+        out = self._fused_fn(self._tab_dev(tab), arr)
+        host = residency.device_get(out)
+        ctr = residency.counters()
+        ctr.note_mesh("pg", stacks.nbytes)
+        if plane.n_shard > 1:
+            ctr.note_mesh("shard", stacks.nbytes // plane.n_shard)
+        return host
+
+    def run_tab(self, tab: np.ndarray, blocks: Sequence[np.ndarray],
+                pgids: Sequence[int], bs_pad: int,
+                slot: Optional[int] = None) -> List[np.ndarray]:
+        """Apply ``tab`` to every [k, bs] block, PG-sliced.
+
+        ``slot`` set = the PRIMARY-slot lane: the whole batch is one
+        dispatch on that slot's device (a coalescer batch belongs to
+        one primary OSD, whose device owns every PG it leads -- the
+        per-PG mesh slicing emerges because DIFFERENT primaries' fused
+        batches land on different devices and their async launches
+        overlap).  ``slot=None`` spreads by per-stripe PG ownership: a
+        batch covering every mesh slot rides the fused shard_map
+        program, a partial one dispatches per owner slot.  Returns one
+        [rows_out, bs_pad] host array per block, input order."""
+        from ceph_tpu.analysis import residency
+
+        plane = self.plane
+        k = blocks[0].shape[0]
+        per_slot: Dict[int, List[int]] = {}
+        if slot is not None:
+            per_slot[slot % plane.n_devices] = list(range(len(blocks)))
+        else:
+            for i, pg in enumerate(pgids):
+                per_slot.setdefault(plane.owner_slot(pg), []).append(i)
+        if len(per_slot) == plane.n_devices:
+            stacks, where = plane._stack_pg_sliced(blocks, pgids, bs_pad)
+            host = self.apply_fused(tab, stacks)
+            plane.counters["mesh_fused_dispatches"] += 1
+            return [host[row] for row, _bs in where]
+        # partial batch: per-slot mesh-local dispatch -- the launches
+        # are async, so distinct slots' kernels overlap on real devices
+        ctr = residency.counters()
+        outs: Dict[int, object] = {}
+        total = 0
+        for slot, idxs in per_slot.items():
+            rows = plane._bucket_batch(len(idxs))
+            arr = np.zeros((rows, k, bs_pad), dtype=np.uint8)
+            for j, i in enumerate(idxs):
+                b = blocks[i]
+                arr[j, :, :b.shape[1]] = b
+            d = residency.device_put(arr, plane.devices[slot])
+            outs[slot] = self._slot_fn(self._tab_on_slot(tab, slot), d)
+            total += arr.nbytes
+        ctr.note_mesh("pg", total)
+        plane.counters["mesh_local_dispatches"] += len(per_slot)
+        results: List[Optional[np.ndarray]] = [None] * len(blocks)
+        for slot, idxs in per_slot.items():
+            host = residency.device_get(outs[slot])
+            for j, i in enumerate(idxs):
+                results[i] = host[j]
+        return results  # type: ignore[return-value]
+
+    def encode_scatter(self, stacks: np.ndarray) -> Optional[np.ndarray]:
+        """In-collective parity scatter: [B, k, n] -> [B, m, n] with the
+        parity computed by a shard-axis psum_scatter (each owner device
+        receives exactly its slice).  None when the pool shape cannot
+        ride the collective mesh."""
+        codec = self.scatter_codec()
+        if codec is None:
+            return None
+        from ceph_tpu.analysis import residency
+
+        parity = np.asarray(codec.encode_scatter(stacks))
+        ctr = residency.counters()
+        ctr.note_mesh("pg", stacks.nbytes)
+        ctr.note_mesh("shard", stacks.nbytes)
+        return parity
+
+
+class MeshDataPlane:
+    """Process-wide mesh over the local devices: PG-slice ownership,
+    sharded codec dispatch, and the in-collective delivery board."""
+
+    def __init__(self, n_devices: Optional[int] = None):
+        import jax
+        from jax.sharding import Mesh, NamedSharding
+        from jax.sharding import PartitionSpec as PSpec
+
+        self._NamedSharding = NamedSharding
+        self._PSpec = PSpec
+        devs = jax.devices()
+        if n_devices is None:
+            try:
+                from ceph_tpu.utils.config import get_config
+
+                n_devices = int(get_config().get_val("osd_mesh_devices"))
+            except Exception:  # noqa: BLE001 -- no config layer
+                n_devices = 0
+        n = len(devs) if not n_devices else min(int(n_devices), len(devs))
+        self.devices = list(devs[:max(1, n)])
+        self.n_devices = len(self.devices)
+        # (pg, shard) factoring: the shard axis exists for the
+        # in-collective parity scatter; pools whose k/m do not divide
+        # it ride the pg axis alone (the batch shards over BOTH axes)
+        n_shard = 1
+        for cand in (4, 2):
+            if self.n_devices % cand == 0:
+                n_shard = cand
+                break
+        self.n_shard = n_shard
+        self.n_pg = self.n_devices // n_shard
+        self.mesh = Mesh(
+            np.array(self.devices).reshape(self.n_pg, self.n_shard),
+            axis_names=("pg", "shard"),
+        )
+        self._collective_mesh = None
+        #: content-keyed PartitionSpec / NamedSharding caches: steady-
+        #: state dispatch constructs ZERO sharding objects per op (the
+        #: jax-percall-sharding-construction contract; the analogue of
+        #: PR-7's accounted_device_matrix for placement objects)
+        self._pspecs: Dict[tuple, object] = {}
+        self._shardings: Dict[tuple, object] = {}
+        self.sharding_builds = 0
+        #: in-mesh OSD membership: name -> device slot (one OSD per
+        #: device -- the TPU-core-per-OSD model; late binders past the
+        #: device count stay out-of-mesh and keep the wire path)
+        self._members: Dict[str, int] = {}
+        self._codecs: Dict[tuple, _PoolCodec] = {}
+        self._lock = threading.Lock()
+        self.board = DeliveryBoard()
+        self.counters: Dict[str, int] = {
+            "mesh_encode_stripes": 0,
+            "mesh_encode_dispatches": 0,
+            "mesh_fused_dispatches": 0,
+            "mesh_local_dispatches": 0,
+            "mesh_decode_stripes": 0,
+            "mesh_deliver_chunks": 0,
+            "mesh_wire_bytes_avoided": 0,
+            "mesh_claim_miss": 0,
+        }
+
+    # -- sharding-object cache (content-keyed, built once) -----------------
+
+    def pspec(self, *axes):
+        spec = self._pspecs.get(axes)
+        if spec is None:
+            spec = self._pspecs[axes] = self._PSpec(*axes)
+        return spec
+
+    def sharding(self, *axes):
+        ns = self._shardings.get(axes)
+        if ns is None:
+            ns = self._shardings[axes] = self._NamedSharding(
+                self.mesh, self.pspec(*axes))
+            self.sharding_builds += 1
+        return ns
+
+    @property
+    def collective_mesh(self):
+        """(data, shard, sub) view of the same devices for the
+        ``DistributedCodec`` scatter path (its axis names are part of
+        its compiled programs)."""
+        if self._collective_mesh is None:
+            from ceph_tpu.parallel.distributed import make_mesh
+
+            self._collective_mesh = make_mesh(
+                n_data=self.n_pg, n_shard=self.n_shard, n_sub=1,
+                devices=self.devices,
+            )
+        return self._collective_mesh
+
+    # -- membership / PG-slice ownership -----------------------------------
+
+    def bind(self, name: str) -> Optional[int]:
+        """Attach an OSD to the mesh; returns its device slot, or None
+        once every device hosts an OSD (the overflow stays
+        out-of-mesh).  Idempotent per name."""
+        with self._lock:
+            slot = self._members.get(name)
+            if slot is not None:
+                return slot
+            if len(self._members) >= self.n_devices:
+                return None
+            slot = len(self._members)
+            self._members[name] = slot
+            return slot
+
+    def covers(self, name: str) -> bool:
+        return name in self._members
+
+    def slot_of(self, name: str) -> Optional[int]:
+        return self._members.get(name)
+
+    def owner_slot(self, pgid: int) -> int:
+        """The mesh device slot owning a PG's shard slice."""
+        return int(pgid) % self.n_devices
+
+    # -- codec plumbing ----------------------------------------------------
+
+    def can_encode(self, ec) -> bool:
+        return bool(getattr(ec, "mesh_plane_capable", False))
+
+    def _codec(self, ec) -> _PoolCodec:
+        matrix = np.asarray(ec.matrix, dtype=np.uint32)
+        key = (matrix.shape, matrix.tobytes(), int(ec.w))
+        with self._lock:
+            codec = self._codecs.get(key)
+            if codec is None:
+                codec = self._codecs[key] = _PoolCodec(
+                    self, matrix, ec.get_data_chunk_count(),
+                    ec.get_chunk_count() - ec.get_data_chunk_count(),
+                    int(ec.w),
+                )
+            return codec
+
+    def _scatter_on(self) -> bool:
+        try:
+            from ceph_tpu.utils.config import get_config
+
+            mode = str(get_config().get_val("osd_mesh_scatter"))
+        except Exception:  # noqa: BLE001
+            mode = "auto"
+        if mode == "on":
+            return True
+        if mode == "off":
+            return False
+        try:
+            import jax
+
+            return jax.default_backend() == "tpu"
+        except Exception:  # noqa: BLE001
+            return False
+
+    def _bucket_batch(self, count_per_slot: int) -> int:
+        """Rows-per-device bucket (pow2) so the jit program set stays
+        bounded no matter how the coalescer's batch sizes wander."""
+        return _pow2ceil(max(1, count_per_slot))
+
+    @staticmethod
+    def _bucket_bs(bs: int) -> int:
+        """Stripe-width bucket: the shared rung ladder
+        (``ops/bucketing.py``) extended downward with pow2 sub-rungs --
+        the plane's unit is one stripe's chunk (KiBs), not the
+        pipeline's fused granule (the ladder starts at 16 KiB), and
+        padding a 4 KiB chunk 4x would waste sliced compute."""
+        from ceph_tpu.ops import bucketing
+
+        floor = bucketing.ladder()[0]
+        if bs >= floor:
+            return bucketing.bucket_bytes(bs)
+        return min(floor, max(1024, _pow2ceil(bs)))
+
+    def _stack_pg_sliced(
+        self, blocks: Sequence[np.ndarray], pgids: Sequence[int],
+        bs_pad: int,
+    ) -> Tuple[np.ndarray, List[Tuple[int, int]]]:
+        """Arrange ``blocks`` ([k, bs] u8) into the PG-sliced batch
+        array [n_devices * rows, k, bs_pad]: stripe i lands in its
+        owning slot's row segment, so the NamedSharding placement puts
+        each stripe on the device that owns its PG.  Returns the array
+        and each stripe's (global row, true width)."""
+        k = blocks[0].shape[0]
+        per_slot: Dict[int, List[int]] = {}
+        for i, pg in enumerate(pgids):
+            per_slot.setdefault(self.owner_slot(pg), []).append(i)
+        rows = self._bucket_batch(
+            max(len(v) for v in per_slot.values()))
+        arr = np.zeros((self.n_devices * rows, k, bs_pad), dtype=np.uint8)
+        where: List[Tuple[int, int]] = [(0, 0)] * len(blocks)
+        for slot, idxs in per_slot.items():
+            for j, i in enumerate(idxs):
+                b = blocks[i]
+                arr[slot * rows + j, :, :b.shape[1]] = b
+                where[i] = (slot * rows + j, b.shape[1])
+        return arr, where
+
+    # -- encode (the coalescer's fused dispatch target) --------------------
+
+    def encode_shard_major_many(
+        self, ec, blocks: Sequence[np.ndarray],
+        pgids: Optional[Sequence[int]] = None,
+        slot: Optional[int] = None,
+    ) -> List[Dict[int, np.ndarray]]:
+        """ONE PG-sliced SPMD dispatch per (bucketed width) group over
+        the whole coalesced batch: [k, bs] shard-major blocks in, full
+        chunk maps out -- bit-exact with the single-device path and the
+        jerasure oracle (gated in tests/test_mesh_plane.py)."""
+        codec = self._codec(ec)
+        k, m = codec.k, codec.m
+        if pgids is None:
+            pgids = list(range(len(blocks)))
+        out: List[Optional[Dict[int, np.ndarray]]] = [None] * len(blocks)
+        groups: Dict[int, List[int]] = {}
+        for i, b in enumerate(blocks):
+            if b.shape[1] == 0:
+                out[i] = {ec.chunk_index(j): np.zeros(0, np.uint8)
+                          for j in range(k + m)}
+                continue
+            groups.setdefault(self._bucket_bs(b.shape[1]), []).append(i)
+        scatter = self._scatter_on()
+        for bs_pad, idxs in groups.items():
+            blocks_l = [np.asarray(blocks[i], dtype=np.uint8)
+                        for i in idxs]
+            pgids_l = [pgids[i] for i in idxs]
+            rows_l = None
+            if scatter:
+                stacks, where = self._stack_pg_sliced(
+                    blocks_l, pgids_l, bs_pad)
+                parity = codec.encode_scatter(stacks)
+                if parity is not None:
+                    rows_l = [parity[row] for row, _bs in where]
+            if rows_l is None:
+                rows_l = codec.run_tab(
+                    codec._enc_tab, blocks_l, pgids_l, bs_pad,
+                    slot=slot)
+            for i, pr in zip(idxs, rows_l):
+                b = blocks[i]
+                bs = b.shape[1]
+                enc = {ec.chunk_index(j): b[j] for j in range(k)}
+                for j in range(m):
+                    enc[ec.chunk_index(k + j)] = np.ascontiguousarray(
+                        pr[j, :bs])
+                out[i] = enc
+            self.counters["mesh_encode_dispatches"] += 1
+            self.counters["mesh_encode_stripes"] += len(idxs)
+        return out  # type: ignore[return-value]
+
+    # -- decode (degraded reads through the same sliced plane) -------------
+
+    def decode_maps(
+        self, ec, maps: Sequence[Dict[int, np.ndarray]],
+        slot: Optional[int] = None,
+    ) -> List[Dict[int, np.ndarray]]:
+        """Reconstruct every missing chunk of every map; signature
+        groups share one composed row matrix and one sliced dispatch
+        per width group (the decode twin of the encode path)."""
+        from ceph_tpu.ops.pipeline import matrix_reconstruct_rows
+        from ceph_tpu.ops.xla_gf import gf8_row_tables
+
+        codec = self._codec(ec)
+        k, m = codec.k, codec.m
+        km = k + m
+        results: List[Optional[Dict[int, np.ndarray]]] = [None] * len(maps)
+        groups: Dict[tuple, List[int]] = {}
+        for i, cm in enumerate(maps):
+            groups.setdefault(tuple(sorted(cm.keys())), []).append(i)
+        for sig, idxs in groups.items():
+            erased = [c for c in range(km) if c not in sig]
+            if not erased:
+                for i in idxs:
+                    results[i] = {c: np.asarray(a, dtype=np.uint8)
+                                  for c, a in maps[i].items()}
+                continue
+            if len(sig) < k:
+                raise ValueError("not enough chunks to decode")
+            sel, rows = matrix_reconstruct_rows(
+                codec.matrix, k, m, codec.w, list(sig), erased)
+            tab = gf8_row_tables(rows)
+            by_size: Dict[int, List[int]] = {}
+            for i in idxs:
+                bs = len(next(iter(maps[i].values())))
+                by_size.setdefault(bs, []).append(i)
+            for bs, sized in by_size.items():
+                bs_pad = self._bucket_bs(bs)
+                rec_l = codec.run_tab(
+                    tab,
+                    [np.stack([np.asarray(maps[i][c], dtype=np.uint8)
+                               for c in sel]) for i in sized],
+                    list(range(len(sized))), bs_pad, slot=slot)
+                for i, rec in zip(sized, rec_l):
+                    full = {c: np.asarray(a, dtype=np.uint8)
+                            for c, a in maps[i].items()}
+                    for j, e in enumerate(erased):
+                        full[e] = np.ascontiguousarray(rec[j, :bs])
+                    results[i] = full
+                self.counters["mesh_decode_stripes"] += len(sized)
+        return results  # type: ignore[return-value]
+
+    def decode_concat_many(self, sinfo, ec, maps,
+                           slot: Optional[int] = None) -> List[bytes]:
+        """``ecutil.decode_concat_many`` with the reconstruction routed
+        through the sliced plane (the read-path coalescer's dispatch)."""
+        from ceph_tpu.osd import ecutil
+
+        results: List[bytes] = [b""] * len(maps)
+        need = [i for i, cm in enumerate(maps)
+                if cm and len(next(iter(cm.values()))) > 0]
+        if not need:
+            return results
+        full = self.decode_maps(ec, [maps[i] for i in need], slot=slot)
+        for i, out in zip(need, full):
+            results[i] = ecutil._reassemble(sinfo, ec, out)
+        return results
+
+    # -- in-collective delivery (the wire split's board half) --------------
+
+    def detach_sub_write(self, sub) -> int:
+        """Replace a sub-write transaction's chunk payloads with board
+        references (the mesh-delivery frame: the bytes ride the device
+        plane, the messenger frames only the envelope).  Returns the
+        payload bytes taken off the wire."""
+        txn = getattr(sub, "transaction", None)
+        if txn is None:
+            return 0
+        moved = 0
+        for op in txn.ops:
+            if op.op == "write" and len(op.data) >= MIN_DETACH_BYTES:
+                key, nbytes, crc = self.board.deposit(op.data)
+                op.op = "write_ref"
+                op.data = b""
+                op.attr_value = (key, nbytes, crc)
+                moved += nbytes
+        if moved:
+            self.counters["mesh_deliver_chunks"] += 1
+            self.counters["mesh_wire_bytes_avoided"] += moved
+        return moved
+
+    def resolve_transaction(self, txn) -> bool:
+        """Claim every board reference back into payload bytes before
+        the transaction applies (crc-checked, like the wire frame the
+        bytes skipped).  False = a reference was evicted/foreign; the
+        caller refuses the sub-write and recovery repairs the shard."""
+        for op in txn.ops:
+            if op.op != "write_ref":
+                continue
+            key, nbytes, crc = op.attr_value
+            data = self.board.claim(key)
+            if data is None or len(data) != nbytes or crc32c(data) != crc:
+                self.counters["mesh_claim_miss"] += 1
+                return False
+            op.op = "write"
+            op.data = data
+            op.attr_value = None
+        return True
+
+    def status(self) -> dict:
+        return {
+            "n_devices": self.n_devices,
+            "n_pg": self.n_pg,
+            "n_shard": self.n_shard,
+            "members": dict(self._members),
+            "sharding_builds": self.sharding_builds,
+            "board": self.board.stats(),
+            "counters": dict(self.counters),
+        }
+
+
+_plane: Optional[MeshDataPlane] = None
+_plane_lock = threading.Lock()
+
+
+def configure(n_devices: Optional[int] = None) -> MeshDataPlane:
+    """(Re)build the process plane over ``n_devices`` (None/0 = every
+    local device) -- the bench sweep's knob.  Drops prior membership
+    and board state (a mesh reshape is a process event, like an osdmap
+    epoch)."""
+    global _plane
+    with _plane_lock:
+        _plane = MeshDataPlane(n_devices)
+        return _plane
+
+
+def reset() -> None:
+    global _plane
+    with _plane_lock:
+        _plane = None
+
+
+def current_plane() -> Optional[MeshDataPlane]:
+    """The process plane iff ``osd_mesh_data_plane`` is on and a jax
+    backend exists; None otherwise (callers fall back to the
+    single-device / full-wire path)."""
+    try:
+        from ceph_tpu.utils.config import get_config
+
+        if not bool(get_config().get_val("osd_mesh_data_plane")):
+            return None
+    except Exception:  # noqa: BLE001 -- no config layer: stay off
+        return None
+    global _plane
+    plane = _plane
+    if plane is not None:
+        return plane
+    with _plane_lock:
+        if _plane is None:
+            try:
+                _plane = MeshDataPlane()
+            except Exception:  # noqa: BLE001 -- no jax backend
+                return None
+        return _plane

@@ -88,6 +88,18 @@ class _TpuMixin:
     def _mesh_active(self) -> bool:
         return any(n > 1 for n in self._mesh_spec)
 
+    @property
+    def mesh_plane_capable(self) -> bool:
+        """Can the OSD mesh data plane (``osd_mesh_data_plane``,
+        ceph_tpu/parallel/mesh_plane.py) take this codec's coalesced
+        encode/decode batches?  Matrix techniques at w=8 qualify (the
+        plane's GF(2^8) row-table and psum_scatter lanes are bit-exact
+        for them); a profile that ALREADY shards over its own mesh
+        (``mesh_shard``/``mesh_sub``) keeps that path -- the plane must
+        not re-shard a sharded codec."""
+        return (getattr(self, "matrix", None) is not None
+                and self.w == 8 and not self._mesh_active())
+
     def _mesh(self):
         if self._mesh_codec is None:
             from ceph_tpu.parallel.distributed import (
@@ -96,7 +108,19 @@ class _TpuMixin:
             )
 
             nd, ns, nb = self._mesh_spec
-            mesh = make_mesh(n_data=nd, n_shard=ns, n_sub=nb)
+            # mesh_shard profile wiring: when the OSD mesh data plane is
+            # up, the profile's mesh rides the SAME device set (one
+            # process, one mesh ownership map) instead of grabbing raw
+            # jax.devices() -- falling back to the raw set when the
+            # plane spans fewer devices than the profile asks for
+            devices = None
+            from ceph_tpu.parallel import mesh_plane as mesh_mod
+
+            plane = mesh_mod.current_plane()
+            if plane is not None and len(plane.devices) >= nd * ns * nb:
+                devices = plane.devices
+            mesh = make_mesh(n_data=nd, n_shard=ns, n_sub=nb,
+                             devices=devices)
             self._mesh_codec = DistributedCodec(self.matrix, self.w, mesh)
         return self._mesh_codec
 

@@ -84,6 +84,39 @@ OPTIONS: Dict[str, Option] = {
              "re-upload) instead of re-uploading the host copy.  "
              "Granules carrying such objects are never donated",
              see_also=("osd_ec_donate", "osd_tier_promote_temp")),
+        _opt("osd_mesh_data_plane", bool, False, LEVEL_ADVANCED,
+             "mesh-shard the OSD data plane over the local "
+             "jax.sharding.Mesh (ceph_tpu/parallel/mesh_plane.py): PG "
+             "ownership is sliced over the mesh's pg axis, the per-PG "
+             "coalescer's fused encode batches run SPMD across the "
+             "devices, and chunk payloads destined for in-mesh OSDs "
+             "are delivered through the device plane (in-collective) "
+             "instead of being serialized through the TCP messenger.  "
+             "False (default) keeps the single-device path -- the A/B "
+             "baseline the mesh-path bench compares against",
+             see_also=("osd_mesh_devices", "osd_mesh_scatter",
+                       "osd_mesh_board_bytes")),
+        _opt("osd_mesh_devices", int, 0, LEVEL_ADVANCED,
+             "devices the mesh data plane spans (0 = every local jax "
+             "device).  Each mesh device hosts one OSD's PG-shard "
+             "slice; OSDs past the device count stay out-of-mesh and "
+             "keep the wire delivery path",
+             see_also=("osd_mesh_data_plane",)),
+        _opt("osd_mesh_scatter", str, "auto", LEVEL_ADVANCED,
+             "in-collective parity scatter mode for the mesh data "
+             "plane: 'auto' shards the GF contraction over the mesh's "
+             "shard axis (psum_scatter parity placement) only on a TPU "
+             "backend where the collectives ride ICI; 'on' forces it "
+             "(cpu-fallback correctness runs); 'off' keeps every "
+             "device's encode mesh-local (pg slicing only)",
+             see_also=("osd_mesh_data_plane",)),
+        _opt("osd_mesh_board_bytes", int, 64 << 20, LEVEL_ADVANCED,
+             "byte bound on the mesh delivery board (the in-collective "
+             "chunk handoff between in-mesh OSDs): beyond it the "
+             "oldest unclaimed deposits are dropped and the affected "
+             "sub-write fails over to normal recovery (bounded memory; "
+             "claims release immediately)",
+             see_also=("osd_mesh_data_plane",)),
         _opt("osd_recovery_max_chunk", int, 8 << 20, LEVEL_ADVANCED,
              "max bytes per recovery window"),
         _opt("osd_recovery_batched", bool, True, LEVEL_ADVANCED,

@@ -1,0 +1,68 @@
+"""Fixture: jax-percall-sharding-construction (under a ceph_tpu/ path).
+
+Placement objects (Mesh / NamedSharding / PartitionSpec / make_mesh)
+are dispatch-invariant: constructing one inside a loop or inside a
+jitted function re-hashes device lists per call and defeats jax's C++
+dispatch cache.  Builder-code construction (``__init__``, cache-miss
+fill) is the sanctioned shape.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+class Plane:
+    def __init__(self, devices):
+        # construction-time build: clean
+        self.mesh = Mesh(np.array(devices), axis_names=("pg",))
+        self._shardings = {}
+
+    def sharding(self, *axes):
+        # cache-miss fill (no loop, not jitted): the blessed seam
+        ns = self._shardings.get(axes)
+        if ns is None:
+            ns = self._shardings[axes] = NamedSharding(self.mesh, P(*axes))
+        return ns
+
+    def dispatch_many(self, batches):
+        outs = []
+        for arr in batches:
+            ns = NamedSharding(self.mesh, P("pg"))  # LINT: jax-percall-sharding-construction
+            outs.append(jax.device_put(arr, ns))
+        return outs
+
+    def dispatch_cached(self, batches):
+        ns = self.sharding("pg")  # hoisted through the cache: clean
+        return [jax.device_put(arr, ns) for arr in batches]
+
+
+def spec_in_while(mesh, n):
+    out = []
+    i = 0
+    while i < n:
+        out.append(P("pg", None))  # LINT: jax-percall-sharding-construction
+        i += 1
+    return out
+
+
+@jax.jit
+def jitted_dispatch(x):
+    spec = P(None)  # LINT: jax-percall-sharding-construction
+    return jax.lax.with_sharding_constraint(x, spec)
+
+
+def build_mesh_once(devices):
+    # plain builder function: clean
+    return Mesh(np.array(devices), axis_names=("pg",))
+
+
+def loop_defines_builder(devices, n):
+    builders = []
+    for _ in range(n):
+        def make():
+            # the loop re-runs the DEF, not this body: clean
+            return Mesh(np.array(devices), axis_names=("pg",))
+
+        builders.append(make)
+    return builders

@@ -46,6 +46,9 @@ FIXTURES = {
     "jax_donation_rebind_pipeline.py": None,
     "jax_bucketing_pipeline.py": "ceph_tpu/ops/_fixture_bucketing.py",
     "jax_loop_invariant_transfer.py": "ceph_tpu/ops/_fixture_loopinv.py",
+    # PR-15 mesh data plane: placement objects built once, cached
+    "jax_percall_sharding_construction.py":
+        "ceph_tpu/parallel/_fixture_sharding.py",
     "ceph_config_undeclared.py": None,
     "async_rmw_across_await.py": None,
     "async_lock_across_await.py": None,

@@ -228,8 +228,14 @@ def test_real_encode_path_enters_sections_cleanly():
 
 def test_status_payload_shape():
     st = residency.status()
-    assert set(st["counters"]) == {"h2d_ops", "h2d_bytes", "d2h_ops",
-                                   "d2h_bytes", "jit_retraces"}
+    base = {"h2d_ops", "h2d_bytes", "d2h_ops", "d2h_bytes",
+            "jit_retraces"}
+    assert base <= set(st["counters"])
+    # the only dynamic keys are the mesh plane's per-axis dispatch
+    # ledger (mesh_<axis>_dispatches / mesh_<axis>_bytes), present once
+    # any sharded dispatch has run in this process
+    assert all(k.startswith("mesh_")
+               for k in set(st["counters"]) - base)
     assert "mode" in st and "violations" in st and \
         "sections_entered" in st
 

@@ -8,13 +8,19 @@
 #      transfer ledger (h2d/d2h ops+bytes, jit retraces) as JSON and
 #      FAILS on any steady-state retrace -- transfer regressions
 #      surface here, in CI, not in the next bench round.
-#   3. The full-tree gate (the exact scan tests/test_cephlint.py pins)
+#   3. A multichip dryrun smoke on >= 2 simulated devices (the fast
+#      half of __graft_entry__.dryrun_multichip: sharded compile checks
+#      + the mesh-plane stage, whose steady-state pass asserts ZERO
+#      retraces per the PR-8 ledger contract and whose delivery cycle
+#      asserts in-collective chunk movement).
+#   4. The full-tree gate (the exact scan tests/test_cephlint.py pins)
 #      then decides the exit code -- a finding anywhere fails CI, not
 #      just one the diff happened to touch.
 #
 # Usage: tools/ci_lint.sh [sarif-output-path]
 #   CEPHLINT_SARIF_OUT overrides the default cephlint.sarif.
-#   CEPHLINT_NO_SMOKE=1 skips the transfer smoke (lint-only runners).
+#   CEPHLINT_NO_SMOKE=1 skips the transfer + multichip smokes
+#   (lint-only runners).
 
 set -eu
 
@@ -29,6 +35,12 @@ if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
         -P k=4 -P m=2 --objects 16 --size 4096 --writers 4 \
         --iterations 2 --profile
     echo "cephlint: storage-path transfer smoke passed" >&2
+    # multichip dryrun on simulated devices: jax_num_cpu_devices where
+    # the jax supports it, the XLA_FLAGS device-count override otherwise
+    JAX_PLATFORMS=cpu \
+    XLA_FLAGS="${XLA_FLAGS:-} --xla_force_host_platform_device_count=2" \
+    python -c 'import __graft_entry__ as g; g.dryrun_multichip(2, fast=True)'
+    echo "cephlint: multichip mesh-plane smoke passed (2 devices)" >&2
 fi
 
 exec python tools/cephlint.py ceph_tpu tools tests

@@ -39,7 +39,10 @@ def parse_args(argv):
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
-                            "recovery-path"])
+                            "recovery-path", "mesh-path"])
+    p.add_argument("--mesh-sizes", default="1,2,4,8",
+                   help="mesh-path only: comma-separated mesh device "
+                        "counts to sweep")
     p.add_argument("-e", "--erasures", type=int, default=1,
                    help="number of erasures when decoding")
     p.add_argument("--erased", type=int, action="append", default=[],
@@ -122,6 +125,50 @@ def main(argv=None) -> int:
             continue
         key, val = param.split("=")
         profile[key] = val
+
+    if args.workload == "mesh-path":
+        # Mesh-scaling stage (round 15): the full TCP cluster path and
+        # the PG-sliced SPMD encode dispatch swept over mesh device
+        # counts (osd_mesh_data_plane on) vs the TCP-only baseline.
+        # Correctness-gated inside the harness: bit-exact read-back,
+        # byte-identical shards across configurations, monotone
+        # wire-bytes-avoided, and ZERO steady-state retraces in the
+        # timed pass -- any steady retrace raises, so the PR-8 ledger
+        # contract is this command's exit code (tools/ci_lint.sh runs
+        # it as the multichip smoke).  The pool profile is fixed
+        # (k=2 m=2 tpu unless -P overrides); --objects/--size scale
+        # the payload set.
+        import json
+
+        from ceph_tpu.msg.mesh_bench import run_mesh_path_bench
+
+        sizes = tuple(int(t) for t in args.mesh_sizes.split(",") if t)
+        result = run_mesh_path_bench(
+            n_objects=args.objects, obj_bytes=args.size,
+            writers=args.writers, iters=max(1, args.iterations),
+            mesh_sizes=sizes or (1, 2, 4, 8),
+            k=int(profile.get("k", "2")), m=int(profile.get("m", "2")),
+        )
+        if args.profile:
+            print(json.dumps({
+                "workload": "mesh-path",
+                "k": result["k"], "m": result["m"],
+                "mesh_sizes": result["mesh_sizes"],
+                "bit_exact": result["bit_exact"],
+                "steady_jit_retraces": result["steady_jit_retraces"],
+                "wire_bytes_avoided": result["wire_bytes_avoided"],
+                "wire_bytes_sent": result["wire_bytes_sent"],
+            }))
+            return 1 if result["steady_jit_retraces"] else 0
+        print(json.dumps(result))
+        print(
+            f"mesh-path k={result['k']} m={result['m']} "
+            f"{args.objects}x{args.size}B over TCP: speedup vs mesh_1 "
+            f"{result['speedup_vs_mesh1']}, wire bytes avoided "
+            f"{result['wire_bytes_avoided']}, encode GiB/s "
+            f"{result['encode_GiBs']}", file=sys.stderr,
+        )
+        return 1 if result["steady_jit_retraces"] else 0
 
     k = int(profile.get("k", "0"))
     m = int(profile.get("m", "0"))
