@@ -600,6 +600,25 @@ def main() -> int:
 
     mp_host = _staged("mesh_path_host", _mesh_path_host)
 
+    def _trace_path_host():
+        """Round-16 observability gate: the storage-path + cluster-path
+        workload under trace_mode off / sampled / full
+        (ceph_tpu/osd/trace_bench.py).  Correctness-gated: one write's
+        trace must stitch client -> primary -> sub-writes with the
+        batch_encode fan-in span and timeline segments summing to the
+        measured end-to-end; slow-op detection must fire; zero
+        unfinished spans after quiesce; and sampled-mode overhead must
+        stay within 3% of tracing-off (retried against noise) or the
+        stage FAILS."""
+        from ceph_tpu.osd.trace_bench import run_trace_overhead_bench
+
+        return run_trace_overhead_bench(
+            cpu_ec, n_objects=48, obj_bytes=16 << 10, writers=8, iters=2,
+            overhead_limit_pct=3.0,
+        )
+
+    tr_host = _staged("trace_path_host", _trace_path_host)
+
     def _lint_stage():
         """Static-health trend metrics: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json) as
@@ -716,6 +735,15 @@ def main() -> int:
         "mesh_path_steady_jit_retraces": (
             mp_host["steady_jit_retraces"] if mp_host else None),
         "mesh_path_host": mp_host,
+        # observability gate (round 16): leaving sampled tracing ON must
+        # cost nothing measurable, and the forensics lane must fire
+        "trace_overhead_pct_sampled": (
+            tr_host["trace_overhead_pct_sampled"] if tr_host else None),
+        "trace_overhead_pct_full": (
+            tr_host["trace_overhead_pct_full"] if tr_host else None),
+        "slow_ops_detected": (
+            tr_host["slow_ops_detected"] if tr_host else None),
+        "trace_path_host": tr_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
             lint_stage["by_rule"] if lint_stage else None),
@@ -772,7 +800,11 @@ def main() -> int:
         f"{fo_host['thrash_p99_ms'] if fo_host else '?'}ms, mesh-path "
         f"{mp_host['speedup_max'] if mp_host else '?'}x at max mesh "
         f"(wire avoided "
-        f"{mp_host['wire_bytes_avoided'] if mp_host else '?'}) on "
+        f"{mp_host['wire_bytes_avoided'] if mp_host else '?'}), trace "
+        f"sampled overhead "
+        f"{tr_host['trace_overhead_pct_sampled'] if tr_host else '?'}% "
+        f"({tr_host['slow_ops_detected'] if tr_host else '?'} slow ops "
+        f"detected) on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

@@ -495,8 +495,15 @@ def check_trailing_compat(ctx: FileContext) -> Iterator[Finding]:
     if not ctx.imports_module("ceph_tpu.utils.encoding"):
         return
 
+    # honor only REAL comment tokens: a `wire-optional` spelling quoted
+    # inside a docstring/fixture string is prose, not a declaration
+    # (the round-12 section-marker gotcha, same fix)
+    from ceph_tpu.analysis.core import _comment_line_numbers
+
+    comment_lines = _comment_line_numbers(ctx.lines)
     opt_lines = [i for i, line in enumerate(ctx.lines, start=1)
-                 if _WIRE_OPTIONAL.search(line)]
+                 if _WIRE_OPTIONAL.search(line)
+                 and (comment_lines is None or i in comment_lines)]
 
     def suffix_check(items: Optional[List[Item]], what: str
                      ) -> Iterator[Finding]:

@@ -280,9 +280,35 @@ async def serve(args) -> None:
             "ops", lambda cmd: shard.optracker.dump_ops_in_flight()
         )
         asok.register(
+            "dump_ops_in_flight",
+            lambda cmd: shard.optracker.dump_ops_in_flight(),
+        )
+        asok.register(
             "dump_historic_ops",
             lambda cmd: shard.optracker.dump_historic_ops(),
         )
+        asok.register(
+            "dump_historic_slow_ops",
+            lambda cmd: shard.optracker.dump_historic_slow_ops(),
+        )
+
+        def _trace_status(cmd):
+            from ceph_tpu.utils import trace
+
+            return dict(trace.status(), name=name)
+
+        def _trace_dump(cmd):
+            from ceph_tpu.utils import trace
+
+            tid = cmd.get("trace_id")
+            if tid is not None:
+                return trace.dump_trace(int(tid))
+            if cmd.get("slow"):
+                return trace.dump_slow(cmd.get("count"))
+            return trace.dump()
+
+        asok.register("trace status", _trace_status)
+        asok.register("trace dump", _trace_dump)
         asok.register(
             "config show", lambda cmd: get_config().show_config()
         )

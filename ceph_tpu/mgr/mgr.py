@@ -256,6 +256,42 @@ def prometheus_text(state: dict) -> str:
             ]
     except Exception:  # noqa: BLE001 -- exposition must never fail
         pass
+    # observability plane (utils/trace.py + optracker): slow ops per
+    # daemon, trace collector health, and every registered
+    # PerfHistogram as REAL prometheus histogram series
+    # (_bucket/_sum/_count over the latency marginal) -- the per-stage
+    # queue-wait / dispatch / wire-rtt / ack-lag / tier hit-vs-miss
+    # attribution ROADMAP items 2-3 read
+    lines += ["# HELP ceph_osd_slow_ops ops slower than "
+              "osd_op_complaint_time (slow-op forensics)",
+              "# TYPE ceph_osd_slow_ops counter"]
+    for name, s in sorted(state["osd_stats"].items()):
+        lines.append(f'ceph_osd_slow_ops{{ceph_daemon="{name}"}} '
+                     f"{s['perf'].get('slow_ops', 0)}")
+    try:
+        from ceph_tpu.utils import trace as _trace
+        from ceph_tpu.utils.perf import histograms_prometheus_text
+
+        ts = _trace.status()
+        lines += [
+            "# HELP ceph_trace_spans_finished finished trace spans "
+            "collected (bounded ring)",
+            "# TYPE ceph_trace_spans_finished counter",
+            f"ceph_trace_spans_finished {ts['finished']}",
+            "# HELP ceph_trace_spans_dropped finished spans dropped "
+            "past the trace_keep ring bound",
+            "# TYPE ceph_trace_spans_dropped counter",
+            f"ceph_trace_spans_dropped {ts['dropped']}",
+            "# HELP ceph_trace_spans_unfinished started-but-unfinished "
+            "spans right now (a leak detector: quiesced == 0)",
+            "# TYPE ceph_trace_spans_unfinished gauge",
+            f"ceph_trace_spans_unfinished {ts['unfinished']}",
+        ]
+        hist_text = histograms_prometheus_text()
+        if hist_text:
+            lines.append(hist_text)
+    except Exception:  # noqa: BLE001 -- exposition must never fail
+        pass
     lines += ["# HELP ceph_pool_objects logical objects in the pool",
               "# TYPE ceph_pool_objects gauge",
               f"ceph_pool_objects {state['pools']['num_objects']}",

@@ -63,6 +63,7 @@ from __future__ import annotations
 import asyncio
 import os
 import struct
+import time as _time
 from collections import deque
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional, \
     Tuple
@@ -120,13 +121,16 @@ class _QueuedMsg:
     per connection), so frames seal at (re)transmit time by EXTENDING
     the cached crc over the tail instead of re-digesting the payload."""
 
-    __slots__ = ("seq", "parts", "crc", "nbytes")
+    __slots__ = ("seq", "parts", "crc", "nbytes", "t_enq")
 
     def __init__(self, seq: int, parts: List):
         self.seq = seq
         self.parts = parts
         self.crc: Optional[int] = None
         self.nbytes = sum(len(p) for p in parts)
+        #: enqueue stamp: ack-lag (enqueue -> delivery-ack prune) feeds
+        #: the per-node ack_lag latency histogram (observability)
+        self.t_enq = _time.monotonic()
 
 
 class _SendSession:
@@ -325,6 +329,11 @@ class TCPMessenger:
             "bytes_sent": 0, "acks_piggybacked": 0, "acks_standalone": 0,
             "acks_elided": 0, "acks_piggybacked_recv": 0,
         }
+        #: ack-lag attribution (observability): enqueue -> delivery-ack
+        #: latency per pruned message, a prometheus histogram family
+        from ceph_tpu.utils.perf import stage_histogram
+
+        self._h_ack_lag = stage_histogram(f"{node}.ack_lag_usec")
         #: per-process instance id (the Pipe connect nonce): receive
         #: state is keyed by it, so a restarted peer's fresh stream
         #: never collides with its predecessor's sequence watermark
@@ -585,7 +594,7 @@ class TCPMessenger:
                 if back_ack:
                     sess = self._sessions.get(peer_node)
                     if sess is not None:
-                        sess.prune(back_ack)
+                        self._prune_acked(sess, back_ack)
                     self.counters["acks_piggybacked_recv"] += 1
             if seq:
                 # lossless stream (in order per TCP connection).  A dst
@@ -832,7 +841,7 @@ class TCPMessenger:
         dec = Decoder(self._unseal(rec, skey))
         if dec.u8() != _K_SESSION:
             raise OSError(f"{node}: bad session reply")
-        sess.prune(dec.varint())  # peer already delivered these
+        self._prune_acked(sess, dec.varint())  # peer delivered these
         async with lock:
             # re-snapshot until stable: a send that lands while the
             # drain below is awaiting appends to sess.sent and is
@@ -867,7 +876,7 @@ class TCPMessenger:
                 if dec.u8() == _K_ACK:
                     sess = self._sessions.get(node)
                     if sess is not None:
-                        sess.prune(dec.varint())
+                        self._prune_acked(sess, dec.varint())
             if self._conns.get(node) is conn:
                 self._drop_conn(node)
             else:
@@ -907,6 +916,18 @@ class TCPMessenger:
             f"reconnect.{node}",
             asyncio.get_event_loop().create_task(reconnect_loop()),
         )
+
+    def _prune_acked(self, sess: _SendSession, acked_seq: int) -> None:
+        """Observe delivery-ack lag (enqueue -> cumulative-ack arrival)
+        for every entry this ack releases, then prune the unacked
+        queue -- the "ack" leg of the op timeline at the wire layer."""
+        target = max(sess.acked, acked_seq)
+        now = _time.monotonic()
+        for entry in sess.sent:
+            if entry.seq > target:
+                break
+            self._h_ack_lag.inc((now - entry.t_enq) * 1e6, entry.nbytes)
+        sess.prune(acked_seq)
 
     # -- frame assembly (zero-copy seal/frame at transmit time) ------------
 

@@ -88,6 +88,8 @@ def message_encoder(msg: object) -> Encoder:
         enc.value(msg.prev_version)
         enc.value(tuple(msg.reqid) if isinstance(
             msg.reqid, (tuple, list)) else msg.reqid)
+        enc.value(list(msg.trace) if isinstance(
+            msg.trace, (tuple, list)) else msg.trace)
     elif isinstance(msg, ECSubWriteReply):
         enc.u8(_MSG_EC_SUB_WRITE_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -102,6 +104,8 @@ def message_encoder(msg: object) -> Encoder:
         enc.value(list(msg.attrs_to_read))
         enc.value({k: [tuple(x) for x in v] for k, v in msg.subchunks.items()})
         enc.string(msg.op_class)
+        enc.value(list(msg.trace) if isinstance(
+            msg.trace, (tuple, list)) else msg.trace)
     elif isinstance(msg, ECSubReadReply):
         enc.u8(_MSG_EC_SUB_READ_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -140,6 +144,10 @@ def decode_message(data: bytes) -> object:
             prev_version=dec.value(),
             # cephlint: wire-optional -- pre-reqid senders end here
             reqid=dec.value() if dec.remaining() else None,
+            # cephlint: wire-optional -- pre-trace senders end at the
+            # reqid (and pre-trace DECODERS stop there, cleanly
+            # ignoring this trailing context from newer senders)
+            trace=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_WRITE_REPLY:
         return ECSubWriteReply(
@@ -156,6 +164,8 @@ def decode_message(data: bytes) -> object:
             subchunks={k: [tuple(x) for x in v]
                        for k, v in dec.value().items()},
             op_class=dec.string(),
+            # cephlint: wire-optional -- pre-trace senders end here
+            trace=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_READ_REPLY:
         return ECSubReadReply(

@@ -43,6 +43,7 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, List, Optional, Sequence
 
+from ceph_tpu.utils import trace
 from ceph_tpu.utils.perf import PerfCounters
 
 #: default flush thresholds: a batch larger than this dispatches without
@@ -79,11 +80,21 @@ class BatchCoalescer:
         self.max_batch = max_batch
         self.max_bytes = max_bytes
         self._sem = asyncio.Semaphore(max(1, depth))
-        self._pending: List[tuple] = []  # (item, future)
+        self._pending: List[tuple] = []  # (item, future, nbytes, span)
         self._pending_bytes = 0
         self._flush_scheduled = False
         self.perf = perf
         self._counter = counter
+        #: trace stage name ("encode"/"decode"/...): op spans record
+        #: <stage>_submit/<stage>_done and the shared dispatch becomes
+        #: one batch_<stage> fan-in span (docs/observability.md)
+        self._stage = "encode" if "encode" in counter else (
+            "decode" if "decode" in counter else counter)
+        # precomputed event/span names: the unsampled fast path must
+        # not pay a per-submit f-string
+        self._ev_submit = f"{self._stage}_submit"
+        self._ev_done = f"{self._stage}_done"
+        self._span_name = f"batch_{self._stage}"
 
     # -- submission ---------------------------------------------------------
 
@@ -91,7 +102,12 @@ class BatchCoalescer:
         """Queue one work item; resolves with its dispatch result."""
         loop = asyncio.get_event_loop()
         fut = loop.create_future()
-        self._pending.append((item, fut, nbytes))
+        # batch fan-in tracing: remember the submitting op's span so the
+        # shared dispatch becomes ONE span child of every rider (cheap:
+        # a contextvar read; NULL_SPAN rides as False)
+        span = trace.current()
+        span.event(self._ev_submit)
+        self._pending.append((item, fut, nbytes, span))
         self._pending_bytes += nbytes
         if (
             len(self._pending) >= self.max_batch
@@ -130,26 +146,39 @@ class BatchCoalescer:
 
     async def _run_batch(self, batch: List[tuple]) -> None:
         async with self._sem:
-            items = [item for item, _fut, _nb in batch]
+            items = [item for item, _fut, _nb, _sp in batch]
+            # the shared stage is ONE fan-in span, child of every
+            # sampled rider (amortized_over = batch size); it is also
+            # the task-current span while dispatching, so the dispatch
+            # lane (mesh plane, pipeline) can annotate it
+            fanin = trace.batch_span(
+                self._span_name, [sp for _i, _f, _nb, sp in batch])
             try:
-                results = self._dispatch_many(items)
-                if asyncio.iscoroutine(results):
-                    results = await results
+                with trace.use_span(fanin):
+                    results = self._dispatch_many(items)
+                    if asyncio.iscoroutine(results):
+                        results = await results
             except asyncio.CancelledError:
+                fanin.finish()
                 raise
             except Exception as e:  # noqa: BLE001 -- each waiter gets the
                 # failure; the coalescer itself stays serviceable
-                for _item, fut, _nb in batch:
+                fanin.tag_set("error", type(e).__name__)
+                fanin.finish()
+                for _item, fut, _nb, sp in batch:
+                    sp.event(self._ev_done)
                     if not fut.done():
                         fut.set_exception(
                             type(e)(*e.args) if e.args else IOError(str(e))
                         )
                 return
+            fanin.tag_set("items", len(batch))
+            fanin.finish()
             if self.perf is not None:
                 self.perf.inc(self._counter)
                 self.perf.inc(f"{self._counter}_items", len(batch))
                 self.perf.inc(f"{self._counter}_bytes",
-                              sum(nb for _i, _f, nb in batch))
+                              sum(nb for _i, _f, nb, _sp in batch))
                 if len(batch) > 1:
                     self.perf.inc(f"{self._counter}_batched",
                                   len(batch))
@@ -158,6 +187,7 @@ class BatchCoalescer:
                 # so this is the "how much parallelism did one tick
                 # actually gather" number the mesh bench reads
                 self.perf.hwm(f"{self._counter}_batch_hwm", len(batch))
-            for (_item, fut, _nb), res in zip(batch, results):
+            for (_item, fut, _nb, sp), res in zip(batch, results):
+                sp.event(self._ev_done)
                 if not fut.done():
                     fut.set_result(res)

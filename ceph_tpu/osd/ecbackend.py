@@ -33,6 +33,7 @@ the EC strategy.  The OSD daemon role moved to ``ceph_tpu.osd.shard``.
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -56,7 +57,8 @@ from ceph_tpu.osd.pg import (  # noqa: F401  (compat re-exports)
 )
 from ceph_tpu.osd.shard import OSDShard  # noqa: F401  (compat re-export)
 from ceph_tpu.osd.types import ECSubWrite, LogEntry, Transaction
-from ceph_tpu.utils.perf import PerfCounters
+from ceph_tpu.utils import trace
+from ceph_tpu.utils.perf import PerfCounters, stage_histogram
 
 
 class ECBackend(PG):
@@ -187,8 +189,13 @@ class ECBackend(PG):
         block = ecutil.to_shard_major(self.sinfo, self.k, buf)
         pgid = self._pg_of(oid) if oid else 0
         if self._enc_coalescer is None:
+            # direct (uncoalesced) path: same timeline events as the
+            # coalescer records, batch of one
+            trace.event("encode_submit")
             items = [(block, want_resident, pgid)]
-            return self._encode_dispatch(items)[0]
+            out = self._encode_dispatch(items)[0]
+            trace.event("encode_done")
+            return out
         return await self._enc_coalescer.submit(
             (block, want_resident, pgid), block.nbytes)
 
@@ -196,7 +203,10 @@ class ECBackend(PG):
         """Client-op decode: stripes sharing an erasure signature ride
         one fused reconstruction dispatch."""
         if self._dec_coalescer is None:
-            return ecutil.decode_concat(self.sinfo, self.ec, chunks)
+            trace.event("decode_submit")
+            out = ecutil.decode_concat(self.sinfo, self.ec, chunks)
+            trace.event("decode_done")
+            return out
         nbytes = sum(c.nbytes for c in chunks.values())
         return await self._dec_coalescer.submit(chunks, nbytes)
 
@@ -267,6 +277,17 @@ class ECBackend(PG):
             return data[:ent.logical_size]
         lo = offset - start
         return data[lo:lo + length]
+
+    def _tier_hist(self, which: str):
+        """Lazy tier read-latency observers (hit vs miss), shared per
+        daemon name -- the ``ceph_hist_tier_read_{hit,miss}_usec``
+        prometheus families."""
+        attr = f"_h_tier_{which}"
+        h = getattr(self, attr, None)
+        if h is None:
+            h = stage_histogram(f"{self.name}.tier_read_{which}_usec")
+            setattr(self, attr, h)
+        return h
 
     def _tier_hot(self, oid: str) -> bool:
         if self._hitset_temp is None:
@@ -353,10 +374,6 @@ class ECBackend(PG):
         buf = np.zeros(padded_len, dtype=np.uint8)
         buf[:logical] = np.frombuffer(data, dtype=np.uint8)
 
-        from ceph_tpu.utils import trace
-
-        span = trace.new_trace("ec write")
-        span.event("start_rmw")
         dev_block = None
         if padded_len:
             # decide promote-from-encode BEFORE dispatch so the pipeline
@@ -367,7 +384,6 @@ class ECBackend(PG):
         else:
             # zero-byte object (S3 markers, touch): no stripes to encode
             encoded = [np.zeros(0, dtype=np.uint8) for _ in range(self.km)]
-        span.event("encoded")
         hinfo = ecutil.HashInfo(self.km)
         if padded_len:
             hinfo.append(0, encoded)
@@ -400,8 +416,6 @@ class ECBackend(PG):
             self._pool_stamp(txn, soid)
             if snapset is not None:
                 txn.setattr(soid, SNAPSET_KEY, snapset)
-            with span.child("ec sub write") as sub_span:
-                sub_span.event(f"shard {s} -> osd.{acting[s]}")
             subs.append((f"osd.{acting[s]}", ECSubWrite(
                 from_shard=s,
                 tid=tid,
@@ -420,7 +434,6 @@ class ECBackend(PG):
                 oid, tid, subs, {f"osd.{acting[s]}" for s in up},
                 min_acks=self.k,
             )
-            span.event("all_commit")
             self._snap_committed(oid, snapset, logical)
             if tier_put:
                 self._tier.mark_clean(self.pool_name, oid, version)
@@ -429,8 +442,6 @@ class ECBackend(PG):
                 # the fan-out failed: the device copy is unconfirmed
                 self._tier.invalidate(self.pool_name, oid)
             raise
-        finally:
-            span.finish()
 
     # -- read path ---------------------------------------------------------
 
@@ -443,10 +454,19 @@ class ECBackend(PG):
             # source; write-only recording would never promote a
             # read-hot object)
             self._hitset_record(oid)
+        t0 = time.monotonic()
         cached = self._tier_read(oid)
         if cached is not None:
+            # tier-hit attribution: one D2H + transpose, no fan-out --
+            # the histogram pair the mgr exposes as hit-vs-miss read
+            self._tier_hist("hit").inc(
+                (time.monotonic() - t0) * 1e6, len(cached))
+            trace.event("tier_hit")
             self.perf.inc("read")
             return cached
+        tiered = self.tier_mode in ("writeback", "readproxy")
+        if tiered:
+            trace.event("tier_miss")
         acting = self.acting_set(oid)
         up_shards = [
             s
@@ -469,6 +489,11 @@ class ECBackend(PG):
         if logical_size is None:
             raise IOError(f"no size metadata for {oid}")
         data = await self._decode_op(chunks)
+        if tiered:
+            # miss attribution: the full fan-out + decode the resident
+            # block would have saved
+            self._tier_hist("miss").inc(
+                (time.monotonic() - t0) * 1e6, logical_size)
         self.perf.inc("read")
         return data[:logical_size]
 
@@ -480,11 +505,15 @@ class ECBackend(PG):
         ECBackend.cc:1021-1037 fragmented shard reads)."""
         if self._hitset_record is not None:
             self._hitset_record(oid)
+        t0 = time.monotonic()
         cached = self._tier_read(oid, offset, length)
         if cached is not None:
             # whole-object residency serves any extent without a stat
             # round-trip; the stripe/chunk column selection happened ON
             # DEVICE, so only the covering stripes' bytes crossed the bus
+            self._tier_hist("hit").inc(
+                (time.monotonic() - t0) * 1e6, len(cached))
+            trace.event("tier_hit")
             self.perf.inc("read_range")
             return cached
         size, _ = await self._stat(oid)

@@ -36,6 +36,8 @@ import random
 from typing import Dict, List, Optional
 
 from ceph_tpu.osd.ecbackend import ObjectIncomplete
+from ceph_tpu.utils import trace
+from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf import PerfCounters
 
 #: error type names coming back over the wire -> local exception classes
@@ -113,6 +115,10 @@ class Objecter:
         #: first/only pool (legacy names).
         self.oid_prefix = oid_prefix
         self.perf = PerfCounters(name)
+        #: client-side op tracking: every logical op is a TrackedOp
+        #: whose span (when sampled) roots the cross-daemon trace --
+        #: dump_ops_in_flight/dump_historic_ops work client-side too
+        self.optracker = OpTracker(perf=self.perf, name=name)
         self._tid = 0
         #: reqid incarnation (osd_reqid_t role): (name, inc, tid)
         #: identifies each logical op across any number of resends
@@ -261,6 +267,24 @@ class Objecter:
         conflict_retries = 1
         reqid = self._new_reqid()
         resends = 0
+        # the trace ROOT: the sampling roll happens once, here, and the
+        # decision travels with the op (unsampled ops carry no wire
+        # context and cost nothing downstream)
+        span = trace.new_trace(f"client:{kind}")
+        op = self.optracker.create_request(f"{kind} {oid}", span=span)
+        wire_ctx = span.to_wire() if span else None
+        try:
+            return await self._submit_tracked(
+                kind, oid, fields, loop, deadline, cfg, backoff_base,
+                backoff_max, conflict_retries, reqid, resends, op,
+                wire_ctx)
+        finally:
+            op.finish()
+
+    async def _submit_tracked(self, kind, oid, fields, loop, deadline,
+                              cfg, backoff_base, backoff_max,
+                              conflict_retries, reqid, resends, op,
+                              wire_ctx):
         while True:
             self._tid += 1
             tid = self._tid
@@ -268,10 +292,14 @@ class Objecter:
             self._pending[tid] = fut
             msg = dict(fields, op="client_op", tid=tid, kind=kind, oid=oid,
                        pool=self.pool, reqid=list(reqid))
+            if wire_ctx is not None:
+                msg["trace"] = wire_ctx
             try:
                 primary = self._primary_abs(oid)
                 await self.messenger.send_message(self.name, primary, msg)
+                op.mark_event("sent" if not resends else "resent")
                 reply = await self._await_reply(fut, tid, primary, deadline)
+                op.mark_event("reply_received")
             finally:
                 self._pending.pop(tid, None)
             if reply is None:

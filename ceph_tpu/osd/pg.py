@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import asyncio
 import contextvars
+import time
 from contextlib import asynccontextmanager
 from typing import Dict, List, Optional, Tuple
 
@@ -48,7 +49,8 @@ from ceph_tpu.osd.types import (
     LogEntry,
     Transaction,
 )
-from ceph_tpu.utils.perf import PerfCounters
+from ceph_tpu.utils import trace
+from ceph_tpu.utils.perf import PerfCounters, stage_histogram
 
 SIZE_KEY = "_size"
 #: per-shard object version xattr (the object_info_t version role): every
@@ -268,6 +270,10 @@ class PG:
         #: test swapping shard.hitsets is picked up)
         self._hitset_record = None
         self._hitset_temp = None
+        #: per-stage latency observers (lazy; shared per daemon name via
+        #: perf.stage_histogram): sub-op round-trip wire time, measured
+        #: fan-out-send -> commit-ack arrival on this primary
+        self._h_wire_rtt = None
 
     # -- placement (CRUSH-lite) --------------------------------------------
 
@@ -391,6 +397,17 @@ class PG:
             state = self._pending.get(msg.tid)
             if state is None:
                 return
+            t_sent = state.get("t_sent")
+            if t_sent is not None:
+                # per-sub-op wire round trip (send -> commit-ack here):
+                # the "wire" attribution of the op timeline, exposed as
+                # a prometheus histogram through the mgr module
+                if self._h_wire_rtt is None:
+                    self._h_wire_rtt = stage_histogram(
+                        f"{self.name}.wire_rtt_usec")
+                self._h_wire_rtt.inc(
+                    (time.monotonic() - t_sent) * 1e6,
+                    state.get("nbytes", 0))
             if msg.missed:
                 # the shard skipped an incremental write (missed base):
                 # degrade the fan-out as if it were down — it must not
@@ -623,11 +640,26 @@ class PG:
                 if getattr(sub, "op_class", "client") == "client" and \
                         getattr(sub, "reqid", None) is None:
                     sub.reqid = rid
+        # trace stitching: the in-flight op's wire context rides every
+        # sub-op of its own fan-out (trailing optional field, like the
+        # reqid), so the applying shards' sub-write spans join the
+        # client's trace.  Unsampled ops stamp nothing.
+        wire_ctx = trace.current_wire()
+        if wire_ctx is not None:
+            for _dst, sub in subs:
+                if getattr(sub, "trace", None) is None:
+                    sub.trace = wire_ctx
         done = asyncio.get_event_loop().create_future()
         self._pending[tid] = {
             "committed": set(),
             "expected": set(expected),
             "done": done,
+            "t_sent": time.monotonic(),
+            "nbytes": sum(
+                len(top.data)
+                for _dst, sub in subs
+                for top in sub.transaction.ops
+            ),
         }
         # mesh-local vs wire routing split (osd_mesh_data_plane,
         # ceph_tpu/parallel/mesh_plane.py), chosen per-chunk from CRUSH
@@ -651,7 +683,9 @@ class PG:
         # into a single scatter-gather burst (one writev + one drain per
         # peer instead of one per sub-op)
         await self.messenger.send_messages(self.name, subs)
+        trace.event("fanout_sent")
         await self._await_commits(oid, tid, done, min_acks=min_acks)
+        trace.event("commit")
 
     # -- shard read plumbing -----------------------------------------------
 
@@ -672,7 +706,10 @@ class PG:
             "done": done,
         }
         # multi-destination submit: the sub-read fan-out corks per peer
-        # exactly like the write fan-out
+        # exactly like the write fan-out.  The in-flight op's trace
+        # context rides each sub-read so the serving shards' spans
+        # stitch into the same trace.
+        wire_ctx = trace.current_wire()
         await self.messenger.send_messages(self.name, [
             (f"osd.{acting[s]}", ECSubRead(
                 from_shard=s,
@@ -680,9 +717,11 @@ class PG:
                 to_read={oid: list(extents) if extents else [(0, -1)]},
                 attrs_to_read=[oid],
                 op_class=op_class,
+                trace=wire_ctx,
             ))
             for s in shards
         ])
+        trace.event("gather_sent")
         try:
             # config-driven (osd_op_thread_timeout role): give revived
             # stragglers the headroom the client op budget already allows
@@ -692,6 +731,7 @@ class PG:
                 get_config().get_val("osd_read_gather_timeout")))
         except asyncio.TimeoutError:
             pass  # missing shards handled by the caller
+        trace.event("gather_done")
         state = self._pending.pop(tid)
         return state["replies"]
 

@@ -57,6 +57,7 @@ from ceph_tpu.osd import ecutil
 from ceph_tpu.osd.pg import (SIZE_KEY, SNAPSET_KEY, VERSION_KEY,
                              WHITEOUT_KEY, shard_oid, vt)
 from ceph_tpu.osd.types import ECSubRead, ECSubWrite, Transaction
+from ceph_tpu.utils import trace
 
 #: queued client ops above which background batches back off (the
 #: saturation signal; _cop_sem bounds execution at 64, so half a
@@ -145,6 +146,7 @@ async def batched_sub_reads(
     per message.  Returns one ECSubReadReply (or None on loss/timeout)
     per entry, in order."""
     loop = asyncio.get_event_loop()
+    wire_ctx = trace.current_wire()  # stitch into the batch span
     pend = []
     subs = []
     for osd_name, s, to_read, attrs in reads:
@@ -158,6 +160,7 @@ async def batched_sub_reads(
             from_shard=s, tid=tid,
             to_read={oid: list(ext) for oid, ext in to_read.items()},
             attrs_to_read=list(attrs), op_class=op_class,
+            trace=wire_ctx,
         )))
     await backend.messenger.send_messages(backend.name, subs)
     if pend:
@@ -181,8 +184,11 @@ async def batched_pushes(
     """Ship every (target osd, sub-write) as ONE corked multi-submit
     burst; returns per-push commit success, in order."""
     loop = asyncio.get_event_loop()
+    wire_ctx = trace.current_wire()  # stitch into the batch span
     pend = []
     for target, _sub in pushes:
+        if wire_ctx is not None and getattr(_sub, "trace", None) is None:
+            _sub.trace = wire_ctx
         done = loop.create_future()
         backend._pending[_sub.tid] = {
             "committed": set(), "expected": {target}, "done": done,
@@ -283,10 +289,23 @@ class RecoveryCoalescer:
         from contextlib import AsyncExitStack
 
         backend = self.backend
-        async with AsyncExitStack() as stack:
-            for oid in sorted(group):
-                await stack.enter_async_context(backend._object_lock(oid))
-            return await self._recover_batch_locked(group)
+        # one batch span for the whole multi-read/decode/multi-push
+        # cycle (background root: rolls its own sampling decision);
+        # amortized over the batch's objects like a coalescer fan-in
+        span = trace.new_trace("recovery_batch")
+        if span.sampled:
+            span.amortized_over = max(1, len(group))
+            span.tag_set("objects", len(group))
+        try:
+            with trace.use_span(span):
+                async with AsyncExitStack() as stack:
+                    for oid in sorted(group):
+                        await stack.enter_async_context(
+                            backend._object_lock(oid))
+                    span.event("locks_acquired")
+                    return await self._recover_batch_locked(group)
+        finally:
+            span.finish()
 
     async def _recover_batch_locked(self,
                                     group: Dict[str, List[tuple]]) -> set:
@@ -336,6 +355,7 @@ class RecoveryCoalescer:
         timeout = float(cfg.get_val("osd_read_gather_timeout"))
         replies = await batched_sub_reads(
             backend, read_list, "recovery", timeout)
+        trace.event("gather_done")
 
         # collate per (oid, shard): chunks / versions / sizes / attrs
         per_oid: Dict[str, dict] = {
@@ -408,7 +428,9 @@ class RecoveryCoalescer:
                 wants.append(rebuild)
                 ready.append(oid)
         if maps:
+            trace.event("decode_submit")
             decoded = ecutil.decode_shards_many(backend.ec, maps, wants)
+            trace.event("decode_done")
         else:
             decoded = []
 
@@ -438,6 +460,7 @@ class RecoveryCoalescer:
                 full[oid] = {}
         commit_t = float(cfg.get_val("osd_client_op_commit_timeout"))
         results = await batched_pushes(backend, pushes, commit_t)
+        trace.event("push_done")
 
         ok_oids: set = set()
         bad_oids: set = set()

@@ -14,6 +14,7 @@ reference's OSD vs PG/PGBackend layering (src/osd/PGBackend.cc:533).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Dict
 
 from ceph_tpu.osd import ecutil
@@ -37,7 +38,8 @@ from ceph_tpu.osd.types import (
     Transaction,
 )
 from ceph_tpu.native.gf_native import crc32c
-from ceph_tpu.utils.perf import PerfCounters
+from ceph_tpu.utils import trace
+from ceph_tpu.utils.perf import PerfCounters, stage_histogram
 
 #: client-op kinds subject to reqid dup detection: every kind that
 #: mutates state (re-executing a replay would double-apply or return a
@@ -101,7 +103,7 @@ class OSDShard:
         #: OSD-side meta_apply fan-out acks (CAS replication authority)
         self._meta_tid = 0
         self._meta_pending: Dict[int, tuple] = {}
-        self.optracker = OpTracker()
+        self.optracker = OpTracker(perf=self.perf, name=self.name)
         #: peer name -> last heartbeat pong time (handle_osd_ping role)
         self.hb_pongs: Dict[str, float] = {}
         #: entity -> OSDCap; entities absent here run with the open
@@ -117,6 +119,13 @@ class OSDShard:
             HistogramAxis("latency_usec", 0, 64, 32, "log2"),
             HistogramAxis("size_bytes", 0, 512, 24, "log2"),
         )
+        # per-stage latency attribution (docs/observability.md): these
+        # feed the prometheus _bucket/_sum/_count series the mgr module
+        # exposes, and mirror the trace-span segments
+        self.h_queue_wait = stage_histogram(
+            f"osd.{osd_id}.op_queue_wait_usec")
+        self.h_dispatch = stage_histogram(
+            f"osd.{osd_id}.op_dispatch_usec")
         # object-access temperature tracking (src/osd/HitSet.h; feeds
         # the tiering-agent role and the admin-socket hit_set commands)
         from ceph_tpu.osd.hitset import HitSetTracker
@@ -520,6 +529,11 @@ class OSDShard:
                     # so queued bytes stay under the daemon's cap
                     claim()
                 cost = max(1, len(msg.get("data") or b"") // 4096)
+                # queue-entry stamp only (no allocation): the TrackedOp
+                # and its span are minted at dequeue BACKDATED to this
+                # stamp, so queue wait is attributed per op without a
+                # tracker object per queued message
+                msg["_queued_mono"] = time.monotonic()
                 if self.op_queue_type == "mclock":
                     self.opq.enqueue(
                         "client", cost, (src, msg),
@@ -551,6 +565,8 @@ class OSDShard:
         if isinstance(msg, (ECSubWrite, ECSubRead)):
             klass = getattr(msg, "op_class", "client")
             cost = self._op_cost(msg)
+            # queue-entry stamp (see the client-op path above)
+            msg._queued_mono = time.monotonic()
             if self.op_queue_type == "mclock":
                 self.opq.enqueue(
                     klass, cost, (src, msg), asyncio.get_event_loop().time()
@@ -687,7 +703,13 @@ class OSDShard:
                         self.store.stat(so)
                     except FileNotFoundError:
                         continue
-                    shards[s] = tuple(vt(self.store.getattr(so, VERSION_KEY)))
+                    # string key: the wire encoder (utils/encoding
+                    # value()) rejects int dict keys, so an int here
+                    # crashed every delta-peering probe REPLY on the
+                    # real-TCP path (in-process delivery hid it); the
+                    # consumer int()s the key either way
+                    shards[str(s)] = tuple(
+                        vt(self.store.getattr(so, VERSION_KEY)))
                     if pool_tag is None:
                         pool_tag = self.store.getattr(so, POOL_KEY)
                 mv = None
@@ -1015,17 +1037,33 @@ class OSDShard:
             self.messenger.adopt_task(f"{self.name}.cop{self._cop_seq}", task)
             return
         kind = "sub_write" if isinstance(msg, ECSubWrite) else "sub_read"
+        t_exec = time.monotonic()
+        cost_bytes = self._op_cost(msg) * 4096
+        qat = getattr(msg, "_queued_mono", None)
+        # the span joins the originating op's trace (trailing wire
+        # field) so the cross-daemon timeline stitches client ->
+        # primary -> sub-op; the op backdates to queue entry
         op = self.optracker.create_request(
-            f"{kind}(tid={msg.tid} oid={next(iter(msg.to_read), '?') if isinstance(msg, ECSubRead) else msg.oid} shard={msg.from_shard})"
+            f"{kind}(tid={msg.tid} oid={next(iter(msg.to_read), '?') if isinstance(msg, ECSubRead) else msg.oid} shard={msg.from_shard})",
+            span=trace.join(getattr(msg, "trace", None),
+                            f"{self.name}:{kind}", t0=qat),
+            t0=qat,
         )
+        # queue wait = enqueue stamp -> here
+        self.h_queue_wait.inc(
+            (t_exec - (qat if qat is not None else t_exec)) * 1e6,
+            cost_bytes)
         op.mark_event("dequeued")
         try:
-            if isinstance(msg, ECSubWrite):
-                await self.handle_sub_write(src, msg)
-            else:
-                await self.handle_sub_read(src, msg)
+            with trace.use_span(op.span):
+                if isinstance(msg, ECSubWrite):
+                    await self.handle_sub_write(src, msg)
+                else:
+                    await self.handle_sub_read(src, msg)
             op.mark_event("replied")
         finally:
+            self.h_dispatch.inc(
+                (time.monotonic() - t_exec) * 1e6, cost_bytes)
             op.finish()
 
     async def _run_client_op(self, src: str, msg: dict) -> None:
@@ -1034,18 +1072,39 @@ class OSDShard:
         Reference: the osd_op_tp worker calling PrimaryLogPG::do_request
         -> do_op -> execute_ctx, with the MOSDOpReply back to the client
         (src/osd/OSD.cc:9072, src/osd/PrimaryLogPG.cc:1649)."""
+        t_exec = time.monotonic()
+        qat = msg.pop("_queued_mono", None)
+        # the op backdates to its queue-entry stamp; its span (when the
+        # client's trace context rode the op) starts there too, so the
+        # timeline's first segment is the true queue wait
         op = self.optracker.create_request(
-            f"client_op({msg.get('kind')} oid={msg.get('oid')} from={src})"
+            f"client_op({msg.get('kind')} oid={msg.get('oid')} "
+            f"from={src})",
+            span=trace.join(msg.get("trace"), f"osd:{msg.get('kind')}",
+                            t0=qat),
+            t0=qat,
         )
+        self.h_queue_wait.inc(
+            (t_exec - (qat if qat is not None else t_exec)) * 1e6,
+            len(msg.get("data") or b""))
+        op.mark_event("dequeued")
         reply = {"op": "client_reply", "tid": msg["tid"]}
         try:
-            await self._run_client_op_inner(src, msg, op, reply)
+            # the op span is task-current for the whole execution: the
+            # engine's fan-outs stamp it onto sub-ops and the coalescer
+            # links its batch fan-in span to it
+            with trace.use_span(op.span):
+                await self._run_client_op_inner(src, msg, op, reply)
         finally:
+            self.h_dispatch.inc(
+                (time.monotonic() - t_exec) * 1e6,
+                len(msg.get("data") or b""))
             release = msg.pop("_budget_release", None)
             if release is not None:
                 release()  # claimed messenger dispatch-throttle budget
             if msg.pop("_client_gauge", None):
                 self._client_ops_queued -= 1
+            op.finish()
 
     async def _run_client_op_inner(self, src: str, msg: dict, op,
                                    reply: dict) -> None:

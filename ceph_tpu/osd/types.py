@@ -120,6 +120,12 @@ class ECSubWrite:
     #: failover is answered from the log instead of re-executed.  None
     #: for recovery/scrub pushes and legacy senders.
     reqid: object = None
+    #: originating op's trace context ``[trace_id, parent_span_id]``
+    #: (utils/trace.py): the applying shard's sub-write span joins the
+    #: client op's trace so one op stitches client -> primary ->
+    #: sub-write across daemons.  None for unsampled ops and pre-trace
+    #: senders (trailing optional wire field, msg/wire.py).
+    trace: object = None
 
 
 @dataclasses.dataclass
@@ -154,6 +160,9 @@ class ECSubRead:
     )
     #: QoS class for the OSD op queue ("client" | "recovery" | "scrub")
     op_class: str = "client"
+    #: originating op's trace context (see ECSubWrite.trace); trailing
+    #: optional wire field, None for unsampled ops / pre-trace senders
+    trace: object = None
 
 
 @dataclasses.dataclass

@@ -520,6 +520,14 @@ class MeshDataPlane:
         jerasure oracle (gated in tests/test_mesh_plane.py)."""
         codec = self._codec(ec)
         k, m = codec.k, codec.m
+        # trace attribution: the coalescer's fan-in span is task-current
+        # during dispatch -- mark which lane the shared stage took so a
+        # slow op's timeline says "mesh SPMD" vs "single-device"
+        from ceph_tpu.utils import trace as _trace
+
+        _trace.tag("lane", "mesh_spmd" if slot is None
+                   else f"mesh_primary_slot_{slot}")
+        _trace.tag("mesh_devices", self.n_devices)
         if pgids is None:
             pgids = list(range(len(blocks)))
         out: List[Optional[Dict[int, np.ndarray]]] = [None] * len(blocks)
@@ -615,6 +623,10 @@ class MeshDataPlane:
         through the sliced plane (the read-path coalescer's dispatch)."""
         from ceph_tpu.osd import ecutil
 
+        from ceph_tpu.utils import trace as _trace
+
+        _trace.tag("lane", "mesh_spmd" if slot is None
+                   else f"mesh_primary_slot_{slot}")
         results: List[bytes] = [b""] * len(maps)
         need = [i for i, cm in enumerate(maps)
                 if cm and len(next(iter(cm.values()))) > 0]

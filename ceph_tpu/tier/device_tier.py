@@ -25,6 +25,7 @@ evicted -- the agent flushes them instead (`TierAgent.tick`).
 from __future__ import annotations
 
 import threading
+import time
 from collections import OrderedDict
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -248,6 +249,12 @@ class DeviceTierStore:
             block = _to_device(block)
         elif resident_origin and self.perf is not None:
             self.perf.inc("tier_promote_from_encode")
+        # timeline attribution: a traced op that paid (or saved) a tier
+        # insert on its path shows it as a named event
+        from ceph_tpu.utils import trace
+
+        trace.event("tier_put_resident" if resident_origin
+                    else "tier_put")
         ent = self._insert(pool, oid, block, version, logical_size, dirty,
                            mesh_slice=mesh_slice)
         self.evict_to_budget()
@@ -286,7 +293,10 @@ class DeviceTierStore:
                 continue
             groups.setdefault(blk.shape[0], []).append(it)
         from ceph_tpu.analysis.residency import resident_section
+        from ceph_tpu.utils import trace
+        from ceph_tpu.utils.perf import stage_histogram
 
+        t0 = time.monotonic()
         n = 0
         for grp in groups.values():
             big = np.concatenate(
@@ -309,6 +319,13 @@ class DeviceTierStore:
                     n += 1
             # cephlint: end-device-resident-section
         if n:
+            # the batched promote is a shared stage too: one histogram
+            # observation for the whole transfer (latency x bytes), and
+            # an event on whatever span drove the tick
+            stage_histogram("tier.promote_usec").inc(
+                (time.monotonic() - t0) * 1e6,
+                sum(g[2].nbytes for grp in groups.values() for g in grp))
+            trace.event("tier_promote_batch")
             self.evict_to_budget()
         return n
 

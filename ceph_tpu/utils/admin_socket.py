@@ -30,7 +30,10 @@ class AdminSocket:
         self.register("help", lambda cmd: sorted(self._hooks))
 
     def register(self, prefix: str, hook: Callable[[dict], object]) -> None:
-        """AdminSocket::register_command; hook(cmd_dict) -> JSON-able."""
+        """AdminSocket::register_command; hook(cmd_dict) -> JSON-able
+        (or an awaitable of one -- async hooks are awaited in the serve
+        loop, so introspection commands may take the daemon's locks
+        through the normal async surface)."""
         self._hooks[prefix] = hook
 
     async def start(self) -> str:
@@ -73,6 +76,9 @@ class AdminSocket:
             else:
                 try:
                     out = hook(cmd)
+                    if asyncio.iscoroutine(out) or \
+                            isinstance(out, asyncio.Future):
+                        out = await out
                 except Exception as e:  # noqa: BLE001 -- a hook crash
                     out = {"error": f"{type(e).__name__}: {e}"}
             writer.write(json.dumps(out).encode() + b"\n")

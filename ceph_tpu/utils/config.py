@@ -178,6 +178,40 @@ OPTIONS: Dict[str, Option] = {
              "max concurrent object recoveries per OSD"),
         _opt("osd_tick_interval", float, 5.0, LEVEL_ADVANCED,
              "seconds between OSD background ticks (peering/scrub)"),
+        _opt("trace_mode", str, "sampled", LEVEL_ADVANCED,
+             "end-to-end op tracing (utils/trace.py): 'off' mints no "
+             "spans; 'sampled' traces one in trace_sample_every root "
+             "ops (the default: forensics always on at negligible "
+             "cost, gated by the bench tracing stage); 'full' traces "
+             "every op.  The sampling decision travels with the wire "
+             "context, so a trace is always whole",
+             see_also=("trace_sample_every", "trace_keep")),
+        _opt("trace_sample_every", int, 64, LEVEL_ADVANCED,
+             "in sampled trace_mode, one of this many root ops is "
+             "traced (client ops and background batches roll "
+             "independently)",
+             see_also=("trace_mode",)),
+        _opt("trace_keep", int, 256, LEVEL_ADVANCED,
+             "finished spans retained in the bounded collector ring "
+             "(oldest dropped and counted; the seed's unbounded "
+             "_finished list is gone)",
+             see_also=("trace_keep_slow",)),
+        _opt("trace_keep_slow", int, 64, LEVEL_ADVANCED,
+             "slowest finished root spans retained past ring churn "
+             "(the optracker historic-slow discipline)",
+             see_also=("trace_keep",)),
+        _opt("osd_op_complaint_time", float, 5.0, LEVEL_ADVANCED,
+             "an op slower than this logs a slow-op warning with its "
+             "full decomposed timeline and is retained by "
+             "dump_historic_slow_ops (reference "
+             "osd_op_complaint_time, 30s; shrunk to the mini-cluster "
+             "time scale)"),
+        _opt("osd_op_history_size", int, 20, LEVEL_ADVANCED,
+             "completed TrackedOps retained per daemon for "
+             "dump_historic_ops (reference osd_op_history_size)"),
+        _opt("osd_op_history_slow_size", int, 20, LEVEL_ADVANCED,
+             "slowest completed TrackedOps retained per daemon "
+             "(reference osd_op_history_slow_op_size)"),
         _opt("lockdep", bool, False, LEVEL_DEV,
              "track lock acquisition order and raise on cycles "
              "(reference src/common/lockdep.h; asyncio-lock analogue)"),

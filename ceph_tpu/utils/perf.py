@@ -112,13 +112,25 @@ class HistogramAxis:
         if self.scale == "linear":
             b = 1 + int(off // self.quant)
         else:
-            b = 1
-            span = self.quant
-            while off >= span and b < self.buckets - 1:
-                off -= span
-                span *= 2
-                b += 1
+            # closed form of the doubling walk (b doublings cover
+            # quant*(2^b - 1)): O(1) -- this runs on every data-path
+            # latency observation, a Python loop here was measurable
+            b = (int(off) // self.quant + 1).bit_length()
         return min(b, self.buckets - 1)
+
+    def upper_bounds(self) -> list:
+        """Inclusive upper bound of every bucket but the last (whose
+        bound is +Inf) -- the prometheus ``le`` values this axis maps
+        onto.  Bucket 0 is the underflow bucket (< min)."""
+        if self.scale == "linear":
+            return [self.min + self.quant * b
+                    for b in range(self.buckets - 1)]
+        out = [self.min]
+        acc = 0
+        for b in range(1, self.buckets - 1):
+            acc += self.quant * (2 ** (b - 1))
+            out.append(self.min + acc)
+        return out
 
     def to_dict(self) -> dict:
         return {"name": self.name, "min": self.min, "quant_size": self.quant,
@@ -138,6 +150,10 @@ class PerfHistogram:
         self.y = y
         self._lock = threading.Lock()
         self._values = [0] * (x.buckets * y.buckets)
+        #: running sum of raw x observations (the prometheus ``_sum``
+        #: series; the grid alone only preserves bucketed counts)
+        self._x_sum = 0.0
+        self._count = 0
         with PerfCounters._collection_lock:
             PerfHistogram._collection[name] = self
 
@@ -146,13 +162,40 @@ class PerfHistogram:
         by = self.y.bucket_for(y_value)
         with self._lock:
             self._values[bx * self.y.buckets + by] += amount
+            self._x_sum += x_value * amount
+            self._count += amount
 
     def snapshot(self) -> dict:
         with self._lock:
             return {
                 "axes": [self.x.to_dict(), self.y.to_dict()],
                 "values": list(self._values),
+                "x_sum": self._x_sum,
+                "count": self._count,
             }
+
+    def x_marginal(self) -> list:
+        """Per-x-bucket counts summed over the y axis (the 1-D latency
+        distribution a prometheus histogram series exposes)."""
+        with self._lock:
+            vals = list(self._values)
+        yb = self.y.buckets
+        return [sum(vals[bx * yb:(bx + 1) * yb])
+                for bx in range(self.x.buckets)]
+
+    @classmethod
+    def get_or_create(cls, name: str, x_factory, y_factory
+                      ) -> "PerfHistogram":
+        """Idempotent registration: per-stage latency observers share
+        one histogram per (daemon, stage) name no matter which engine
+        touches it first."""
+        with PerfCounters._collection_lock:
+            h = cls._collection.get(name)
+        if h is not None:
+            return h
+        cls(name, x_factory(), y_factory())
+        with PerfCounters._collection_lock:
+            return cls._collection[name]
 
     @classmethod
     def dump(cls) -> str:
@@ -162,3 +205,60 @@ class PerfHistogram:
                 {name: h.snapshot() for name, h in cls._collection.items()},
                 indent=2, sort_keys=True,
             )
+
+
+def stage_histogram(name: str) -> PerfHistogram:
+    """The shared per-stage latency observer: a latency(usec, log2) x
+    size(bytes, log2) grid under ``name`` (one per daemon per stage --
+    queue-wait, dispatch, wire-rtt, ack-lag, tier hit/miss read), the
+    PerfHistogram the prometheus module exposes as real
+    ``_bucket``/``_sum``/``_count`` series."""
+    return PerfHistogram.get_or_create(
+        name,
+        lambda: HistogramAxis("latency_usec", 0, 64, 32, "log2"),
+        lambda: HistogramAxis("size_bytes", 0, 512, 24, "log2"),
+    )
+
+
+def histograms_prometheus_text() -> str:
+    """Every registered PerfHistogram as prometheus histogram series:
+    cumulative ``_bucket{le=...}`` over the x (latency) marginal, plus
+    ``_sum`` (raw x sum) and ``_count``.  Instances named
+    ``<daemon>.<stage>`` (daemon like ``osd.0`` / ``client``) share one
+    metric family per stage with a ``ceph_daemon`` label."""
+    with PerfCounters._collection_lock:
+        hists = list(PerfHistogram._collection.items())
+    families: Dict[str, list] = {}
+    for name, h in sorted(hists):
+        parts = name.split(".")
+        if len(parts) >= 3 and parts[0] == "osd" and parts[1].isdigit():
+            daemon, family = f"{parts[0]}.{parts[1]}", ".".join(parts[2:])
+        elif len(parts) >= 2:
+            daemon, family = parts[0], ".".join(parts[1:])
+        else:
+            daemon, family = "", name
+        metric = "ceph_hist_" + "".join(
+            c if c.isalnum() else "_" for c in family)
+        families.setdefault(metric, []).append((daemon, h))
+    lines = []
+    for metric in sorted(families):
+        lines.append(f"# HELP {metric} per-stage latency histogram "
+                     "(PerfHistogram x-axis marginal; le in the axis "
+                     "unit)")
+        lines.append(f"# TYPE {metric} histogram")
+        for daemon, h in families[metric]:
+            label = f'{{ceph_daemon="{daemon}",le=' if daemon \
+                else "{le="
+            marginal = h.x_marginal()
+            bounds = h.x.upper_bounds()
+            cum = 0
+            for ub, count in zip(bounds, marginal):
+                cum += count
+                lines.append(f'{metric}_bucket{label}"{ub}"}} {cum}')
+            cum += sum(marginal[len(bounds):])
+            lines.append(f'{metric}_bucket{label}"+Inf"}} {cum}')
+            snap = h.snapshot()
+            tail = f'{{ceph_daemon="{daemon}"}}' if daemon else ""
+            lines.append(f"{metric}_sum{tail} {snap['x_sum']}")
+            lines.append(f"{metric}_count{tail} {snap['count']}")
+    return "\n".join(lines)

@@ -1,0 +1,93 @@
+"""trace-span-unfinished fixture: spans/TrackedOps must reach
+finish() on every CFG path, ride a `with` block, or escape (ownership
+transfer).  Annotated lines are the rule's exact expected findings."""
+
+import asyncio
+
+from ceph_tpu.utils import trace
+from ceph_tpu.utils.optracker import OpTracker
+
+tracker = OpTracker()
+
+
+def leak_no_finish():
+    span = trace.new_trace("op")  # LINT: trace-span-unfinished
+    span.event("work")
+    return 1
+
+
+def leak_early_return(flag):
+    span = trace.new_trace("op")  # LINT: trace-span-unfinished
+    if flag:
+        return None  # this path leaves the span open
+    span.finish()
+    return flag
+
+
+def leak_one_branch_only(flag):
+    op = tracker.create_request("op")  # LINT: trace-span-unfinished
+    if flag:
+        op.finish()
+
+
+async def leak_across_await():
+    span = trace.new_trace("op")  # LINT: trace-span-unfinished
+    await asyncio.sleep(0)
+    span.event("woke")
+
+
+def ok_try_finally():
+    span = trace.new_trace("op")
+    try:
+        span.event("work")
+    finally:
+        span.finish()
+
+
+def ok_with_expression():
+    with trace.new_trace("op") as span:
+        span.event("work")
+
+
+def ok_with_variable():
+    span = trace.new_trace("op")
+    with span:
+        span.event("work")
+
+
+def ok_every_branch_finishes(flag):
+    span = trace.new_trace("op")
+    if flag:
+        span.event("fast")
+        span.finish()
+        return 1
+    span.finish()
+    return 0
+
+
+def ok_ownership_passed(sink):
+    span = trace.new_trace("op")
+    sink(span)  # the receiver finishes it (create_request(span=...))
+
+
+def ok_ownership_returned():
+    span = trace.new_trace("op")
+    return span
+
+
+def ok_ownership_stored(holder):
+    span = trace.new_trace("op")
+    holder.span = span  # stored: the holder's lifecycle closes it
+
+
+def ok_batch_span(parents):
+    fanin = trace.batch_span("batch_encode", parents)
+    try:
+        fanin.tag_set("items", len(parents))
+    finally:
+        fanin.finish()
+
+
+def ok_tracked_op_escapes():
+    op = tracker.create_request("op")
+    return op

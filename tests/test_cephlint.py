@@ -56,6 +56,9 @@ FIXTURES = {
     "async_background_unthrottled.py": None,
     "async_atomic_section.py": None,
     "wire_symmetry.py": None,
+    # PR-16 observability: started spans must reach finish() on every
+    # CFG path (or escape / ride a `with` block)
+    "trace_span_unfinished.py": None,
     "suppressions.py": None,
 }
 

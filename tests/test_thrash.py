@@ -285,9 +285,12 @@ def test_thrash_rebuild_under_load():
 
 
 def test_trace_spans():
+    """One traced write stitches client -> primary -> k+m sub-writes
+    plus the batch_encode fan-in span (the round-16 span model)."""
     from ceph_tpu.utils import trace
 
     trace.enable(True)
+    trace.clear()
     try:
 
         async def main():
@@ -302,12 +305,20 @@ def test_trace_spans():
 
         asyncio.new_event_loop().run_until_complete(main())
         spans = trace.dump()
-        names = [s["name"] for s in spans]
-        assert "ec write" in names
-        assert names.count("ec sub write") == 6
-        root = next(s for s in spans if s["name"] == "ec write")
-        kids = [s for s in spans if s["parent_id"] == root["span_id"]]
-        assert len(kids) == 6
-        assert "encoded" in root["events"] and "all_commit" in root["events"]
+        root = next(s for s in spans if s["name"] == "client:write")
+        tid = root["trace_id"]
+        fam = [s for s in spans if s["trace_id"] == tid]
+        primary = next(s for s in fam if s["name"] == "osd:write")
+        assert primary["parent_id"] == root["span_id"]
+        subs = [s for s in fam if s["name"].endswith(":sub_write")]
+        assert len(subs) == 6  # one per placed shard, all stitched
+        assert all(s["parent_id"] == primary["span_id"] for s in subs)
+        # the shared encode dispatch is ONE fan-in span, child of the
+        # op span, amortized over the batch
+        enc = next(s for s in fam if s["name"] == "batch_encode")
+        assert primary["span_id"] in enc["parent_ids"]
+        assert enc["amortized_over"] >= 1
+        assert "fanout_sent" in primary["events"]
+        assert "commit" in primary["events"]
     finally:
         trace.enable(False)

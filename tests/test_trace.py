@@ -1,0 +1,462 @@
+"""Round-16 observability subsystem: sampled bounded spans with batch
+fan-in, wire-context stitching (client -> primary -> sub-ops), the
+optracker's slow-op forensics, PerfHistogram prometheus exposition,
+and the tracing-overhead bench gate.
+
+The acceptance test (`test_single_write_stitched_trace_decomposes`)
+drives ONE client write on a mesh-enabled, tier-enabled cluster and
+requires the stitched cross-daemon trace to decompose into queue-wait /
+batch-encode (amortized) / wire / ack segments that sum to the op's
+measured end-to-end latency, with ``dump_historic_ops`` returning the
+same op."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import re
+
+import pytest
+
+from ceph_tpu.utils import trace
+from ceph_tpu.utils.config import get_config
+from ceph_tpu.utils.perf import PerfCounters
+
+
+@pytest.fixture
+def trace_full():
+    """Full tracing for one test; restores knobs and clears state."""
+    cfg = get_config()
+    prior = {k: cfg.get_val(k)
+             for k in ("trace_mode", "trace_sample_every", "trace_keep",
+                       "trace_keep_slow")}
+    trace.configure(mode="full")
+    trace.clear()
+    try:
+        yield
+    finally:
+        for k, v in prior.items():
+            cfg.set_val(k, v)
+        trace.configure()
+        trace.clear()
+
+
+def _run(coro):
+    asyncio.new_event_loop().run_until_complete(coro)
+
+
+# -- collector bounds (the seed unbounded-growth bug, fixed) ----------------
+
+
+def test_collector_is_bounded_with_slow_retention(trace_full):
+    trace.configure(keep=32, keep_slow=4)
+    for i in range(500):
+        span = trace.new_trace(f"op{i}")
+        span.finish()
+    spans = trace.dump()
+    assert len(spans) <= 32, "the finished ring must stay bounded"
+    st = trace.status()
+    assert st["finished"] == 500
+    assert st["dropped"] == 500 - 32
+    assert len(trace.dump_slow()) <= 4
+    # slowest-retention: a deliberately slow root survives ring churn
+    slow = trace.new_trace("slowpoke")
+    slow.start -= 10.0  # backdate: 10s duration
+    slow.finish()
+    for i in range(100):
+        trace.new_trace(f"churn{i}").finish()
+    assert any(s["name"] == "slowpoke" for s in trace.dump_slow()), \
+        "the slowest root must survive ring churn"
+
+
+def test_sampling_mints_one_in_n(trace_full):
+    trace.configure(mode="sampled", sample_every=8)
+    real = sum(1 for _ in range(80)
+               if trace.new_trace("s").sampled)
+    assert real == 10  # deterministic modulo, not a coin flip
+    for s in trace.dump():
+        s  # finished list only holds sampled spans
+    # off mode mints nothing and the null span costs no state
+    trace.configure(mode="off")
+    n0 = trace.status()["finished"]
+    for _ in range(50):
+        sp = trace.new_trace("x")
+        assert not sp.sampled
+        sp.event("e")
+        sp.finish()
+    assert trace.status()["finished"] == n0
+
+
+def test_batch_fanin_span_amortizes_over_parents(trace_full):
+    parents = [trace.new_trace(f"op{i}") for i in range(4)]
+    fanin = trace.batch_span("batch_encode", parents)
+    assert fanin.sampled
+    assert fanin.amortized_over == 4
+    assert {p.span_id for p in parents} == set(fanin.parent_ids)
+    fanin.start -= 0.4  # pretend the shared stage took 400ms
+    fanin.finish()
+    shares = []
+    for p in parents:
+        assert p.tags["fanin:batch_encode"] == fanin.span_id
+        p.event("encode_submit", t=p.start)
+        p.event("encode_done")
+        p.finish()
+        tl = trace.op_timeline(p)
+        seg = next(s for s in tl["segments"]
+                   if s["segment"] == "batch_encode")
+        assert seg["batch_n"] == 4
+        shares.append(seg["amortized_share_ms"])
+        # segments still sum exactly to the op's total
+        assert sum(s["ms"] for s in tl["segments"]) == \
+            pytest.approx(tl["total_ms"], rel=1e-6, abs=1e-6)
+    # shares are capped by each op's own interval (no double-timing:
+    # an op never claims more of the stage than it waited for it)
+    for p, share in zip(parents, shares):
+        assert share <= p.duration * 1000 + 1e-6
+    # a batch of only unsampled parents records nothing (and the null
+    # result needs no finish -- NULL_SPAN is stateless)
+    assert not trace.batch_span("batch_encode",
+                                [trace.NULL_SPAN] * 3).sampled
+
+
+def test_unfinished_span_accounting(trace_full):
+    span = trace.new_trace("leaky")
+    assert trace.unfinished_count() == 1
+    assert "leaky" in trace.unfinished_names()
+    span.finish()
+    assert trace.unfinished_count() == 0
+    span.finish()  # idempotent: no double-collect
+    assert trace.status()["finished"] == 1
+
+
+# -- wire compat (trailing optional field, reqid-style) ---------------------
+
+
+def _sub_write(trace_ctx):
+    from ceph_tpu.osd.types import ECSubWrite, Transaction
+
+    return ECSubWrite(
+        from_shard=2, tid=7, oid="obj", at_version=(3, "osd.0"),
+        transaction=Transaction().write("obj@2", 0, b"abc"),
+        reqid=("client", 1, 9), trace=trace_ctx,
+    )
+
+
+def test_wire_trace_context_roundtrips_v4():
+    from ceph_tpu.msg.wire import decode_message, encode_message
+    from ceph_tpu.osd.types import ECSubRead
+
+    out = decode_message(encode_message(_sub_write([123, 456])))
+    assert out.trace == [123, 456]
+    assert tuple(out.reqid) == ("client", 1, 9)
+    # absent context decodes as None (unsampled op, same v4 peers)
+    assert decode_message(encode_message(_sub_write(None))).trace is None
+    rd = ECSubRead(from_shard=1, tid=3, to_read={"o": [(0, -1)]},
+                   attrs_to_read=["o"], trace=[11, 22])
+    back = decode_message(encode_message(rd))
+    assert back.trace == [11, 22]
+    assert back.op_class == "client"
+
+
+def test_pre_trace_decoder_cleanly_ignores_trailing_context():
+    """A pre-trace decoder stops at the reqid field: every field it
+    reads must parse identically and the trailing context is simply
+    unread bytes (the declared wire-optional compat contract)."""
+    from ceph_tpu.msg.wire import decode_transaction, message_encoder
+    from ceph_tpu.utils.encoding import Decoder
+
+    body = message_encoder(_sub_write([9, 10])).bytes()
+    dec = Decoder(body)
+    assert dec.u8() == 1  # _MSG_EC_SUB_WRITE
+    assert dec.varint() == 2          # from_shard
+    assert dec.varint() == 7          # tid
+    assert dec.string() == "obj"      # oid
+    decode_transaction(dec)
+    assert tuple(dec.value()) == (3, "osd.0")   # at_version
+    assert dec.varint() == 0          # log entries
+    assert dec.string() == "client"   # op_class
+    assert dec.value() is False       # rollback
+    assert dec.value() is None        # prev_version
+    assert tuple(dec.value()) == ("client", 1, 9)  # reqid (guarded)
+    # ... and a pre-trace decoder ends HERE, trailing bytes unread
+    assert dec.remaining() > 0
+
+
+def test_pre_trace_sender_decodes_with_none_context():
+    """A sender that predates the trace field (encoder truncated at the
+    reqid) must decode cleanly with trace=None."""
+    from ceph_tpu.msg.wire import (decode_message, encode_transaction,
+                                   message_encoder)
+    from ceph_tpu.utils.encoding import Encoder
+
+    msg = _sub_write(None)
+    enc = Encoder()
+    enc.u8(1)
+    enc.varint(msg.from_shard).varint(msg.tid).string(msg.oid)
+    encode_transaction(enc, msg.transaction)
+    enc.value(tuple(msg.at_version))
+    enc.varint(0)
+    enc.string(msg.op_class)
+    enc.value(msg.rollback)
+    enc.value(msg.prev_version)
+    enc.value(tuple(msg.reqid))  # pre-trace wire form ends here
+    out = decode_message(enc.bytes())
+    assert out.trace is None
+    assert tuple(out.reqid) == ("client", 1, 9)
+    assert out.oid == "obj"
+    # sanity: the current encoder's form is strictly longer
+    assert len(message_encoder(msg).bytes()) > len(enc.bytes())
+
+
+# -- the acceptance gate: one stitched, decomposed cross-daemon trace -------
+
+
+def test_single_write_stitched_trace_decomposes(trace_full):
+    """One client write on a mesh-enabled, tier-enabled cluster: the
+    trace stitches client -> primary -> sub-writes (+ the batch_encode
+    fan-in span on the mesh lane), the primary op timeline decomposes
+    into queue-wait / batch-encode(amortized) / wire / ack segments
+    summing to the measured end-to-end, and dump_historic_ops returns
+    the very op."""
+    from ceph_tpu.osd.cluster import ECCluster
+    from ceph_tpu.parallel import mesh_plane
+
+    cfg = get_config()
+    prior_mesh = cfg.get_val("osd_mesh_data_plane")
+    cfg.set_val("osd_mesh_data_plane", True)
+    found = {}
+
+    async def main():
+        PerfCounters.reset_all()
+        mesh_plane.configure(4)
+        cluster = ECCluster(
+            6, {"k": "4", "m": "2", "technique": "reed_sol_van"},
+            plugin="tpu")
+        cluster.set_tier_mode("writeback")
+        try:
+            await cluster.write("stitched", b"t" * 20000)
+            primary = cluster.backend.primary_of("stitched")
+            found["historic"] = cluster.osds[
+                int(primary.split(".")[1])
+            ].optracker.dump_historic_ops()
+        finally:
+            await cluster.shutdown()
+
+    _run(main())
+    spans = trace.dump()
+    root = next(s for s in spans if s["name"] == "client:write")
+    fam = [s for s in spans if s["trace_id"] == root["trace_id"]]
+    primary = next(s for s in fam if s["name"] == "osd:write")
+    assert primary["parent_id"] == root["span_id"]
+    subs = [s for s in fam if s["name"].endswith(":sub_write")]
+    assert len(subs) == 6 and len({s["name"] for s in subs}) == 6
+    assert all(s["parent_id"] == primary["span_id"] for s in subs)
+    # the shared encode stage: one fan-in span, mesh lane attributed
+    enc = next(s for s in fam if s["name"] == "batch_encode")
+    assert primary["span_id"] in enc["parent_ids"]
+    assert str(enc["tags"].get("lane", "")).startswith("mesh"), \
+        "mesh-enabled dispatch must attribute its lane"
+    # timeline decomposition: the canonical segments, summing exactly
+    tl = trace.op_timeline(primary["span_id"])
+    names = [s["segment"] for s in tl["segments"]]
+    for want in ("queue_wait", "batch_encode", "wire_commit", "ack"):
+        assert want in names, f"{want} missing from {names}"
+    seg_sum = sum(s["ms"] for s in tl["segments"])
+    assert seg_sum == pytest.approx(tl["total_ms"], rel=0.02, abs=0.5)
+    enc_seg = next(s for s in tl["segments"]
+                   if s["segment"] == "batch_encode")
+    assert "amortized_share_ms" in enc_seg
+    assert enc_seg["amortized_share_ms"] + \
+        enc_seg["batch_wait_ms"] == pytest.approx(enc_seg["ms"],
+                                                  rel=1e-6, abs=1e-6)
+    # dump_historic_ops returns the same op, timeline attached
+    ops = found["historic"]["ops"]
+    mine = [o for o in ops
+            if o.get("trace_id") == root["trace_id"]]
+    assert mine, "dump_historic_ops must return the traced op"
+    assert mine[0]["timeline"]["segments"]
+    # quiesced cluster leaves no unfinished spans behind
+    assert trace.unfinished_count() == 0
+    cfg.set_val("osd_mesh_data_plane", prior_mesh)
+    mesh_plane.reset()
+
+
+# -- torn-burst replay: stitching survives, no duplicate spans --------------
+
+
+def test_torn_burst_replay_no_duplicate_spans(trace_full):
+    """Kill the primary's peer connection mid-fan-out-burst: reconnect
+    + replay must deliver every sub-write exactly once, so the trace
+    still stitches with EXACTLY one sub-write span per shard."""
+    from ceph_tpu.msg.cluster_bench import ClusterHarness
+    from ceph_tpu.plugins import registry as registry_mod
+
+    ec = registry_mod.instance().factory(
+        "jerasure", {"k": "4", "m": "2", "technique": "reed_sol_van"})
+
+    async def main():
+        PerfCounters.reset_all()
+        h = ClusterHarness(ec, 6, cork=True, pool="tornpool")
+        await h.start()
+        try:
+            # warm connections so the kill tears an ESTABLISHED stream
+            await h.objecter.write("warm", b"w" * 8192)
+            primary = h.objecter.primary_of("torn")
+            pm = h.messengers[int(primary.split(".")[1])]
+            # one-shot: the next outbound burst dies mid-write
+            pm.fault.schedule_conn_kill(2)
+            await h.objecter.write("torn", b"t" * 16384)
+            assert await h.objecter.read("torn") == b"t" * 16384
+        finally:
+            await h.shutdown()
+
+    _run(main())
+    spans = trace.dump()
+    roots = [s for s in spans if s["name"] == "client:write"]
+    torn_root = roots[-1]  # the second (torn) write
+    fam = [s for s in spans if s["trace_id"] == torn_root["trace_id"]]
+    subs = [s for s in fam if s["name"].endswith(":sub_write")]
+    # exactly one span per shard daemon: the replayed frames were
+    # deduped at the watermark before dispatch, so no double spans
+    assert len(subs) == len({s["name"] for s in subs}) == 6, \
+        [s["name"] for s in subs]
+    primary_span = next(s for s in fam if s["name"] == "osd:write")
+    assert all(s["parent_id"] == primary_span["span_id"] for s in subs)
+
+
+# -- slow-op forensics ------------------------------------------------------
+
+
+def test_slow_op_detection_logs_decomposed_timeline(trace_full, caplog):
+    from ceph_tpu.osd.cluster import ECCluster
+
+    cfg = get_config()
+    prior = cfg.get_val("osd_op_complaint_time")
+    cfg.set_val("osd_op_complaint_time", 1e-6)
+    state = {}
+
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(
+            6, {"k": "4", "m": "2", "technique": "reed_sol_van"})
+        try:
+            with caplog.at_level(logging.WARNING,
+                                 logger="ceph_tpu.optracker"):
+                await cluster.write("sluggish", b"s" * 8192)
+                await cluster.read("sluggish")
+            state["slow"] = sum(o.optracker.slow_ops
+                                for o in cluster.osds)
+            state["dump"] = [o.optracker.dump_historic_slow_ops()
+                             for o in cluster.osds]
+            state["perf"] = {o.name: o.perf.snapshot()
+                             for o in cluster.osds}
+        finally:
+            cfg.set_val("osd_op_complaint_time", prior)
+            await cluster.shutdown()
+
+    _run(main())
+    assert state["slow"] > 0
+    assert any("slow op" in r.message for r in caplog.records)
+    assert any("=" in r.message and "ms" in r.message
+               for r in caplog.records), \
+        "the warning must carry the decomposed timeline"
+    returned = [op for d in state["dump"] for op in d["ops"]]
+    assert returned
+    assert any(op.get("timeline", {}).get("segments")
+               for op in returned)
+    assert any(s.get("slow_ops", 0) > 0 for s in state["perf"].values())
+
+
+# -- PerfHistogram -> prometheus exposition ---------------------------------
+
+
+def test_histogram_prometheus_scrape_parse_roundtrip():
+    from ceph_tpu.utils.perf import (PerfHistogram, histograms_prometheus_text,
+                                     stage_histogram)
+
+    PerfCounters.reset_all()
+    h = stage_histogram("osd.9.op_queue_wait_usec")
+    assert stage_histogram("osd.9.op_queue_wait_usec") is h
+    observed = [10, 100, 1000, 50_000, 2_000_000, 2_000_000]
+    for v in observed:
+        h.inc(v, 4096)
+    text = histograms_prometheus_text()
+    fam = "ceph_hist_op_queue_wait_usec"
+    # scrape-parse: cumulative buckets, ascending le, +Inf == count
+    buckets = re.findall(
+        rf'{fam}_bucket{{ceph_daemon="osd\.9",le="([^"]+)"}} (\d+)',
+        text)
+    assert buckets and buckets[-1][0] == "+Inf"
+    les = [float("inf") if le == "+Inf" else float(le)
+           for le, _n in buckets]
+    counts = [int(n) for _le, n in buckets]
+    assert les == sorted(les)
+    assert counts == sorted(counts), "bucket series must be cumulative"
+    assert counts[-1] == len(observed)
+    # every observation lands in the first bucket whose le covers it
+    for v in observed:
+        idx = next(i for i, le in enumerate(les) if v <= le)
+        assert counts[idx] >= 1
+    m = re.search(rf'{fam}_sum{{ceph_daemon="osd\.9"}} ([0-9.e+]+)',
+                  text)
+    assert m and float(m.group(1)) == pytest.approx(sum(observed))
+    m = re.search(rf'{fam}_count{{ceph_daemon="osd\.9"}} (\d+)', text)
+    assert m and int(m.group(1)) == len(observed)
+    # mgr module surfaces the same families + trace health
+    from ceph_tpu.utils.perf import PerfHistogram as PH  # noqa: F401
+
+
+def test_mgr_metrics_expose_histograms_and_trace_health(trace_full):
+    from ceph_tpu.mgr.mgr import prometheus_text
+    from ceph_tpu.osd.cluster import ECCluster
+
+    state = {}
+
+    async def main():
+        PerfCounters.reset_all()
+        cluster = ECCluster(
+            4, {"k": "2", "m": "2", "technique": "reed_sol_van"})
+        try:
+            await cluster.write("metric", b"m" * 4096)
+            await cluster.read("metric")
+            state["text"] = prometheus_text(
+                __import__("ceph_tpu.mgr.mgr",
+                           fromlist=["ClusterState"]).ClusterState(
+                    cluster).dump())
+        finally:
+            await cluster.shutdown()
+
+    _run(main())
+    text = state["text"]
+    assert "ceph_trace_spans_finished" in text
+    assert "ceph_trace_spans_unfinished 0" in text
+    assert "ceph_osd_slow_ops" in text
+    for fam in ("ceph_hist_op_queue_wait_usec",
+                "ceph_hist_op_dispatch_usec",
+                "ceph_hist_wire_rtt_usec"):
+        assert f"{fam}_bucket" in text, fam
+        assert f"{fam}_count" in text, fam
+    # TYPE lines declare real histograms
+    assert re.search(r"# TYPE ceph_hist_\w+ histogram", text)
+
+
+# -- the bench stage (loose gate: tier-1 smoke, not the 3% artifact) --------
+
+
+def test_trace_overhead_bench_smoke():
+    from ceph_tpu.osd.trace_bench import run_trace_overhead_bench
+    from ceph_tpu.plugins import registry as registry_mod
+
+    ec = registry_mod.instance().factory(
+        "jerasure", {"k": "4", "m": "2", "technique": "reed_sol_van"})
+    result = run_trace_overhead_bench(
+        ec, n_objects=8, obj_bytes=4096, writers=4, iters=1,
+        overhead_limit_pct=100.0)
+    assert result["slow_ops_detected"] > 0
+    assert result["unfinished_spans"] == 0
+    assert result["stitched"]["sub_writes"] == 6
+    assert result["stitched"]["timeline_segment_sum_ms"] == \
+        pytest.approx(result["stitched"]["timeline_total_ms"],
+                      rel=0.05, abs=0.5)
+    assert "trace_overhead_pct_sampled" in result
+    assert result["modes"]["off"]["cluster_wall_s"] > 0

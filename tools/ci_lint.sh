@@ -35,6 +35,13 @@ if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
         -P k=4 -P m=2 --objects 16 --size 4096 --writers 4 \
         --iterations 2 --profile
     echo "cephlint: storage-path transfer smoke passed" >&2
+    # traced-op smoke (round 16): one traced op end to end — fails on
+    # unfinished spans, a broken client->primary->sub-write stitch,
+    # missing slow-op detection, or gross tracing overhead (bench.py
+    # runs the real 3% gate; this catches leaks/regressions in CI)
+    JAX_PLATFORMS=cpu python -m ceph_tpu.osd.trace_bench --smoke \
+        > /dev/null
+    echo "cephlint: traced-op observability smoke passed" >&2
     # multichip dryrun on simulated devices: jax_num_cpu_devices where
     # the jax supports it, the XLA_FLAGS device-count override otherwise
     JAX_PLATFORMS=cpu \

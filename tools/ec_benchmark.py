@@ -39,7 +39,7 @@ def parse_args(argv):
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
-                            "recovery-path", "mesh-path"])
+                            "recovery-path", "mesh-path", "trace-path"])
     p.add_argument("--mesh-sizes", default="1,2,4,8",
                    help="mesh-path only: comma-separated mesh device "
                         "counts to sweep")
@@ -301,6 +301,34 @@ def main(argv=None) -> int:
             f"{result['batched']['client_p99_ms']}ms during rebuild, "
             f"{result['batched']['counters']['recovery_ops_batched']} "
             f"objects through the batched lane",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "trace-path":
+        # Observability stage (round 16): the same storage-path +
+        # cluster-path workload under trace_mode off/sampled/full,
+        # correctness-gated (stitched cross-daemon trace, timeline
+        # segments summing to end-to-end, slow-op detection, zero
+        # unfinished spans) and FAILING if sampled-mode overhead
+        # exceeds the gate.  Prints one JSON line (the shape bench.py
+        # records as trace_path_host_*).
+        import json
+
+        from ceph_tpu.osd.trace_bench import run_trace_overhead_bench
+
+        result = run_trace_overhead_bench(
+            ec, n_objects=args.objects, obj_bytes=args.size,
+            writers=args.writers, iters=max(1, args.iterations),
+        )
+        print(json.dumps(result))
+        print(
+            f"trace-path {args.objects}x{args.size}B x{args.writers} "
+            f"writers: sampled overhead "
+            f"{result['trace_overhead_pct_sampled']}% / full "
+            f"{result['trace_overhead_pct_full']}% vs off, "
+            f"{result['stitched']['spans']} spans stitched, "
+            f"{result['slow_ops_detected']} slow ops detected",
             file=sys.stderr,
         )
         return 0

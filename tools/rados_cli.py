@@ -17,6 +17,8 @@ Usage:
   rados_cli.py --dir RUN df
   rados_cli.py --dir RUN tier status
   rados_cli.py --dir RUN recovery status
+  rados_cli.py --dir RUN ops [in-flight|historic|slow]
+  rados_cli.py --dir RUN trace [status|<trace_id>]
   rados_cli.py --dir RUN setomapval <obj> <key> <value>
   rados_cli.py --dir RUN listomapvals <obj>
 """
@@ -117,6 +119,77 @@ async def _run(args) -> int:
                   f"dirty {dirty}")
         if not found:
             print("no daemons with a recovery admin socket",
+                  file=sys.stderr)
+            return 1
+        return 0
+    if args.cmd == "ops":
+        # slow-op forensics across the cluster (admin-socket union):
+        # in-flight / historic / slow TrackedOps with their decomposed
+        # per-stage timelines (docs/observability.md workflow)
+        which = args.args[0] if args.args else "in-flight"
+        prefix = {"in-flight": "dump_ops_in_flight",
+                  "historic": "dump_historic_ops",
+                  "slow": "dump_historic_slow_ops"}.get(which)
+        if prefix is None:
+            print(f"unknown ops view {which!r} "
+                  "(in-flight|historic|slow)", file=sys.stderr)
+            return 1
+        found = False
+        for sock in _asoks(args.dir):
+            st = await admin_command(sock, prefix)
+            if "error" in st:
+                continue
+            found = True
+            daemon = os.path.basename(sock).rsplit(".asok", 1)[0]
+            print(f"{daemon}\t{st['num_ops']} ops")
+            for op_d in st["ops"]:
+                age = op_d.get("age", 0.0)
+                line = f"  {op_d['description']}\tage {age:.3f}s"
+                if op_d.get("trace_id"):
+                    line += f"\ttrace {op_d['trace_id']}"
+                print(line)
+                tl = op_d.get("timeline")
+                if tl:
+                    segs = "  ".join(
+                        f"{s['segment']}={s['ms']:.2f}ms"
+                        + (f" (share {s['amortized_share_ms']:.2f}ms"
+                           f" of {s.get('batch_n', 1)})"
+                           if "amortized_share_ms" in s else "")
+                        for s in tl.get("segments", []))
+                    print(f"    {segs}")
+        if not found:
+            print("no daemons with an ops admin socket", file=sys.stderr)
+            return 1
+        return 0
+    if args.cmd == "trace":
+        # trace collector status / one stitched trace across daemons
+        want = args.args[0] if args.args else "status"
+        found = False
+        for sock in _asoks(args.dir):
+            if want == "status":
+                st = await admin_command(sock, "trace status")
+                if "error" in st:
+                    continue
+                found = True
+                print(f"{st['name']}\tmode {st['mode']} "
+                      f"(1/{st['sample_every']})\t"
+                      f"finished {st['finished']} "
+                      f"dropped {st['dropped']} "
+                      f"unfinished {st['unfinished']}")
+            else:
+                spans = await admin_command(
+                    sock, "trace dump", trace_id=int(want))
+                if isinstance(spans, dict) and "error" in spans:
+                    continue
+                found = True
+                for s in spans:
+                    dur = s.get("duration_ms")
+                    print(f"{s['span_id']}\t{s['name']}\t"
+                          f"parent {s['parent_id']}\t"
+                          f"{dur if dur is None else round(dur, 3)}ms\t"
+                          f"x{s.get('amortized_over', 1)}")
+        if not found:
+            print("no daemons with a trace admin socket",
                   file=sys.stderr)
             return 1
         return 0
