@@ -619,6 +619,25 @@ def main() -> int:
 
     tr_host = _staged("trace_path_host", _trace_path_host)
 
+    def _qos_path_host():
+        """Round-17 tentpole metric: the million-client-direction scale
+        harness + unified QoS admission (ceph_tpu/loadgen/ +
+        osd/qos_bench.py).  Three real-TCP sub-stages, every number
+        correctness-gated inside the harness: (1) overload -- a gold
+        class's dmClock reservation must hold within 10% against a 10x
+        bulk weight storm with execution slots scarce; (2) chaos --
+        thrash TCP kills + a mid-run OSD wipe + tier promotion under
+        mixed RGW/RBD/CephFS/transactional load, exactly-once audit
+        exact; (3) scale -- >= 1000 concurrent hub-multiplexed
+        Objecters at saturation with background rebuild, per-class
+        fairness spread and saturation p99 as the headline numbers, no
+        closed-loop client left at zero ops."""
+        from ceph_tpu.osd.qos_bench import run_qos_path_bench
+
+        return run_qos_path_bench(smoke=False)
+
+    qp_host = _staged("qos_path_host", _qos_path_host)
+
     def _lint_stage():
         """Static-health trend metrics: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json) as
@@ -744,6 +763,20 @@ def main() -> int:
         "slow_ops_detected": (
             tr_host["slow_ops_detected"] if tr_host else None),
         "trace_path_host": tr_host,
+        # unified QoS + scale harness (round 17): fairness as a
+        # first-class metric, gated on reservation floors, exactly-once
+        # under thrash, and the 1000-client real-TCP saturation run
+        "qos_path_clients": (
+            qp_host["qos_path_clients"] if qp_host else None),
+        "qos_path_saturation_p99_ms": (
+            qp_host["qos_path_saturation_p99_ms"] if qp_host else None),
+        "qos_path_fairness_spread_max": (
+            qp_host["qos_path_fairness_spread_max"] if qp_host else None),
+        "qos_path_reservation_ratio": (
+            qp_host["qos_path_reservation_ratio"] if qp_host else None),
+        "qos_path_cas_exact": (
+            qp_host["qos_path_cas_exact"] if qp_host else None),
+        "qos_path_host": qp_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
             lint_stage["by_rule"] if lint_stage else None),
@@ -804,7 +837,11 @@ def main() -> int:
         f"sampled overhead "
         f"{tr_host['trace_overhead_pct_sampled'] if tr_host else '?'}% "
         f"({tr_host['slow_ops_detected'] if tr_host else '?'} slow ops "
-        f"detected) on "
+        f"detected), qos-path "
+        f"{qp_host['qos_path_clients'] if qp_host else '?'} clients at "
+        f"p99 {qp_host['qos_path_saturation_p99_ms'] if qp_host else '?'}"
+        f"ms (reservation ratio "
+        f"{qp_host['qos_path_reservation_ratio'] if qp_host else '?'}) on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

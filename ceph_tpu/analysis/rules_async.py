@@ -10,7 +10,7 @@ mechanically-detectable face of that bug class.
 from __future__ import annotations
 
 import ast
-from typing import Iterator, Set
+from typing import Iterator, Optional, Set
 
 from ceph_tpu.analysis.core import (SEV_ERROR, SEV_WARNING, FileContext,
                                     Finding, call_attr, call_name,
@@ -298,6 +298,126 @@ def check_unbounded_retry(ctx: FileContext) -> Iterator[Finding]:
                 "deadline check (fail the op when the budget is spent) "
                 "and an awaited, ideally jittered-exponential, delay "
                 "between attempts",
+            )
+
+
+#: iterable names that mark a per-client/per-op scale collection --
+#: the million-client rule (substring match on the last dotted part):
+#: fanning one coroutine/task per element of one of these without a
+#: budget admit is exactly how a scale harness OOMs itself
+_FANOUT_COLLECTION_MARKS = (
+    "client", "conn", "session", "objecter", "peer", "request",
+    "waiter", "op_list", "ops", "oids",
+)
+#: budget evidence: an awaited acquire/admit (semaphore, throttle,
+#: QoS admission) or a Semaphore/budget construction in the function
+_FANOUT_BUDGET_ATTRS = {"acquire", "admit", "slot", "get"}
+
+
+def _fanout_collection_name(node: ast.expr) -> Optional[str]:
+    """The iterated collection's name when it looks like an unbounded
+    client/op set (``self.clients``, ``conns``, ...); None for
+    literals, ``range(...)`` worker pools and unmarked names."""
+    from ceph_tpu.analysis.core import dotted_name
+
+    if isinstance(node, ast.Call):
+        return None  # range(n)/sorted(...) worker-pool shapes
+    name = dotted_name(node).rsplit(".", 1)[-1].lower()
+    if not name:
+        return None
+    for mark in _FANOUT_COLLECTION_MARKS:
+        if mark in name:
+            return name
+    return None
+
+
+def _has_budget_evidence(fn: ast.AST, ctx: FileContext, holder) -> bool:
+    """An awaited acquire/admit/slot, a Semaphore construction, or a
+    budget-named attribute in ``fn`` (same function scope)."""
+    from ceph_tpu.analysis.core import dotted_name, enclosing_functions
+
+    for inner in ast.walk(fn):
+        if isinstance(inner, ast.Call):
+            tail = dotted_name(inner.func).rsplit(".", 1)[-1]
+            if tail in ("Semaphore", "BoundedSemaphore", "Throttle"):
+                return True
+        if isinstance(inner, ast.Await) and \
+                isinstance(inner.value, ast.Call) and \
+                enclosing_functions(ctx, inner) == holder:
+            attr = call_attr(inner.value)
+            if attr in _FANOUT_BUDGET_ATTRS:
+                return True
+            tgt = dotted_name(inner.value.func).lower()
+            if "budget" in tgt or "throttle" in tgt or "admit" in tgt:
+                return True
+        if isinstance(inner, (ast.Attribute, ast.Name)):
+            nm = dotted_name(inner).rsplit(".", 1)[-1].lower()
+            if "budget" in nm or "_sem" in nm or nm.endswith("sem"):
+                return True
+    return False
+
+
+@rule(
+    "async-unbounded-fanout", "async", SEV_WARNING,
+    "gather/spawn fan-out over an unbounded client/op collection with "
+    "no semaphore/budget admit in scope: at a thousand clients the "
+    "coroutine set IS the memory bound, and at a million it is an OOM "
+    "-- acquire a budget permit per element (the loadgen "
+    "per-client in-flight budget discipline) or bound the pool "
+    "(fixed worker count over a queue)",
+)
+def check_unbounded_fanout(ctx: FileContext) -> Iterator[Finding]:
+    from ceph_tpu.analysis.core import enclosing_functions
+
+    for fn in ast.walk(ctx.tree):
+        if not isinstance(fn, ast.AsyncFunctionDef):
+            continue
+        budget_known: Optional[bool] = None
+        for node in ast.walk(fn):
+            holder = enclosing_functions(ctx, node)
+            if not holder or holder[-1] is not fn:
+                continue
+            site = None
+            coll = None
+            # shape 1: gather(*(f(x) for x in CLIENTS)) / gather(*[...])
+            if isinstance(node, ast.Call) and (
+                    call_attr(node) == "gather" or
+                    call_name(node) == "gather"):
+                for arg in node.args:
+                    gen = None
+                    if isinstance(arg, ast.Starred):
+                        gen = arg.value
+                    if isinstance(gen, (ast.GeneratorExp, ast.ListComp)):
+                        per_item = any(
+                            isinstance(x, ast.Call)
+                            for x in ast.walk(gen.elt))
+                        if per_item and gen.generators:
+                            coll = _fanout_collection_name(
+                                gen.generators[0].iter)
+                            site = node
+            # shape 2: for x in CLIENTS: ... create_task(f(x)) ...
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                cname = _fanout_collection_name(node.iter)
+                if cname is not None:
+                    for inner in ast.walk(node):
+                        if isinstance(inner, ast.Call) and \
+                                call_attr(inner) in _SPAWN_ATTRS and \
+                                enclosing_functions(ctx, inner) == holder:
+                            coll = cname
+                            site = inner
+                            break
+            if site is None or coll is None:
+                continue
+            if budget_known is None:
+                budget_known = _has_budget_evidence(fn, ctx, holder)
+            if budget_known:
+                continue
+            yield ctx.finding(
+                "async-unbounded-fanout", site,
+                f"per-item fan-out over {coll!r} in {fn.name}() with no "
+                "semaphore/budget admit in scope; bound it (budget "
+                "permit per element, or a fixed worker pool over a "
+                "queue)",
             )
 
 

@@ -132,23 +132,30 @@ async def _mon_integrate(args, shard, messenger, addr_map,
         start = loop.time()
         for j in peers:  # never-ponged peers age from loop start
             shard.hb_pongs.setdefault(f"osd.{j}", start)
+        # budget-bounded fan-out (async-unbounded-fanout): the gathered
+        # ping round holds at most this many coroutines in flight no
+        # matter how many peers the map grows to
+        hb_budget = asyncio.Semaphore(32)
 
         async def ping_one(j):
-            try:
-                # bound the send: a blackholed peer's TCP connect would
-                # otherwise stall the whole gathered round for the OS
-                # SYN timeout (review r5 finding)
-                await asyncio.wait_for(
-                    messenger.send_message(name, f"osd.{j}", "ping"),
-                    timeout=1.0)
-            except (OSError, asyncio.TimeoutError):
-                pass  # dead peer: its pong stays stale, the grace fires
+            async with hb_budget:
+                try:
+                    # bound the send: a blackholed peer's TCP connect
+                    # would otherwise stall the whole gathered round for
+                    # the OS SYN timeout (review r5 finding)
+                    await asyncio.wait_for(
+                        messenger.send_message(name, f"osd.{j}", "ping"),
+                        timeout=1.0)
+                except (OSError, asyncio.TimeoutError):
+                    pass  # dead peer: pong stays stale, the grace fires
 
         async def confirm_down(j):
-            try:
-                return not await messenger.probe(f"osd.{j}", timeout=1.0)
-            except (OSError, asyncio.TimeoutError):
-                return True
+            async with hb_budget:
+                try:
+                    return not await messenger.probe(
+                        f"osd.{j}", timeout=1.0)
+                except (OSError, asyncio.TimeoutError):
+                    return True
 
         while True:
             cfg = get_config()

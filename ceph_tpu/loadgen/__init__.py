@@ -1,0 +1,28 @@
+"""Million-client scale harness: scenario-diverse load generation over
+the real-TCP cluster path (ROADMAP item 3).
+
+The package composes four layers (docs/qos.md):
+
+* :mod:`profiles` -- named workload shapes (RGW-style object PUT/GET
+  mixes, RBD-style small random extent I/O, CephFS-style metadata+data,
+  transactional omap_cas/exec traffic) as weighted op/size tables;
+* :mod:`arrival` -- open-loop (Poisson) and closed-loop (think-time)
+  arrival processes;
+* :mod:`clients` -- LoadClient: one Objecter driven by a profile under
+  an arrival process, with a per-client in-flight budget semaphore
+  (``loadgen_client_inflight``) so a million-client run can never OOM
+  the harness, and exactly-once CAS accounting built in;
+* :mod:`scenario` -- ScenarioRunner: a real-TCP cluster (client hubs
+  multiplex thousands of Objecters over a handful of sockets -- the
+  ``name@hub`` messenger aliasing), client groups with per-group QoS
+  classes, concurrent chaos (thrash kills, failover, background
+  rebuild, tier promotion), and fairness/percentile/exactly-once
+  result collection.
+"""
+
+from ceph_tpu.loadgen.arrival import ClosedLoop, OpenLoop  # noqa: F401
+from ceph_tpu.loadgen.clients import ClientStats, LoadClient  # noqa: F401
+from ceph_tpu.loadgen.profiles import PROFILES, WorkloadProfile  # noqa: F401
+from ceph_tpu.loadgen.scenario import (ClientGroup, Scenario,  # noqa: F401
+                                       ScenarioResult, ScenarioRunner,
+                                       run_scenario)

@@ -193,6 +193,54 @@ def prometheus_text(state: dict) -> str:
         for name, s in sorted(state["osd_stats"].items()):
             lines.append(f'ceph_osd_{counter}{{ceph_daemon="{name}"}} '
                          f"{s['perf'].get(counter, 0)}")
+    # unified QoS admission (osd/qos.py, docs/qos.md): per-class
+    # admitted ops/bytes and throttle waits (client classes counted per
+    # op, recovery/scrub per batch), plus the load-generator-published
+    # per-class fairness spread (max/min achieved per-client throughput
+    # within the class; 1.0 = perfectly fair)
+    try:
+        qos_rows = {"ops": [], "bytes": [], "throttle_waits": []}
+        for name, s in sorted(state["osd_stats"].items()):
+            for counter, value in sorted(s["perf"].items()):
+                if not counter.startswith("qos_") or \
+                        not isinstance(value, (int, float)):
+                    continue
+                for suffix in ("throttle_waits", "bytes", "ops"):
+                    if counter.endswith(f"_{suffix}"):
+                        klass = counter[len("qos_"):-len(suffix) - 1]
+                        if klass:
+                            qos_rows[suffix].append((name, klass, value))
+                        break
+        for suffix, help_text in (
+            ("ops", "batches/ops admitted per QoS class"),
+            ("bytes", "stripe bytes admitted per QoS class"),
+            ("throttle_waits",
+             "admissions that waited for a dmClock grant per QoS class"),
+        ):
+            if not qos_rows[suffix]:
+                continue
+            lines += [f"# HELP ceph_qos_class_{suffix} {help_text}",
+                      f"# TYPE ceph_qos_class_{suffix} counter"]
+            for name, klass, value in qos_rows[suffix]:
+                lines.append(
+                    f'ceph_qos_class_{suffix}{{ceph_daemon="{name}",'
+                    f'qos_class="{klass}"}} {value}')
+        from ceph_tpu.osd import qos as _qos_mod
+
+        spreads = _qos_mod.fairness_spreads()
+        if spreads:
+            lines += [
+                "# HELP ceph_qos_fairness_spread max/min achieved "
+                "per-client throughput within a QoS class (loadgen-"
+                "published; 1.0 = perfectly fair)",
+                "# TYPE ceph_qos_fairness_spread gauge",
+            ]
+            for klass in sorted(spreads):
+                lines.append(
+                    f'ceph_qos_fairness_spread{{qos_class="{klass}"}} '
+                    f"{spreads[klass]}")
+    except Exception:  # noqa: BLE001 -- exposition must never fail
+        pass
     client_perf = state["pools"].get("client_perf", {})
     for counter in ("op_resend", "backoff_received"):
         lines += [f"# HELP ceph_client_{counter} client-side {counter} "

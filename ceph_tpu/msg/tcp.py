@@ -721,9 +721,21 @@ class TCPMessenger:
     # -- client side -------------------------------------------------------
 
     def _node_of(self, entity: str) -> Optional[str]:
-        """The node hosting an entity: itself if it has an address, else
-        its 'osd.N'-style name IS the node name in the default layout."""
-        return entity if entity in self.addr_map else None
+        """The node hosting an entity: itself if it has an address,
+        else -- for hub-multiplexed entities named ``<name>@<node>``
+        (the loadgen scale harness: thousands of client Objecters
+        sharing a handful of client-hub messengers/ports) -- the node
+        after the ``@``.  A reply to ``c137@lg0`` then rides the ONE
+        cached connection to node ``lg0`` instead of opening a socket
+        per client, and the hub's dispatch fans it to the registered
+        entity queue by full name."""
+        if entity in self.addr_map:
+            return entity
+        if "@" in entity:
+            node = entity.rsplit("@", 1)[1]
+            if node in self.addr_map:
+                return node
+        return None
 
     async def _connect(self, node: str):
         from ceph_tpu.auth.cephx import AuthHandshake

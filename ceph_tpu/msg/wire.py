@@ -90,6 +90,7 @@ def message_encoder(msg: object) -> Encoder:
             msg.reqid, (tuple, list)) else msg.reqid)
         enc.value(list(msg.trace) if isinstance(
             msg.trace, (tuple, list)) else msg.trace)
+        enc.value(msg.qos_class)
     elif isinstance(msg, ECSubWriteReply):
         enc.u8(_MSG_EC_SUB_WRITE_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -106,6 +107,7 @@ def message_encoder(msg: object) -> Encoder:
         enc.string(msg.op_class)
         enc.value(list(msg.trace) if isinstance(
             msg.trace, (tuple, list)) else msg.trace)
+        enc.value(msg.qos_class)
     elif isinstance(msg, ECSubReadReply):
         enc.u8(_MSG_EC_SUB_READ_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -148,6 +150,9 @@ def decode_message(data: bytes) -> object:
             # reqid (and pre-trace DECODERS stop there, cleanly
             # ignoring this trailing context from newer senders)
             trace=dec.value() if dec.remaining() else None,
+            # cephlint: wire-optional -- pre-qos senders end at the
+            # trace context
+            qos_class=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_WRITE_REPLY:
         return ECSubWriteReply(
@@ -166,6 +171,9 @@ def decode_message(data: bytes) -> object:
             op_class=dec.string(),
             # cephlint: wire-optional -- pre-trace senders end here
             trace=dec.value() if dec.remaining() else None,
+            # cephlint: wire-optional -- pre-qos senders end at the
+            # trace context
+            qos_class=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_READ_REPLY:
         return ECSubReadReply(

@@ -80,6 +80,15 @@ class BatchCoalescer:
         self.max_batch = max_batch
         self.max_bytes = max_bytes
         self._sem = asyncio.Semaphore(max(1, depth))
+        #: unified QoS admission (osd/qos.py, set by the hosting
+        #: OSDShard): when present, a gathered batch claims one
+        #: admission slot under ``qos_class`` with cost = its stripe
+        #: bytes BEFORE dispatching -- the dequeue that frees the slot
+        #: to this batch IS the dmClock decision, so batching and QoS
+        #: are one layer.  None (client-side engines, unified QoS off)
+        #: dispatches on the depth semaphore alone.
+        self.admission = None
+        self.qos_class = "client"
         self._pending: List[tuple] = []  # (item, future, nbytes, span)
         self._pending_bytes = 0
         self._flush_scheduled = False
@@ -145,6 +154,21 @@ class BatchCoalescer:
         task.add_done_callback(refs.discard)
 
     async def _run_batch(self, batch: List[tuple]) -> None:
+        admission = self.admission
+        if admission is not None:
+            # the QoS admission stage: one slot per batched dispatch,
+            # cost = the batch's payload bytes.  Waits only on slot
+            # releases and the clock (never on another op's completion),
+            # so the coalescer's deadlock-freedom argument holds intact.
+            async with admission.slot(
+                self.qos_class,
+                sum(nb for _i, _f, nb, _sp in batch),
+            ):
+                await self._run_batch_admitted(batch)
+        else:
+            await self._run_batch_admitted(batch)
+
+    async def _run_batch_admitted(self, batch: List[tuple]) -> None:
         async with self._sem:
             items = [item for item, _fut, _nb, _sp in batch]
             # the shared stage is ONE fan-in span, child of every

@@ -99,8 +99,15 @@ class Objecter:
         pool: str = "",
         op_timeout: float = 30.0,
         oid_prefix: str = "",
+        qos_class: Optional[str] = None,
     ):
         self.messenger = messenger
+        #: per-client QoS class (docs/qos.md): stamped on every op as
+        #: ``qos_class`` so the primary's unified admission layer and
+        #: mclock op queue schedule it under that class's
+        #: reservation/weight/limit triple; None = the base "client"
+        #: class (no field on the wire)
+        self.qos_class = qos_class
         self.km = km
         self.n_osds = n_osds
         self.placement = placement
@@ -292,6 +299,8 @@ class Objecter:
             self._pending[tid] = fut
             msg = dict(fields, op="client_op", tid=tid, kind=kind, oid=oid,
                        pool=self.pool, reqid=list(reqid))
+            if self.qos_class is not None:
+                msg["qos_class"] = self.qos_class
             if wire_ctx is not None:
                 msg["trace"] = wire_ctx
             try:

@@ -19,9 +19,9 @@ Both queues are cost-aware: an item's cost scales its tag spacing (a
 
 from __future__ import annotations
 
-import heapq
+import time
 from collections import deque
-from typing import Dict, Optional, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 
 class WeightedPriorityQueue:
@@ -95,13 +95,22 @@ class MClockQueue:
     """dmClock tag scheduler over named op classes.
 
     ``classes`` maps class name -> (reservation, weight, limit) in
-    items/sec (cost 1); reservation/limit of 0 mean none.  ``dequeue(now)``
-    returns the next eligible item or None if every queued class is at its
-    limit; ``next_ready(now)`` says when one becomes eligible.
+    cost-units/sec; reservation/limit of 0 mean none.  Time comes from
+    ONE injected monotonic ``clock`` (default ``time.monotonic``) read
+    inside every operation -- callers no longer supply ``now`` floats,
+    so mixed clock domains (event-loop time vs wall time vs a test's
+    virtual clock) can never corrupt tag ordering, and a wall-clock
+    regression cannot re-order tags minted under the old time.
+    ``dequeue()`` returns the next eligible item or None if every queued
+    class is at its limit; ``next_ready()`` says when one becomes
+    eligible (absolute, in the injected clock's domain -- compare
+    against ``self.clock()``).
     """
 
-    def __init__(self, classes: Dict[str, Tuple[float, float, float]]):
+    def __init__(self, classes: Dict[str, Tuple[float, float, float]],
+                 clock: Callable[[], float] = time.monotonic):
         self.classes = dict(classes)
+        self.clock = clock
         self._queues: Dict[str, deque] = {}
         #: per-class last-assigned tags (reservation, proportional, limit)
         self._tags: Dict[str, Tuple[float, float, float]] = {}
@@ -109,7 +118,8 @@ class MClockQueue:
     def _params(self, klass: str) -> Tuple[float, float, float]:
         return self.classes.get(klass, (0.0, 1.0, 0.0))
 
-    def enqueue(self, klass: str, cost: int, item, now: float) -> None:
+    def enqueue(self, klass: str, cost: int, item) -> None:
+        now = self.clock()
         res, wgt, lim = self._params(klass)
         cost = max(1, cost)
         prev = self._tags.get(klass)
@@ -138,7 +148,8 @@ class MClockQueue:
             if q:
                 yield klass, q[0]
 
-    def dequeue(self, now: float):
+    def dequeue(self):
+        now = self.clock()
         # phase 1: honor reservations whose tag has come due
         best = None
         for klass, (r, p, l, item) in self._heads():
@@ -159,11 +170,22 @@ class MClockQueue:
         r, p, l, item = self._queues[klass].popleft()
         return item
 
-    def next_ready(self, now: float) -> Optional[float]:
-        """Earliest time a queued item becomes eligible (None if empty)."""
+    def next_ready(self) -> Optional[float]:
+        """Earliest time a queued item becomes eligible (None if empty;
+        absolute in the injected clock's domain)."""
         t = None
         for klass, (r, p, l, item) in self._heads():
             cand = min(r, l)
             if t is None or cand < t:
                 t = cand
         return t
+
+    def idle_for(self) -> Optional[float]:
+        """Seconds until the next queued item becomes eligible: the
+        shard worker's event-driven idle wakeup (sleep exactly this
+        long, or until a new arrival, instead of polling).  None when
+        the queue is empty; 0.0 when something is eligible right now."""
+        nxt = self.next_ready()
+        if nxt is None:
+            return None
+        return max(0.0, nxt - self.clock())

@@ -129,6 +129,14 @@ REQID_FANOUT_KINDS = frozenset({
 _OP_REQID: "contextvars.ContextVar[Optional[tuple]]" = \
     contextvars.ContextVar("ceph_tpu_op_reqid", default=None)
 
+#: the in-flight client op's QoS sub-class (gold/bulk/... from the
+#: Objecter's qos_class; docs/qos.md), stamped onto the op's own
+#: sub-ops so RECEIVING shards queue them under the same class --
+#: end-to-end reservations need the replica hop, not just the
+#: primary's admission, to honor the tags
+_OP_QOS: "contextvars.ContextVar[Optional[str]]" = \
+    contextvars.ContextVar("ceph_tpu_op_qos", default=None)
+
 #: mclock_opclass-style defaults: (reservation, weight, limit) items/sec;
 #: clients get a floor and most of the weight.  Recovery carries NO hard
 #: limit since round 14: a degraded cluster must re-reach full
@@ -640,6 +648,16 @@ class PG:
                 if getattr(sub, "op_class", "client") == "client" and \
                         getattr(sub, "reqid", None) is None:
                     sub.reqid = rid
+        # QoS: the op's client sub-class rides its own sub-writes so
+        # the applying shards' op queues order them under it (trailing
+        # optional field, like the reqid; scheduling only -- op_class
+        # keeps the version-gate/dup semantics)
+        qcls = _OP_QOS.get()
+        if qcls is not None:
+            for _dst, sub in subs:
+                if getattr(sub, "op_class", "client") == "client" and \
+                        getattr(sub, "qos_class", None) is None:
+                    sub.qos_class = qcls
         # trace stitching: the in-flight op's wire context rides every
         # sub-op of its own fan-out (trailing optional field, like the
         # reqid), so the applying shards' sub-write spans join the
@@ -710,6 +728,7 @@ class PG:
         # context rides each sub-read so the serving shards' spans
         # stitch into the same trace.
         wire_ctx = trace.current_wire()
+        qcls = _OP_QOS.get() if op_class == "client" else None
         await self.messenger.send_messages(self.name, [
             (f"osd.{acting[s]}", ECSubRead(
                 from_shard=s,
@@ -718,6 +737,7 @@ class PG:
                 attrs_to_read=[oid],
                 op_class=op_class,
                 trace=wire_ctx,
+                qos_class=qcls,
             ))
             for s in shards
         ])
@@ -2095,15 +2115,25 @@ class PG:
         to the acting set.  Returns the op's wire-encodable result."""
         kind = msg["kind"]
         reqid = msg.get("reqid")
-        if reqid is not None and kind in REQID_FANOUT_KINDS:
-            # visible to this op's own fan-outs only (task-scoped);
-            # composite kinds (exec/snap_trim) run reqid-free internals
-            token = _OP_REQID.set(tuple(reqid))
-            try:
-                return await self._client_op_inner(msg)
-            finally:
-                _OP_REQID.reset(token)
-        return await self._client_op_inner(msg)
+        qtoken = None
+        if msg.get("qos_class"):
+            # the op's QoS sub-class travels to its own fan-outs (every
+            # kind: reads schedule under the class too)
+            qtoken = _OP_QOS.set(msg["qos_class"])
+        try:
+            if reqid is not None and kind in REQID_FANOUT_KINDS:
+                # visible to this op's own fan-outs only (task-scoped);
+                # composite kinds (exec/snap_trim) run reqid-free
+                # internals
+                token = _OP_REQID.set(tuple(reqid))
+                try:
+                    return await self._client_op_inner(msg)
+                finally:
+                    _OP_REQID.reset(token)
+            return await self._client_op_inner(msg)
+        finally:
+            if qtoken is not None:
+                _OP_QOS.reset(qtoken)
 
     async def _client_op_inner(self, msg: dict):
         kind = msg["kind"]

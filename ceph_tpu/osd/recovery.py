@@ -91,6 +91,10 @@ class BackgroundThrottle:
         self._backend = backend
         self._sem: Optional[asyncio.Semaphore] = None
         self._sem_width = 0
+        #: unified-QoS slots currently held (admit/release pairing is
+        #: caller-side positional, and every slot is the same
+        #: "recovery"-class token, so a simple count suffices)
+        self._qos_held = 0
 
     def _semaphore(self) -> asyncio.Semaphore:
         width = max(1, int(_cfg().get_val("osd_recovery_max_active")))
@@ -99,18 +103,37 @@ class BackgroundThrottle:
             self._sem_width = width
         return self._sem
 
+    def _qos(self):
+        """The hosting shard's unified admission (osd/qos.py), when the
+        engine is shard-hosted and osd_qos_unified is on."""
+        shard = getattr(self._backend, "_host_shard", None)
+        return getattr(shard, "qos", None)
+
     def _client_pressure(self) -> bool:
         shard = getattr(self._backend, "_host_shard", None)
         if shard is None:
             return False
         return getattr(shard, "_client_ops_queued", 0) > CLIENT_PRESSURE_OPS
 
-    async def admit(self, force: bool = False) -> None:
-        """Claim one background-batch slot, backing off while client
-        traffic is saturated (bounded: forced progress after
-        MAX_PREEMPT_ROUNDS so degraded objects blocking client ops
-        still recover)."""
+    async def admit(self, force: bool = False, cost: int = 0) -> None:
+        """Claim one background-batch slot.  Under unified QoS the
+        claim is a dmClock "recovery"-class admission (osd/qos.py) with
+        ``cost`` = the batch's byte budget: client bursts win the freed
+        slots by weight, recovery's reservation guarantees forward
+        progress -- replacing the legacy client-pressure gauge loop,
+        which remains the fallback (osd_qos_unified=false, client-side
+        engines) with its bounded preemption."""
         await self._semaphore().acquire()
+        qos = self._qos()
+        if qos is not None:
+            try:
+                held = await qos.acquire("recovery", max(1, int(cost)))
+            except BaseException:
+                self._sem.release()
+                raise
+            if held:
+                self._qos_held += 1
+            return
         rounds = 0
         while not force and rounds < MAX_PREEMPT_ROUNDS \
                 and self._client_pressure():
@@ -120,6 +143,11 @@ class BackgroundThrottle:
                 0.005, float(_cfg().get_val("osd_recovery_sleep"))))
 
     def release(self) -> None:
+        if self._qos_held > 0:
+            qos = self._qos()
+            if qos is not None:
+                self._qos_held -= 1
+                qos.release_slot()
         if self._sem is not None:
             self._sem.release()
 
@@ -254,10 +282,11 @@ class RecoveryCoalescer:
                 continue
             plain.setdefault(oid, []).append((s, target, rb))
 
+        batch_cost = max(1, int(_cfg().get_val("osd_recovery_batch_bytes")))
         oids = sorted(plain)
         for i in range(0, len(oids), MAX_BATCH_OBJECTS):
             group = {oid: plain[oid] for oid in oids[i:i + MAX_BATCH_OBJECTS]}
-            await self.throttle.admit()
+            await self.throttle.admit(cost=batch_cost)
             try:
                 fell_back = await self._recover_batch(group)
             finally:
@@ -586,6 +615,16 @@ async def scrub_read_many(
             (osd, s, to_read, attr_want[(osd, s)])
             for (osd, s), to_read in reads.items()
         ]
+        qos = getattr(getattr(backend, "_host_shard", None), "qos", None)
+        if qos is not None:
+            # unified admission, transient form: the scrub round is
+            # tag-ordered (and limit-paced) against client/recovery
+            # classes but occupies no slot across its reads -- the
+            # chunk cursor already bounds its footprint
+            await qos.admit(
+                "scrub", chunk_max * max(1, sum(
+                    len(p["up"]) for o, p in plans.items()
+                    if o in pending)))
         replies = await batched_sub_reads(
             backend, read_list, "scrub", timeout)
         backend.perf.inc("scrub_chunks")

@@ -145,9 +145,47 @@ class OSDShard:
         self.tier_agent = None  # built lazily on the first active tick
         self.op_queue_type = op_queue
         if op_queue == "mclock":
-            self.opq = MClockQueue(dict(MCLOCK_DEFAULTS))
+            # the base classes keep their legacy 4KiB-unit rates; the
+            # osd_qos_profile's EXTRA classes (client sub-classes like
+            # gold/bulk) join scaled from MiB/s to 4KiB units so one
+            # profile string governs both the op queue and the unified
+            # admission layer
+            from ceph_tpu.osd.qos import parse_profile
+
+            classes = dict(MCLOCK_DEFAULTS)
+            for kname, (res, wgt, lim) in parse_profile().items():
+                if kname not in classes:
+                    classes[kname] = (res * 256.0, wgt, lim * 256.0)
+            self.opq = MClockQueue(classes)
         else:
             self.opq = WeightedPriorityQueue()
+        # unified QoS admission (osd/qos.py, osd_qos_unified): the
+        # dmClock tags become the data plane's admission stage --
+        # ``qos`` grants BATCH dispatches (coalesced client encodes,
+        # recovery cycles, scrub rounds; counted per batch for the
+        # recovery/scrub classes), ``qos_ops`` grants client-op
+        # execution slots in tag order by the op's qos_class (counted
+        # per op).  Two slot pools, one profile: an op holding an
+        # execution slot may wait on a batch slot but never the other
+        # way around, so no admission cycle can form.
+        from ceph_tpu.utils.config import get_config as _get_config
+
+        self.qos = None
+        self.qos_ops = None
+        if bool(_get_config().get_val("osd_qos_unified")):
+            from ceph_tpu.osd.qos import (QoSAdmission, parse_profile,
+                                          profile_bytes_per_s)
+
+            qclasses = profile_bytes_per_s(parse_profile())
+            self.qos = QoSAdmission(
+                classes=qclasses, perf=self.perf,
+                perf_classes={"recovery", "scrub"},
+            )
+            self.qos_ops = QoSAdmission(
+                slots=int(_get_config().get_val("osd_qos_op_slots")),
+                classes=qclasses, perf=self.perf,
+                perf_classes=set(qclasses) - {"recovery", "scrub"},
+            )
         self._op_event = asyncio.Event()
         #: background-scrub rotating cursor (PG scrub scheduling role)
         self._scrub_cursor = 0
@@ -236,6 +274,16 @@ class OSDShard:
         # batches consult THIS daemon's client-queue depth to back off
         # under saturation (osd/recovery.py BackgroundThrottle)
         backend._host_shard = self
+        # unified QoS hookup: the engine's codec coalescers admit each
+        # fused batch through this daemon's dmClock slots (the
+        # batching-and-QoS-as-one-layer fusion, osd/qos.py); the
+        # recovery/scrub paths reach the same admission via
+        # _host_shard.qos inside the BackgroundThrottle
+        if self.qos is not None:
+            for co in (getattr(backend, "_enc_coalescer", None),
+                       getattr(backend, "_dec_coalescer", None)):
+                if co is not None:
+                    co.admission = self.qos
         # mesh data plane membership (osd_mesh_data_plane): bind this
         # daemon to a mesh device slot so its PG-shard slice lives on
         # (and its inbound chunks are delivered through) the device
@@ -535,10 +583,13 @@ class OSDShard:
                 # tracker object per queued message
                 msg["_queued_mono"] = time.monotonic()
                 if self.op_queue_type == "mclock":
-                    self.opq.enqueue(
-                        "client", cost, (src, msg),
-                        asyncio.get_event_loop().time(),
-                    )
+                    # client sub-class (gold/bulk/... from the op's
+                    # qos_class field) when the profile names it;
+                    # plain "client" otherwise
+                    klass = msg.get("qos_class") or "client"
+                    if klass not in self.opq.classes:
+                        klass = "client"
+                    self.opq.enqueue(klass, cost, (src, msg))
                 else:
                     self.opq.enqueue(
                         OP_PRIORITY["client"], cost, (src, msg)
@@ -564,13 +615,20 @@ class OSDShard:
             return
         if isinstance(msg, (ECSubWrite, ECSubRead)):
             klass = getattr(msg, "op_class", "client")
+            # a client sub-op carrying its originating op's QoS
+            # sub-class queues under THAT class (end-to-end tags: the
+            # replica hop honors the same reservation/weight/limit
+            # triple as the primary's admission); unknown classes ride
+            # the base op_class
+            qcls = getattr(msg, "qos_class", None)
             cost = self._op_cost(msg)
             # queue-entry stamp (see the client-op path above)
             msg._queued_mono = time.monotonic()
             if self.op_queue_type == "mclock":
-                self.opq.enqueue(
-                    klass, cost, (src, msg), asyncio.get_event_loop().time()
-                )
+                if qcls is not None and klass == "client" and \
+                        qcls in self.opq.classes:
+                    klass = qcls
+                self.opq.enqueue(klass, cost, (src, msg))
             else:
                 self.opq.enqueue(OP_PRIORITY.get(klass, 63), cost, (src, msg))
             self.perf.inc(f"queued_{klass}")
@@ -969,24 +1027,25 @@ class OSDShard:
 
     async def _op_worker(self) -> None:
         """Dequeue-and-execute loop (the osd_op_tp worker thread role)."""
-        loop = asyncio.get_event_loop()
         while True:
             await self._op_event.wait()
             self._op_event.clear()
             while True:
                 if self.op_queue_type == "mclock":
-                    now = loop.time()
-                    item = self.opq.dequeue(now)
+                    item = self.opq.dequeue()
                     if item is None:
-                        nxt = self.opq.next_ready(now)
-                        if nxt is None:
-                            break
-                        # wait for the tag to come due OR a new arrival
+                        # next_ready-based idle wakeup: sleep until the
+                        # earliest queued tag comes due OR a new arrival
                         # (whose reservation may be eligible right away)
+                        # -- the queue's OWN injected clock times both
+                        # sides, so no mixed-domain drift can strand a
+                        # tag (the polling fallback is gone)
+                        delay = self.opq.idle_for()
+                        if delay is None:
+                            break
                         try:
                             await asyncio.wait_for(
-                                self._op_event.wait(),
-                                timeout=max(0.0, nxt - now),
+                                self._op_event.wait(), timeout=delay,
                             )
                             self._op_event.clear()
                         except asyncio.TimeoutError:
@@ -1108,7 +1167,23 @@ class OSDShard:
 
     async def _run_client_op_inner(self, src: str, msg: dict, op,
                                    reply: dict) -> None:
-        async with self._cop_sem:
+        # execution-slot admission: under unified QoS the op claims its
+        # slot in dmClock tag order for its client class (the op's
+        # qos_class field, plain "client" otherwise) with cost = payload
+        # bytes (4 KiB floor for metadata ops) -- freed slots go to the
+        # class the tags elect, not to semaphore-FIFO order.  Fallback:
+        # the legacy _cop_sem (osd_qos_unified=false).
+        klass = msg.get("qos_class") or "client"
+        if self.qos_ops is not None and \
+                klass not in self.qos_ops.classes:
+            klass = "client"  # unknown sub-class rides the base class
+        if self.qos_ops is not None and klass in self.qos_ops.classes:
+            guard = self.qos_ops.slot(
+                klass, max(4096, len(msg.get("data") or b"")),
+            )
+        else:
+            guard = self._cop_sem
+        async with guard:
             op.mark_event("started")
             pool_name = msg.get("pool") or ""
             backend = self.pools.get(pool_name)

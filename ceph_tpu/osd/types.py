@@ -126,6 +126,14 @@ class ECSubWrite:
     #: sub-write across daemons.  None for unsampled ops and pre-trace
     #: senders (trailing optional wire field, msg/wire.py).
     trace: object = None
+    #: originating client's QoS sub-class (gold/bulk/...; docs/qos.md):
+    #: the RECEIVING shard's op queue orders this sub-write under that
+    #: class, so end-to-end reservations hold through the replica hop,
+    #: not just at the primary's admission.  Distinct from ``op_class``
+    #: on purpose -- the version-gate/dup semantics key on op_class and
+    #: must not change with scheduling class.  None = plain "client"
+    #: (trailing optional wire field).
+    qos_class: object = None
 
 
 @dataclasses.dataclass
@@ -163,6 +171,9 @@ class ECSubRead:
     #: originating op's trace context (see ECSubWrite.trace); trailing
     #: optional wire field, None for unsampled ops / pre-trace senders
     trace: object = None
+    #: originating client's QoS sub-class (see ECSubWrite.qos_class);
+    #: trailing optional wire field
+    qos_class: object = None
 
 
 @dataclasses.dataclass

@@ -152,6 +152,48 @@ OPTIONS: Dict[str, Option] = {
              "insert is counted as tier_promote_from_recovery",
              see_also=("osd_tier_promote_temp",
                        "osd_tier_promote_from_encode")),
+        _opt("osd_qos_unified", bool, True, LEVEL_ADVANCED,
+             "fuse the dmClock op-queue discipline into the batched "
+             "data plane (osd/qos.py): coalesced client batches, "
+             "recovery cycles and scrub rounds claim admission slots "
+             "in per-class reservation/weight/limit tag order with "
+             "cost = stripe bytes, replacing the round-14 "
+             "BackgroundThrottle's client-pressure preemption gauge.  "
+             "False restores the gauge-based preemption (the A/B "
+             "baseline)",
+             see_also=("osd_qos_profile", "osd_qos_slots")),
+        _opt("osd_qos_profile", str,
+             "client:0:100:0,recovery:4:10:0,scrub:1:5:0",
+             LEVEL_ADVANCED,
+             "per-class dmClock triple, comma/space-separated "
+             "name:reservation:weight:limit entries -- reservation and "
+             "limit in MiB/s (0 = none), weight unitless.  Applied by "
+             "the unified admission layer (cost = batch stripe bytes) "
+             "and, scaled to 4KiB cost units, by the mclock op queue "
+             "for client sub-classes (a client op's qos_class field "
+             "names one)",
+             see_also=("osd_qos_unified",)),
+        _opt("osd_qos_slots", int, 4, LEVEL_ADVANCED,
+             "concurrent admission slots for batched dispatches per "
+             "OSD: the unified QoS layer's service capacity -- when "
+             "all are busy, freed slots go to queued classes in "
+             "dmClock tag order (the point where reservation floors "
+             "and weight shares are enforced)",
+             see_also=("osd_qos_unified",)),
+        _opt("osd_qos_op_slots", int, 64, LEVEL_ADVANCED,
+             "concurrent client-op execution slots per OSD under "
+             "unified QoS (the osd_op_tp width): freed slots are "
+             "granted to queued client ops in dmClock tag order by "
+             "qos_class instead of semaphore FIFO.  Matches the "
+             "legacy _cop_sem width by default",
+             see_also=("osd_qos_unified", "osd_qos_slots")),
+        _opt("loadgen_client_inflight", int, 4, LEVEL_ADVANCED,
+             "per-client in-flight op budget in the load generator "
+             "(ceph_tpu/loadgen/): an open-loop client whose arrivals "
+             "outrun completions parks on this semaphore instead of "
+             "accumulating unbounded tasks, so a million-client run "
+             "cannot OOM the harness; the high-water mark is surfaced "
+             "as client_inflight_hwm"),
         _opt("osd_pg_log_dups_tracked", int, 3000, LEVEL_ADVANCED,
              "reqid dup entries retained per OSD PG log for client-op "
              "replay detection; kept past trim() like the reference's "

@@ -37,6 +37,14 @@ except AttributeError:
 
 import pytest  # noqa: E402
 
+
+def pytest_configure(config):
+    # tier-1 runs with -m 'not slow'; register the marker so opting a
+    # heavyweight scenario out of the gate is not an unknown-mark typo
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 'not slow' gate")
+
+
 _ATOMIC_VERIFIER = None
 if os.environ.get("CEPH_TPU_ATOMIC_VERIFY", "1") != "0":
     from ceph_tpu.analysis import runtime as _atomic_runtime

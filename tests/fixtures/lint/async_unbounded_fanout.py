@@ -1,0 +1,55 @@
+"""Fixture: async-unbounded-fanout (gather/spawn over a client/op
+collection without a budget admit).  Lines a rule must flag carry
+`# LINT:` annotations; everything else is negative coverage."""
+
+import asyncio
+
+
+async def issue(c):
+    await asyncio.sleep(0)
+    return c
+
+
+async def storm_gather(clients):
+    # per-client coroutine fan-out, nothing bounding it
+    await asyncio.gather(*(issue(c) for c in clients))  # LINT: async-unbounded-fanout
+
+
+async def storm_spawn(self):
+    for conn in self.conns:
+        asyncio.get_event_loop().create_task(issue(conn))  # LINT: async-unbounded-fanout, async-orphan-task
+
+
+async def bounded_gather(clients):
+    # budgeted: every element claims a permit first -- clean
+    budget = asyncio.Semaphore(8)
+
+    async def one(c):
+        async with budget:
+            return await issue(c)
+
+    await asyncio.gather(*(one(c) for c in clients))
+
+
+async def bounded_admit(self, ops_queued):
+    # admitted through a QoS/throttle layer per element -- clean
+    tasks = set()
+    for op in ops_queued:
+        await self.qos.admit("client", 4096)
+        task = asyncio.get_event_loop().create_task(self._run(op))
+        tasks.add(task)
+
+
+async def worker_pool(queue, writers):
+    # fixed worker count over a queue: the classic bounded shape
+    async def worker():
+        while queue:
+            await issue(queue.pop())
+
+    await asyncio.gather(*(worker() for _ in range(max(1, writers))))
+
+
+async def plain_gather(waiters):
+    # gathering bare futures by name (no per-item WORK call): clean
+    # even over a marked collection name
+    await asyncio.gather(*(d for d in waiters))

@@ -54,6 +54,8 @@ FIXTURES = {
     "async_lock_across_await.py": None,
     # PR-14 background data plane: recovery/scrub loops must admit/pace
     "async_background_unthrottled.py": None,
+    # PR-17 scale harness: per-client fan-outs must hold a budget
+    "async_unbounded_fanout.py": None,
     "async_atomic_section.py": None,
     "wire_symmetry.py": None,
     # PR-16 observability: started spans must reach finish() on every

@@ -1,0 +1,198 @@
+"""loadgen scale harness: profiles, budgets, hub multiplexing, the
+real-TCP scenario runner with chaos, and the exactly-once audit
+(ceph_tpu/loadgen/ + osd/qos_bench.py smoke shapes)."""
+
+import asyncio
+import random
+
+import pytest
+
+from ceph_tpu.loadgen import (ClientGroup, ClosedLoop, OpenLoop, PROFILES,
+                              Scenario, run_scenario)
+from ceph_tpu.loadgen.clients import ClientStats, LoadClient
+
+
+# -- profiles --------------------------------------------------------------
+
+
+def test_profiles_sample_shapes():
+    rng = random.Random(1)
+    for name, prof in PROFILES.items():
+        kinds = set()
+        for _ in range(300):
+            kind, size = prof.sample(rng)
+            kinds.add(kind)
+            if kind in ("put", "get", "range_write", "range_read"):
+                assert size > 0, (name, kind)
+            else:
+                assert size == 0, (name, kind)
+        # every mixed kind shows up across 300 draws
+        assert kinds == {k for k, _w in prof.mix}, name
+
+
+def test_arrival_processes():
+    rng = random.Random(2)
+    assert ClosedLoop().gap(rng) == 0.0
+    gaps = [OpenLoop(rate_ops_s=100.0).gap(rng) for _ in range(500)]
+    assert all(g >= 0 for g in gaps)
+    assert 0.005 < sum(gaps) / len(gaps) < 0.02  # ~1/rate mean
+
+
+def test_latency_reservoir_is_bounded():
+    from ceph_tpu.loadgen.clients import LATENCY_RESERVOIR
+
+    stats = ClientStats()
+    rng = random.Random(3)
+    for i in range(5 * LATENCY_RESERVOIR):
+        stats.note_latency(rng, float(i))
+    assert len(stats.latencies) == LATENCY_RESERVOIR
+
+
+# -- per-client in-flight budget (the million-client OOM bound) ------------
+
+
+def test_open_loop_budget_bounds_inflight_and_counts_shed():
+    """An open-loop client whose arrivals outrun completions must cap
+    in-flight ops at the budget and count the shed arrivals."""
+
+    class SlowObjecter:
+        name = "cb@hub0"
+
+        def __init__(self):
+            self.inflight = 0
+            self.hwm = 0
+
+        async def write(self, oid, data, snapc=None):
+            self.inflight += 1
+            self.hwm = max(self.hwm, self.inflight)
+            try:
+                await asyncio.sleep(0.05)  # far slower than arrivals
+            finally:
+                self.inflight -= 1
+
+    async def run():
+        from ceph_tpu.utils.perf import PerfCounters
+
+        perf = PerfCounters("loadgen-test")
+        ob = SlowObjecter()
+        client = LoadClient(
+            ob, PROFILES["put8k"], random.Random(5),
+            arrival=OpenLoop(rate_ops_s=500.0), inflight=3, perf=perf,
+        )
+        stop = asyncio.Event()
+        task = asyncio.ensure_future(client.run(stop))
+        await asyncio.sleep(0.4)
+        stop.set()
+        await task
+        return ob, client, perf
+
+    ob, client, perf = asyncio.run(run())
+    assert ob.hwm <= 3, ob.hwm
+    assert client.stats.arrivals_shed > 0
+    assert perf.snapshot().get("client_inflight_hwm") == 3
+
+
+# -- the real-TCP scenario runner ------------------------------------------
+
+
+def test_scenario_tcp_smoke_mixed_profiles_exact_cas():
+    """A few dozen hub-multiplexed clients over real TCP sockets, all
+    four traffic families, no chaos: ops flow, the QoS admission layer
+    counts them, fairness spread is finite, and the exactly-once audit
+    is exact."""
+    scn = Scenario(
+        name="t1-smoke", duration_s=2.0,
+        groups=(
+            ClientGroup(count=8, profile="rgw"),
+            ClientGroup(count=6, profile="rbd"),
+            ClientGroup(count=6, profile="cephfs", mode="open",
+                        rate_ops_s=4.0),
+            ClientGroup(count=4, profile="txn"),
+        ),
+        seed=19,
+    )
+    res = asyncio.run(run_scenario(scn, n_osds=5))
+    assert res.n_clients == 24
+    assert res.ops > 0
+    assert res.cas_clients > 0 and res.cas_exact
+    assert res.qos_counters.get("qos_client_ops", 0) > 0
+    rgw = res.groups[0]
+    assert rgw["ops"] > 0 and rgw["client_ops_min"] >= 0
+
+
+def test_scenario_chaos_thrash_rebuild_exactly_once():
+    """TRUE TCP kills (listener closed, sockets torn) + a mid-run OSD
+    wipe under transactional load: ops fail over, the rebuild runs
+    through the unified admission, and every CAS/exec counter matches
+    its client's acked successes exactly (modulo explicitly booked
+    indeterminate outcomes)."""
+    scn = Scenario(
+        name="t1-chaos", duration_s=5.0,
+        groups=(
+            ClientGroup(count=10, profile="rgw"),
+            ClientGroup(count=8, profile="txn"),
+        ),
+        chaos=("thrash", "rebuild"),
+        seed=23,
+    )
+    res = asyncio.run(run_scenario(scn, n_osds=6))
+    assert res.kills >= 1, "thrash never killed an OSD"
+    assert res.wipes == 1
+    assert res.cas_clients > 0 and res.cas_exact, res.cas_mismatches
+    assert res.ops > 0
+    # recovery of the wipe rode the unified dmClock admission
+    assert res.qos_counters.get("qos_recovery_ops", 0) > 0
+
+
+@pytest.mark.slow
+def test_qos_bench_overload_smoke_reservation_floor():
+    """The qos-path overload sub-stage at smoke shape: calibration,
+    10x bulk storm against a gold reservation, floor gate within 10%
+    (raises on violation -- the assertion IS the gate)."""
+    from ceph_tpu.osd.qos_bench import _overload_stage
+
+    result = asyncio.run(_overload_stage(smoke=True))
+    assert result["reservation_ratio"] >= 0.9
+    assert result["throttle_waits"] > 0
+    assert result["bulk_ops"] > 0
+
+
+def test_prometheus_exports_qos_class_series_and_fairness_gauge():
+    """ceph_qos_class_ops/bytes/throttle_waits per (daemon, class) and
+    the loadgen-published fairness spread gauge render in the mgr
+    exposition after QoS-admitted traffic."""
+
+    async def run():
+        from ceph_tpu.mgr.mgr import ClusterState, prometheus_text
+        from ceph_tpu.osd import qos as qos_mod
+        from ceph_tpu.osd.cluster import ECCluster
+
+        cluster = ECCluster(4, {"k": "2", "m": "1", "plugin": "jerasure"})
+        try:
+            await cluster.write("pq1", b"q" * 8192)
+            assert await cluster.read("pq1") == b"q" * 8192
+            qos_mod.set_fairness_spread("rgw", 1.25)
+            text = prometheus_text(ClusterState(cluster).dump())
+        finally:
+            qos_mod.set_fairness_spread("rgw", None)
+            await cluster.shutdown()
+        assert "# TYPE ceph_qos_class_ops counter" in text
+        assert 'qos_class="client"' in text
+        assert "# TYPE ceph_qos_class_bytes counter" in text
+        assert 'ceph_qos_fairness_spread{qos_class="rgw"} 1.25' in text
+
+    asyncio.run(run())
+
+
+def test_qos_profile_parse_and_scaling():
+    from ceph_tpu.osd.qos import (DEFAULT_PROFILE, parse_profile,
+                                  profile_bytes_per_s)
+
+    prof = parse_profile("client:0:100:0, gold:2:1:8\nbroken nums:a:b:c")
+    assert prof["client"] == (0.0, 100.0, 0.0)
+    assert prof["gold"] == (2.0, 1.0, 8.0)
+    assert "broken" not in prof and "nums" not in prof
+    bps = profile_bytes_per_s(prof)
+    assert bps["gold"] == (2.0 * (1 << 20), 1.0, 8.0 * (1 << 20))
+    # empty/garbage falls back to the shipped defaults
+    assert set(parse_profile("   ")) == set(parse_profile(DEFAULT_PROFILE))

@@ -42,6 +42,13 @@ if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
     JAX_PLATFORMS=cpu python -m ceph_tpu.osd.trace_bench --smoke \
         > /dev/null
     echo "cephlint: traced-op observability smoke passed" >&2
+    # qos-path smoke (round 17): a few hundred hub-multiplexed clients
+    # over real TCP through the unified dmClock admission -- the
+    # reservation-floor, thrash-exactly-once and fairness gates all
+    # stay armed at smoke shape and any violation exits nonzero
+    JAX_PLATFORMS=cpu python tools/ec_benchmark.py --workload qos-path \
+        --smoke > /dev/null
+    echo "cephlint: qos-path scale-harness smoke passed" >&2
     # multichip dryrun on simulated devices: jax_num_cpu_devices where
     # the jax supports it, the XLA_FLAGS device-count override otherwise
     JAX_PLATFORMS=cpu \

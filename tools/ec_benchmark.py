@@ -39,7 +39,16 @@ def parse_args(argv):
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
-                            "recovery-path", "mesh-path", "trace-path"])
+                            "recovery-path", "mesh-path", "trace-path",
+                            "qos-path"])
+    p.add_argument("--smoke", action="store_true",
+                   help="qos-path only: the fast CI shape (a few "
+                        "hundred clients, a few seconds per sub-stage) "
+                        "instead of the full >=1000-client acceptance "
+                        "run")
+    p.add_argument("--stages", default=None,
+                   choices=["overload", "chaos", "scale"],
+                   help="qos-path only: run a single sub-stage")
     p.add_argument("--mesh-sizes", default="1,2,4,8",
                    help="mesh-path only: comma-separated mesh device "
                         "counts to sweep")
@@ -169,6 +178,31 @@ def main(argv=None) -> int:
             f"{result['encode_GiBs']}", file=sys.stderr,
         )
         return 1 if result["steady_jit_retraces"] else 0
+
+    if args.workload == "qos-path":
+        # Unified-QoS scale stage (round 17): the loadgen harness over
+        # real TCP -- reservation-floor overload proof, thrash/rebuild
+        # chaos with the exactly-once audit, and the >=1000-client
+        # saturation run (--smoke shrinks every sub-stage; the gates
+        # stay armed and any violation raises -> nonzero exit, which is
+        # how tools/ci_lint.sh consumes it).
+        import json
+
+        from ceph_tpu.osd.qos_bench import run_qos_path_bench
+
+        result = run_qos_path_bench(smoke=args.smoke, stages=args.stages)
+        print(json.dumps(result))
+        print(
+            f"qos-path{' (smoke)' if args.smoke else ''}: "
+            f"{result.get('qos_path_clients', '?')} clients, saturation "
+            f"p99 {result.get('qos_path_saturation_p99_ms', '?')}ms, "
+            f"reservation ratio "
+            f"{result.get('qos_path_reservation_ratio', '?')}, fairness "
+            f"spread {result.get('qos_path_fairness_spread_max', '?')}, "
+            f"cas exact {result.get('qos_path_cas_exact', '?')}",
+            file=sys.stderr,
+        )
+        return 0
 
     k = int(profile.get("k", "0"))
     m = int(profile.get("m", "0"))
