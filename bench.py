@@ -638,6 +638,27 @@ def main() -> int:
 
     qp_host = _staged("qos_path_host", _qos_path_host)
 
+    def _telemetry_path_host():
+        """Round-18 observability gate: the wire-fed telemetry plane
+        (ceph_tpu/mgr/{report,pgmap,telemetry_bench}.py).  Three gates,
+        every one raising on violation: (1) the MgrClient report loop
+        (beacon + MgrReport frames at 5-10x the default duty cycle)
+        costs <= 3% on the storage-path workload vs reports-off;
+        (2) the aggregated mgr exposition scrape-parses back to the
+        PGMap's own ceph_degraded_objects + io-rate numbers; (3) a
+        mid-run OSD wipe under concurrent real-TCP client load raises
+        PG_DEGRADED with a nonzero degraded count that drains
+        monotonically to HEALTH_OK via the round-14 recovery plane --
+        health derived ONLY from wire-fed frames, never in-process."""
+        from ceph_tpu.mgr.telemetry_bench import run_telemetry_bench
+
+        return run_telemetry_bench(
+            n_objects=48, obj_bytes=16 << 10, writers=8, iters=2,
+            overhead_limit_pct=3.0,
+        )
+
+    tm_host = _staged("telemetry_path_host", _telemetry_path_host)
+
     def _lint_stage():
         """Static-health trend metrics: unsuppressed cephlint findings
         across ceph_tpu/tools/tests (tools/cephlint.py --format json) as
@@ -777,6 +798,18 @@ def main() -> int:
         "qos_path_cas_exact": (
             qp_host["qos_path_cas_exact"] if qp_host else None),
         "qos_path_host": qp_host,
+        # wire-fed telemetry plane (round 18): leaving the report loop
+        # ON must cost nothing measurable, and the chaos health gate +
+        # exposition roundtrip must hold
+        "telemetry_overhead_pct": (
+            tm_host["telemetry_overhead_pct"] if tm_host else None),
+        "telemetry_degraded_max": (
+            tm_host["chaos"]["degraded_max"] if tm_host else None),
+        "telemetry_health_final": (
+            tm_host["chaos"]["health_final"] if tm_host else None),
+        "telemetry_scrape_series": (
+            tm_host["scrape"]["series_parsed"] if tm_host else None),
+        "telemetry_path_host": tm_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
             lint_stage["by_rule"] if lint_stage else None),
@@ -841,7 +874,12 @@ def main() -> int:
         f"{qp_host['qos_path_clients'] if qp_host else '?'} clients at "
         f"p99 {qp_host['qos_path_saturation_p99_ms'] if qp_host else '?'}"
         f"ms (reservation ratio "
-        f"{qp_host['qos_path_reservation_ratio'] if qp_host else '?'}) on "
+        f"{qp_host['qos_path_reservation_ratio'] if qp_host else '?'}), "
+        f"telemetry overhead "
+        f"{tm_host['telemetry_overhead_pct'] if tm_host else '?'}% "
+        f"(chaos degraded peak "
+        f"{tm_host['chaos']['degraded_max'] if tm_host else '?'} -> "
+        f"{tm_host['chaos']['health_final'] if tm_host else '?'}) on "
         f"{jax.devices()[0].platform}",
         file=sys.stderr,
     )

@@ -65,6 +65,29 @@ async def serve(args) -> None:
     # silence (Monitor.start_tick), so a killed leader is replaced
     mon.start_tick(interval=0.25)
 
+    # mgr telemetry: mons beacon + report like every daemon (MON_DOWN
+    # derives from beacon staleness; the lag probe attributes a wedged
+    # mon event loop).  Report payload is the mon's own state summary.
+    from ceph_tpu.mgr.report import ReportSender, mgr_targets_from
+    from ceph_tpu.mgr.report import REPORT_SCHEMA_VERSION
+
+    reporter = None
+    mgr_targets = mgr_targets_from(addr_map)
+    if mgr_targets:
+        def mon_stats():
+            return {
+                "v": REPORT_SCHEMA_VERSION,
+                "kind": "mon",
+                "rank": mon.rank,
+                "is_leader": mon.is_leader(),
+                "election_epoch": mon.election_epoch,
+                "osdmap_epoch": mon.osdmap.epoch,
+                "perf": {},
+            }
+
+        reporter = ReportSender(name, messenger, mon_stats, mgr_targets)
+        reporter.start()
+
     async def bootstrap():
         # every rank proposes until SOME leader is known, staggered so
         # the lowest live rank usually wins first (Elector probing): a
@@ -89,6 +112,8 @@ async def serve(args) -> None:
     loop.add_signal_handler(signal.SIGTERM, _stop)
     loop.add_signal_handler(signal.SIGINT, _stop)
     await stop
+    if reporter is not None:
+        reporter.stop()
     if asok is not None:
         await asok.stop()
     await messenger.shutdown()

@@ -271,6 +271,19 @@ async def serve(args) -> None:
         # so this branch may yield -- it sits OUTSIDE the section.)
         await _mon_integrate(args, shard, messenger, addr_map,
                              len(mon_ranks))
+    # MgrClient report loop (ceph_tpu/mgr/report.py): when the address
+    # map names mgr daemons, beacon + report frames flow to every one
+    # of them -- cluster health/status/pg-stat over real TCP derive
+    # from THESE frames, never from in-process introspection.  No mgr
+    # in the map = telemetry off, zero overhead (the bench baseline).
+    from ceph_tpu.mgr.report import ReportSender, mgr_targets_from
+
+    reporter = None
+    mgr_targets = mgr_targets_from(addr_map)
+    if mgr_targets:
+        reporter = ReportSender(name, messenger, shard.mgr_report_stats,
+                                mgr_targets, perf=shard.perf)
+        reporter.start()
     # admin socket (src/common/admin_socket.cc): perf dump / ops /
     # config show / status over a unix socket next to the data dir
     asok = None
@@ -409,6 +422,8 @@ async def serve(args) -> None:
     for sig in (signal.SIGTERM, signal.SIGINT):
         loop.add_signal_handler(sig, stop.set)
     await stop.wait()
+    if reporter is not None:
+        reporter.stop()
     if asok is not None:
         await asok.stop()
     await messenger.shutdown()

@@ -92,6 +92,17 @@ class ScenarioResult:
     kills: int
     wipes: int
     qos_counters: Dict[str, int]
+    #: wire-fed telemetry gate fields (telemetry=True runs an mgr
+    #: endpoint fed by per-OSD ReportSenders over the same real TCP and
+    #: samples cluster health during the run): the degraded-objects
+    #: series around a chaos wipe, its peak, whether it drained
+    #: monotonically (bounded transient upticks from concurrent load),
+    #: and the final health status
+    health_timeline: List[tuple] = dataclasses.field(default_factory=list)
+    degraded_max: int = 0
+    degraded_final: int = 0
+    degraded_monotonic_violations: int = 0
+    health_final: str = ""
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -125,7 +136,8 @@ class ScenarioRunner:
     def __init__(self, scenario: Scenario, *, n_osds: int = 6,
                  k: int = 2, m: int = 1, op_queue: str = "mclock",
                  pool: str = "lgpool", op_timeout: float = 20.0,
-                 tuning: Optional[Dict[str, object]] = None):
+                 tuning: Optional[Dict[str, object]] = None,
+                 telemetry: bool = False):
         # at scale the probe grace must sit ABOVE the loaded op p50:
         # a probe tears down the hub's SHARED connection to re-test the
         # wire, so a grace below typical queueing latency makes every
@@ -135,6 +147,15 @@ class ScenarioRunner:
         # ``tuning``; chaos failover then costs ~grace to detect, which
         # is the honest price of not lying to the failure detector.
         self.tuning = dict(self.TUNING)
+        self.telemetry = telemetry
+        if telemetry:
+            # wire-fed health must react on the chaos time scale
+            self.tuning.update({
+                "mgr_beacon_interval": 0.1,
+                "mgr_report_interval": 0.2,
+                "mgr_daemon_beacon_grace": 1.0,
+                "mgr_pg_stale_grace": 2.0,
+            })
         if tuning:
             self.tuning.update(tuning)
         self.scenario = scenario
@@ -156,6 +177,10 @@ class ScenarioRunner:
         self.perf = None
         self.placement = None
         self.ec = None
+        self.mgr = None
+        self._mgr_messenger = None
+        self._reporters: List[object] = []
+        self._health_samples: List[tuple] = []
 
     # -- cluster lifecycle --------------------------------------------------
 
@@ -182,11 +207,14 @@ class ScenarioRunner:
         km = self.ec.get_chunk_count()
         n_clients = sum(g.count for g in self.scenario.groups)
         n_hubs = min(MAX_HUBS, max(1, -(-n_clients // HUB_FANOUT)))
-        ports = free_ports(self.n_osds + n_hubs)
+        n_mgrs = 1 if self.telemetry else 0
+        ports = free_ports(self.n_osds + n_hubs + n_mgrs)
         addr = {f"osd.{i}": ("127.0.0.1", ports[i])
                 for i in range(self.n_osds)}
         for h in range(n_hubs):
             addr[f"lg{h}"] = ("127.0.0.1", ports[self.n_osds + h])
+        if n_mgrs:
+            addr["mgr.0"] = ("127.0.0.1", ports[self.n_osds + n_hubs])
         self.placement = CrushPlacement(self.n_osds, km)
         for i in range(self.n_osds):
             mess = TCPMessenger(f"osd.{i}", addr, fault=FaultInjector())
@@ -201,6 +229,22 @@ class ScenarioRunner:
             shard.start_tick(0.25)
             self.osd_messengers.append(mess)
             self.osds.append(shard)
+        if self.telemetry:
+            # the wire-fed telemetry plane rides the SAME real TCP: one
+            # mgr endpoint, every OSD running its MgrClient report loop
+            from ceph_tpu.mgr.pgmap import MgrServer
+            from ceph_tpu.mgr.report import ReportSender
+
+            self._mgr_messenger = TCPMessenger("mgr.0", addr)
+            await self._mgr_messenger.start()
+            self.mgr = MgrServer("mgr.0", self._mgr_messenger,
+                                 addr_map=addr)
+            for shard, mess in zip(self.osds, self.osd_messengers):
+                sender = ReportSender(shard.name, mess,
+                                      shard.mgr_report_stats, ["mgr.0"],
+                                      perf=shard.perf)
+                sender.start()
+                self._reporters.append(sender)
         for h in range(n_hubs):
             hub = TCPMessenger(f"lg{h}", addr, fault=FaultInjector())
             await hub.start()
@@ -238,7 +282,14 @@ class ScenarioRunner:
     async def shutdown(self) -> None:
         from ceph_tpu.utils.config import get_config
 
-        for mess in self.hubs + self.osd_messengers:
+        for sender in self._reporters:
+            sender.stop()
+        if self.mgr is not None:
+            await self.mgr.stop()
+        messengers = self.hubs + self.osd_messengers
+        if self._mgr_messenger is not None:
+            messengers.append(self._mgr_messenger)
+        for mess in messengers:
             await mess.shutdown()
         if self._prior_cfg:
             get_config().apply_changes(self._prior_cfg)
@@ -252,6 +303,11 @@ class ScenarioRunner:
         osd = self.osds[idx]
         mess = self.osd_messengers[idx]
         osd.frozen = True
+        if self._reporters:
+            # a dead daemon must stop beaconing, or the wire-fed map
+            # would keep reading it as alive (outbound sends still
+            # work after the listener teardown below)
+            self._reporters[idx].stop()
         if mess._server is not None:
             mess._server.close()
         for conn in list(mess._conns.values()):
@@ -270,6 +326,8 @@ class ScenarioRunner:
         await mess.start()
         osd.frozen = False
         mess.mark_up(osd.name)
+        if self._reporters:
+            self._reporters[idx].start()
         for shard in self.osds:
             shard.request_peering()
 
@@ -279,6 +337,26 @@ class ScenarioRunner:
         from ceph_tpu.osd.types import Transaction
 
         osd = self.osds[idx]
+        # degraded accounting, event time: the lost holdings land on
+        # their primaries' incremental pg_stats BEFORE the store
+        # empties, so the wire-fed map shows PG_DEGRADED immediately
+        # and drains as the batched rebuild completes objects
+        for stored in osd.store.list_objects():
+            base, _, _tag = stored.rpartition("@")
+            if not base:
+                continue
+            for other in self.osds:
+                b = other.pools.get(self.pool)
+                if b is None:
+                    continue
+                acting = b.acting_set(base)
+                for s in range(b.km):
+                    if b._shard_up(acting, s):
+                        self.osds[acting[s]].pools[
+                            self.pool].pg_stats.note_down_victims(
+                            f"wipe:{osd.name}", [base])
+                        break
+                break
         txn = Transaction()
         for stored in osd.store.list_objects():
             txn.remove(stored)
@@ -331,10 +409,26 @@ class ScenarioRunner:
 
     # -- the run ------------------------------------------------------------
 
+    async def _health_sampler(self, stop: asyncio.Event) -> None:
+        """Sample the wire-fed map during the run (telemetry=True): the
+        chaos gate's degraded-drain series comes from here."""
+        loop = asyncio.get_event_loop()
+        t0 = loop.time()
+        while not stop.is_set():
+            await asyncio.sleep(0.2)
+            health = self.mgr.pgmap.health()
+            degraded = self.mgr.pgmap.totals()["degraded"]
+            self._health_samples.append(
+                (round(loop.time() - t0, 3), health["status"], degraded))
+
     async def run(self) -> ScenarioResult:
         stop = asyncio.Event()
         chaos = asyncio.get_event_loop().create_task(
             self._chaos_task(stop))
+        sampler = None
+        if self.mgr is not None:
+            sampler = asyncio.get_event_loop().create_task(
+                self._health_sampler(stop))
         t0 = time.perf_counter()
         drivers = [
             asyncio.get_event_loop().create_task(client.run(stop))
@@ -354,6 +448,22 @@ class ScenarioRunner:
         for i, osd in enumerate(self.osds):
             if osd.frozen:
                 await self._revive_osd(i)
+        if sampler is not None:
+            # keep sampling the drain until the map reads clean (or a
+            # bounded settle window expires): the health gate asserts
+            # wipe -> degraded>0 -> monotone drain -> HEALTH_OK
+            drain_stop = asyncio.Event()
+            sampler2 = asyncio.get_event_loop().create_task(
+                self._health_sampler(drain_stop))
+            deadline = time.perf_counter() + max(20.0, self.op_timeout)
+            while time.perf_counter() < deadline:
+                await asyncio.sleep(0.25)
+                if self.mgr.pgmap.totals()["degraded"] == 0 and \
+                        self.mgr.pgmap.health()["status"] == "HEALTH_OK":
+                    break
+            drain_stop.set()
+            await sampler2
+            await sampler
         return await self._collect(wall)
 
     # -- results ------------------------------------------------------------
@@ -406,6 +516,21 @@ class ScenarioRunner:
             for key, val in osd.perf.snapshot().items():
                 if key.startswith("qos_") and isinstance(val, int):
                     qos_counters[key] = qos_counters.get(key, 0) + val
+        samples = list(self._health_samples)
+        degraded_series = [d for _, _, d in samples]
+        degraded_max = max(degraded_series, default=0)
+        violations = 0
+        if degraded_max:
+            # monotone-drain check from the peak: concurrent client
+            # writes against a half-rebuilt object can re-dirty it, so
+            # bounded transient upticks are tolerated by the caller --
+            # the count is reported, the gate decides
+            peak_at = degraded_series.index(degraded_max)
+            prev = degraded_max
+            for d in degraded_series[peak_at:]:
+                if d > prev:
+                    violations += 1
+                prev = d
         return ScenarioResult(
             scenario=self.scenario.name,
             wall_s=round(wall, 3),
@@ -429,6 +554,12 @@ class ScenarioRunner:
             kills=self.kills,
             wipes=self.wipes,
             qos_counters=qos_counters,
+            health_timeline=samples,
+            degraded_max=degraded_max,
+            degraded_final=degraded_series[-1] if degraded_series else 0,
+            degraded_monotonic_violations=violations,
+            health_final=(self.mgr.pgmap.health()["status"]
+                          if self.mgr is not None else ""),
         )
 
     async def _audit_exactly_once(self) -> Tuple[int, int, int]:

@@ -16,6 +16,10 @@ python modules (prometheus, status, ...).  Same roles here:
 from ceph_tpu.mgr.mgr import ClusterState, MgrDaemon, health_checks, \
     prometheus_text
 from ceph_tpu.mgr.module_host import MgrModule, PyModuleRegistry
+from ceph_tpu.mgr.pgmap import MgrServer, PGMap
+from ceph_tpu.mgr.report import (LoopLagProbe, MgrBeacon, MgrReport,
+                                 ReportSender)
 
 __all__ = ["ClusterState", "MgrDaemon", "health_checks", "prometheus_text",
-           "MgrModule", "PyModuleRegistry"]
+           "MgrModule", "PyModuleRegistry", "PGMap", "MgrServer",
+           "MgrBeacon", "MgrReport", "ReportSender", "LoopLagProbe"]

@@ -7,7 +7,15 @@ from typing import Dict, List, Optional
 
 
 class ClusterState:
-    """Aggregated cluster view (PGMap / DaemonStateIndex role)."""
+    """Aggregated cluster view (PGMap / DaemonStateIndex role).
+
+    Round-18 contract: every per-scrape read here is O(daemons +
+    degraded), NEVER O(objects).  Store totals come from the object
+    stores' incremental counters, degraded accounting from the per-PG
+    ``pg_stats`` trackers maintained at the mutation / liveness /
+    recovery seams (osd/pg_stats.py).  The old full-object census
+    survives only behind ``degraded_objects(deep=True)`` as the verify
+    path (``rados_cli health detail --deep`` role)."""
 
     def __init__(self, cluster):
         self.cluster = cluster  # ECCluster
@@ -15,22 +23,14 @@ class ClusterState:
     def osd_stats(self) -> Dict[str, dict]:
         out = {}
         for osd in self.cluster.osds:
-            store = osd.store
-            objects = store.list_objects()
-            used = 0
-            for oid in objects:
-                try:
-                    used += store.stat(oid)
-                except FileNotFoundError:
-                    pass
+            store_stats = osd.store.stats()
             tier = getattr(osd, "tier", None)
             out[osd.name] = {
                 "up": not self.cluster.messenger.is_down(osd.name),
-                "num_shards": len(objects),
-                "bytes_used": used,
+                "num_shards": store_stats["objects"],
+                "bytes_used": store_stats["bytes"],
                 "perf": osd.perf.snapshot(),
-                "ops_in_flight":
-                    osd.optracker.dump_ops_in_flight()["num_ops"],
+                "ops_in_flight": osd.optracker.num_inflight(),
                 # device cache-tier residency (budget + hit/miss ride
                 # along so /metrics can expose them as gauges)
                 "tier": tier.status() if tier is not None else None,
@@ -39,21 +39,41 @@ class ClusterState:
 
     def pool_stats(self) -> dict:
         b = self.cluster.backend
-        oids = set()
+        shards = metas = 0
         for osd in self.cluster.osds:
-            for soid in osd.store.list_objects():
-                oids.add(soid.rsplit("@", 1)[0])
+            st = osd.store.stats()
+            shards += st["shards"]
+            metas += st["metas"]
         ec = self.cluster.ec
+        km = b.km or 1
         return {
-            "num_objects": len(oids),
-            "k": ec.get_data_chunk_count(),
-            "m": ec.get_chunk_count() - ec.get_data_chunk_count(),
+            # shard-derived estimate: every logical object stores km
+            # shard copies (EC chunks or full replicas) and a meta twin
+            # replicates km ways, so distinct objects ~= shards/km +
+            # metas/km.  An object holding BOTH data and omap counts
+            # twice here -- the honest price of never walking stores on
+            # the scrape path (the exact census is degraded-path-only,
+            # behind deep=True).
+            "num_objects": shards // km + metas // km,
+            "k": (km if ec is None else ec.get_data_chunk_count()),
+            "m": (0 if ec is None
+                  else ec.get_chunk_count() - ec.get_data_chunk_count()),
             "client_perf": b.perf.snapshot(),
         }
 
-    def degraded_objects(self) -> List[str]:
-        """Objects with at least one shard on a down/unmapped OSD
-        (the PG_DEGRADED accounting role)."""
+    def degraded_objects(self, deep: bool = False) -> List[str]:
+        """Objects currently degraded (the PG_DEGRADED accounting role).
+
+        Default: union of the hosted engines' incremental pg_stats
+        sets -- O(degraded) per call.  ``deep=True`` runs the original
+        full acting-set scan over every stored object as an audit/verify
+        pass (O(objects x shards); never on the scrape path)."""
+        if not deep:
+            out: set = set()
+            for osd in self.cluster.osds:
+                for backend in osd.pools.values():
+                    out |= backend.pg_stats.degraded_oids()
+            return sorted(out)
         b = self.cluster.backend
         degraded = []
         oids = sorted({
@@ -114,13 +134,9 @@ def health_checks(state: dict) -> dict:
             "summary":
                 f"{len(inconsistent)} objects have scrub inconsistencies",
         }
-    status = "HEALTH_OK"
-    for c in checks.values():
-        if c["severity"] == "HEALTH_ERR":
-            status = "HEALTH_ERR"
-            break
-        status = "HEALTH_WARN"
-    return {"status": status, "checks": checks}
+    from ceph_tpu.mgr.pgmap import fold_health
+
+    return fold_health(checks)
 
 
 def prometheus_text(state: dict) -> str:

@@ -146,17 +146,13 @@ class PyModuleRegistry:
         """Cluster health = base checks merged with every module's
         raised checks (ClusterState::update + module health).  Pass an
         already-computed ``dump`` to avoid a second full state walk."""
+        from ceph_tpu.mgr.pgmap import fold_health
+
         base = health_checks(dump if dump is not None else self.state.dump())
         checks = dict(base["checks"])
         for mod in self.modules.values():
             checks.update(mod._health)
-        status = "HEALTH_OK"
-        for c in checks.values():
-            if c["severity"] == "HEALTH_ERR":
-                status = "HEALTH_ERR"
-                break
-            status = "HEALTH_WARN"
-        return {"status": status, "checks": checks}
+        return fold_health(checks)
 
     def notify_all(self, what: str, ident=None) -> None:
         for mod in self.modules.values():

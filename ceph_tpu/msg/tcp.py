@@ -328,6 +328,7 @@ class TCPMessenger:
             "msgs_sent": 0, "frames_sent": 0, "bursts": 0, "drains": 0,
             "bytes_sent": 0, "acks_piggybacked": 0, "acks_standalone": 0,
             "acks_elided": 0, "acks_piggybacked_recv": 0,
+            "unknown_msg_dropped": 0,
         }
         #: ack-lag attribution (observability): enqueue -> delivery-ack
         #: latency per pruned message, a prometheus histogram family
@@ -632,7 +633,17 @@ class TCPMessenger:
                     continue  # duplicate from a replay: already delivered
                 self._in_seqs[in_key] = seq
                 # cephlint: end-atomic-section
-            msg = decode_message(body)
+            try:
+                msg = decode_message(body)
+            except ValueError:
+                # a frame kind this build does not know (a NEWER peer's
+                # message type -- e.g. mgr report frames reaching a
+                # pre-report daemon): the watermark already advanced, so
+                # ignore-and-count is exactly "old daemon ignores report
+                # frames" forward compat; tearing the connection down
+                # here would make every protocol addition a flag day
+                self.counters["unknown_msg_dropped"] += 1
+                continue
             queue = self._local_queues.get(dst)
             if queue is not None and dst not in self._marked_down:
                 if isinstance(msg, dict) and msg.get("op") == "client_op":

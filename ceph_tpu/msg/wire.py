@@ -15,6 +15,7 @@ from __future__ import annotations
 
 from typing import Tuple
 
+from ceph_tpu.mgr.report import MgrBeacon, MgrReport
 from ceph_tpu.osd.types import (
     ECSubRead,
     ECSubReadReply,
@@ -32,6 +33,11 @@ _MSG_EC_SUB_WRITE = 1
 _MSG_EC_SUB_WRITE_REPLY = 2
 _MSG_EC_SUB_READ = 3
 _MSG_EC_SUB_READ_REPLY = 4
+# mgr telemetry frames (MMgrBeacon / MMgrReport+MPGStats roles); peers
+# that predate them drop unknown kinds at the transport (msg/tcp.py
+# counts unknown_msg_dropped) instead of tearing the connection down
+_MSG_MGR_BEACON = 5
+_MSG_MGR_REPORT = 6
 
 
 def encode_transaction(enc: Encoder, txn: Transaction) -> None:
@@ -117,6 +123,16 @@ def message_encoder(msg: object) -> Encoder:
         )
         enc.value(msg.attrs_read)
         enc.value(msg.errors)
+    elif isinstance(msg, MgrBeacon):
+        enc.u8(_MSG_MGR_BEACON)
+        enc.string(msg.name).varint(msg.seq)
+        enc.value(msg.lag_ms)
+    elif isinstance(msg, MgrReport):
+        enc.u8(_MSG_MGR_REPORT)
+        enc.string(msg.name).varint(msg.seq)
+        enc.value(msg.interval)
+        enc.value(msg.stats)
+        enc.value(msg.lag_ms)
     else:
         enc.u8(_MSG_VALUE)
         enc.value(msg)
@@ -180,5 +196,19 @@ def decode_message(data: bytes) -> object:
             from_shard=dec.varint(), tid=dec.varint(),
             buffers_read=dec.value(), attrs_read=dec.value(),
             errors=dec.value(),
+        )
+    if kind == _MSG_MGR_BEACON:
+        return MgrBeacon(
+            name=dec.string(), seq=dec.varint(),
+            # cephlint: wire-optional -- pre-lag senders end at the seq
+            lag_ms=dec.value() if dec.remaining() else None,
+        )
+    if kind == _MSG_MGR_REPORT:
+        return MgrReport(
+            name=dec.string(), seq=dec.varint(),
+            interval=dec.value(), stats=dec.value(),
+            # cephlint: wire-optional -- pre-lag senders end at the
+            # stats payload
+            lag_ms=dec.value() if dec.remaining() else None,
         )
     raise ValueError(f"unknown message type {kind}")

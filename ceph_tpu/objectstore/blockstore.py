@@ -47,11 +47,12 @@ from typing import Dict, List, Optional
 from ceph_tpu import compressor as compressor_mod
 from ceph_tpu.kv import lsm as lsm_mod
 from ceph_tpu.kv.keyvaluedb import KVTransaction
+from ceph_tpu.objectstore.statfs import ScanStatsMixin
 from ceph_tpu.osd.types import Transaction
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 
-class BlockStore:
+class BlockStore(ScanStatsMixin):
     def __init__(self, path: str, alloc_unit: int = 64 * 1024,
                  deferred_threshold: int = 32 * 1024,
                  compression: Optional[str] = None):
@@ -408,6 +409,7 @@ class BlockStore:
             self._dev.flush()
             self.db.submit_transaction(cleanup)
         self._free.update(freed)
+        self._stats_invalidate()
 
     # -- reads -------------------------------------------------------------
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 import os
 from typing import Dict, List, Optional
 
+from ceph_tpu.objectstore.statfs import ScanStatsMixin
 from ceph_tpu.osd.types import Transaction, TxnOp
 from ceph_tpu.utils.encoding import Decoder, Encoder, frame, unframe
 
@@ -82,7 +83,7 @@ def _decode_txn(payload: bytes):
     return seq, txn
 
 
-class FileStore:
+class FileStore(ScanStatsMixin):
     def __init__(self, path: str, journal_trim_bytes: int = 8 << 20):
         self.path = path
         self.journal_trim_bytes = journal_trim_bytes
@@ -139,6 +140,7 @@ class FileStore:
         self._write_committed()
         if self._journal.tell() > self.journal_trim_bytes:
             self._trim_journal()
+        self._stats_invalidate()
 
     def _write_committed(self) -> None:
         tmp = self._committed_path + ".tmp"

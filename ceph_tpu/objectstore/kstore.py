@@ -17,11 +17,12 @@ from typing import Dict, List, Optional
 
 from ceph_tpu import kv as kv_mod
 from ceph_tpu.kv.keyvaluedb import KeyValueDB, KVTransaction
+from ceph_tpu.objectstore.statfs import ScanStatsMixin
 from ceph_tpu.osd.types import Transaction
 from ceph_tpu.utils.encoding import Decoder, Encoder
 
 
-class KStore:
+class KStore(ScanStatsMixin):
     def __init__(self, path: str, db: Optional[KeyValueDB] = None,
                  stripe_size: int = 64 * 1024):
         self.stripe_size = stripe_size
@@ -195,6 +196,7 @@ class KStore:
                 else:
                     batch.set("O", self._omap_key(oid, k), v)
         self.db.submit_transaction(batch, sync=True)
+        self._stats_invalidate()
 
     # -- reads (MemStore API) ----------------------------------------------
 

@@ -249,8 +249,42 @@ class ECCluster:
         for osd in self.osds:
             osd.request_peering()
 
+    def _primary_backend_for(self, pool: str, oid: str):
+        """The hosted engine currently leading ``oid`` in ``pool``
+        (None while no up OSD can lead it)."""
+        for osd in self.osds:
+            b = osd.pools.get(pool)
+            if b is None:
+                continue
+            acting = b.acting_set(oid)
+            for s in range(b.km):
+                if b._shard_up(acting, s):
+                    return self.osds[acting[s]].pools.get(pool)
+            return None
+        return None
+
+    def _mark_down_victims(self, osd_id: int, reason: str) -> None:
+        """Liveness-event degraded accounting: walk the victim OSD's
+        holdings ONCE (event time, never scrape time) and record each
+        base object on its current primary's incremental pg_stats.
+        This is what keeps ``ClusterState.degraded_objects()`` O(degraded)
+        per call -- the per-object census happens only when an OSD
+        actually dies or loses its disk."""
+        from ceph_tpu.osd.pg import POOL_KEY
+
+        osd = self.osds[osd_id]
+        for stored in osd.store.list_objects():
+            base, _, tag = stored.rpartition("@")
+            if not base:
+                continue
+            pool = osd.store.getattr(stored, POOL_KEY) or self.pool
+            primary = self._primary_backend_for(pool, base)
+            if primary is not None:
+                primary.pg_stats.note_down_victims(reason, [base])
+
     def kill_osd(self, osd_id: int) -> None:
         self.messenger.mark_down(f"osd.{osd_id}")
+        self._mark_down_victims(osd_id, f"osd.{osd_id}")
         self._notify_peering()
 
     def wipe_osd(self, osd_id: int) -> None:
@@ -265,6 +299,10 @@ class ECCluster:
         from ceph_tpu.osd.types import Transaction
 
         osd = self.osds[osd_id]
+        # the lost holdings become degraded the moment the disk is
+        # swapped (recorded BEFORE the store empties; cleared per object
+        # as recovery completes, so the count drains monotonically)
+        self._mark_down_victims(osd_id, f"wipe:osd.{osd_id}")
         txn = Transaction()
         for stored in osd.store.list_objects():
             txn.remove(stored)
@@ -281,6 +319,12 @@ class ECCluster:
 
     def revive_osd(self, osd_id: int) -> None:
         self.messenger.mark_up(f"osd.{osd_id}")
+        # the revived OSD's copies are back: drop exactly the degraded
+        # markings its death caused (wipe markings stay -- that data is
+        # genuinely gone until recovery rebuilds it)
+        for osd in self.osds:
+            for backend in osd.pools.values():
+                backend.pg_stats.clear_down_reason(f"osd.{osd_id}")
         self._notify_peering()
 
     def out_osd(self, osd_id: int) -> None:

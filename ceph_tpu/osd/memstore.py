@@ -24,6 +24,13 @@ class MemStore:
     def __init__(self):
         self._objects: Dict[str, MemObject] = {}
         self._lock = threading.Lock()
+        # incremental usage totals (the ObjectStore statfs role):
+        # maintained at the transaction swap so stats() is O(1) -- the
+        # mgr report loop reads it every interval and MUST NOT pay
+        # O(objects) per report (tests/test_telemetry.py pins this)
+        self._n_shards = 0
+        self._n_metas = 0
+        self._bytes = 0
 
     # -- transactions ------------------------------------------------------
 
@@ -80,10 +87,23 @@ class MemStore:
                 else:
                     raise ValueError(f"unknown op {op.op}")
             for oid, obj in staged.items():
+                prior = self._objects.get(oid)
+                is_meta = oid.endswith("@meta")
+                if prior is not None:
+                    self._bytes -= len(prior.data)
+                    if is_meta:
+                        self._n_metas -= 1
+                    else:
+                        self._n_shards -= 1
                 if obj is None:
                     self._objects.pop(oid, None)
                 else:
                     self._objects[oid] = obj
+                    self._bytes += len(obj.data)
+                    if is_meta:
+                        self._n_metas += 1
+                    else:
+                        self._n_shards += 1
 
     # -- reads -------------------------------------------------------------
 
@@ -127,6 +147,18 @@ class MemStore:
     def list_objects(self) -> List[str]:
         with self._lock:
             return sorted(self._objects.keys())
+
+    def stats(self) -> Dict[str, int]:
+        """O(1) usage totals (statfs role): stored names split into
+        data/parity shard objects ("oid@N") and replicated meta twins
+        ("oid@meta"), plus total data bytes."""
+        with self._lock:
+            return {
+                "objects": self._n_shards + self._n_metas,
+                "shards": self._n_shards,
+                "metas": self._n_metas,
+                "bytes": self._bytes,
+            }
 
     # test hook: corrupt a byte (scrub/EIO-path tests)
     def corrupt(self, oid: str, offset: int) -> None:

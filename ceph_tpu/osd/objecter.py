@@ -32,6 +32,7 @@ from __future__ import annotations
 
 import asyncio
 import itertools
+import os
 import random
 from typing import Dict, List, Optional
 
@@ -54,7 +55,12 @@ _EXCEPTIONS = {
 #: Two Objecters sharing a name (client restart, parallel harnesses)
 #: must never mint colliding reqids -- the incarnation tie-breaks, the
 #: role of the client's global_id + inc in the reference osd_reqid_t.
-_INCARNATIONS = itertools.count(1)
+#: seeded with process-unique entropy: two PROCESSES sharing an entity
+#: name (sequential rados_cli runs) must not mint colliding reqids
+#: either, or the OSDs' replicated dup logs treat the second process's
+#: first mutation as a replay of the first's (exactly-once working
+#: exactly as designed against accidentally-identical ids)
+_INCARNATIONS = itertools.count(int.from_bytes(os.urandom(6), "big"))
 
 
 def deliver_notify_event(messenger, name: str, callbacks: Dict, src: str,
@@ -126,7 +132,16 @@ class Objecter:
         #: whose span (when sampled) roots the cross-daemon trace --
         #: dump_ops_in_flight/dump_historic_ops work client-side too
         self.optracker = OpTracker(perf=self.perf, name=name)
-        self._tid = 0
+        #: tid base: random 48-bit offset per Objecter.  Tids exist in
+        #: replies, sub-op frames and the lossless replay queues of
+        #: long-lived daemons; two client PROCESSES sharing an entity
+        #: name (rados_cli invocations against one vstart cluster) both
+        #: starting at tid 1 let a REPLAYED stale reply from the dead
+        #: process's session match the live process's pending op -- the
+        #: op was acked without ever executing (observed as rados_cli
+        #: put "succeeding" with no sub-writes anywhere).  A random
+        #: base makes cross-process tid collisions vanishingly rare.
+        self._tid = int.from_bytes(os.urandom(6), "big")
         #: reqid incarnation (osd_reqid_t role): (name, inc, tid)
         #: identifies each logical op across any number of resends
         self.incarnation = next(_INCARNATIONS)

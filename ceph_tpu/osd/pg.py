@@ -250,6 +250,9 @@ class PG:
         #: are per-OSD, so the watermark is too); peers whose dup head
         #: matches contribute zero dup traffic
         self._peer_dup_seq: Dict[str, int] = {}
+        #: last incarnation nonce seen per peer (OSDShard.boot_id): a
+        #: change invalidates both watermarks above -- see peering_pass
+        self._peer_boot: Dict[str, str] = {}
         #: the hosting OSD's PGLog (OSDShard.host_pool wires it): where
         #: peering-fetched dup entries are merged so THIS OSD, once
         #: promoted primary, answers replayed ops from the log.  None
@@ -260,6 +263,13 @@ class PG:
         self._dirty: set = set()
         #: replicated-metadata objects in the same state
         self._dirty_meta: set = set()
+        #: incremental per-PG statistics (pg_stat_t role): degraded /
+        #: misplaced / state bits maintained at the mutation, peering
+        #: and recovery-completion seams -- what MgrReport frames and
+        #: the mgr's ClusterState read instead of scanning stores
+        from ceph_tpu.osd.pg_stats import PGStats
+
+        self.pg_stats = PGStats(self)
         #: last inconsistent deep-scrub reports (ScrubStore role);
         #: cleared when a re-scrub comes back clean
         self.scrub_errors: Dict[str, dict] = {}
@@ -1735,6 +1745,26 @@ class PG:
             up_osds, {"op": "pg_log_info"}, timeout=3.0
         )
         self.perf.inc("peering_info_poll")
+        # incarnation check BEFORE any watermark is consulted (dup
+        # watermarks included): a peer whose boot_id changed is a
+        # RESTARTED process -- its log/dup sequence spaces are new, so
+        # our per-peer watermarks against the old incarnation are
+        # meaningless.  Reset them and force the backfill path; a
+        # memstore daemon revived empty would otherwise read as a
+        # "quiet peer" (head 0 <= watermark) and its lost shards would
+        # never be discovered (the multi-process wipe case).
+        restarted = False
+        for osd_name, info in infos.items():
+            bid = info.get("boot_id")
+            if bid is None:
+                continue  # pre-boot-id peer: legacy watermark rules
+            known = self._peer_boot.get(osd_name)
+            if known is not None and known != bid:
+                self._peer_seq.pop(osd_name, None)
+                self._peer_dup_seq.pop(osd_name, None)
+                restarted = True
+                self.perf.inc("peering_peer_restarted")
+            self._peer_boot[osd_name] = bid
         # reqid-dup exchange rides GetInfo (both the delta and backfill
         # flows pass through here): fetch peers' dup entries above our
         # per-peer watermark so a just-promoted primary answers replayed
@@ -1743,7 +1773,7 @@ class PG:
         candidates = set(self._dirty)
         meta_candidates = set(self._dirty_meta)
         pre_heads: Dict[str, int] = {}
-        need_backfill = backfill
+        need_backfill = backfill or restarted
         fetches = []
         for osd_name, info in infos.items():
             head, tail = info["head_seq"], info["tail_seq"]
@@ -1880,6 +1910,7 @@ class PG:
         success the per-peer watermarks jump to the pre-scan log heads, so
         subsequent passes are delta-driven again."""
         self.perf.inc("peering_backfill")
+        self.pg_stats.backfilling = True
         replies = await self._meta_roundtrip(
             up_osds, {"op": "pg_list"}, timeout=3.0
         )
@@ -1897,11 +1928,14 @@ class PG:
                     have.setdefault(base, {}).setdefault(shard, {})[
                         osd_name
                     ] = vt(tuple(ver))
-        n = await self._peering_apply(
-            have, meta, set(replies), max_active,
-            tracked=set(have) | self._dirty,
-            tracked_meta=set(meta) | self._dirty_meta,
-        )
+        try:
+            n = await self._peering_apply(
+                have, meta, set(replies), max_active,
+                tracked=set(have) | self._dirty,
+                tracked_meta=set(meta) | self._dirty_meta,
+            )
+        finally:
+            self.pg_stats.backfilling = False
         # entries at or below the pre-scan heads are covered by the scan
         for osd_name in replies:
             h = pre_heads.get(osd_name)
@@ -1974,6 +2008,15 @@ class PG:
                     continue
                 if cur is None and tuple(authoritative) == (0, ""):
                     continue  # absent object, absent copy: nothing to do
+                if cur is None and any(
+                    holder not in (f"osd.{acting[s]}",)
+                    for holder in shardmap.get(s, {})
+                ):
+                    # the acting slot lost the shard but a copy still
+                    # exists on a non-acting holder (remap leftover):
+                    # data is safe, just in the wrong place -- the
+                    # pg_stat_t misplaced (not degraded) distinction
+                    self.pg_stats.misplaced.add(oid)
                 actions.append(
                     (oid, s, acting[s], authoritative,
                      cur is not None and cur > authoritative)
@@ -2000,6 +2043,13 @@ class PG:
             if stale:
                 meta_actions.append((oid, stale))
 
+        # in-flight rebuild accounting: the action objects count as
+        # degraded from here until their recovery completes (the
+        # per-object note_recovered calls below and in osd/recovery.py
+        # drain the count monotonically while a rebuild runs)
+        action_oids = {a[0] for a in actions} | \
+            {m[0] for m in meta_actions}
+        self.pg_stats.note_recovering(action_oids)
         failed: set = set()
         if actions and self._use_batched_recovery():
             # the batched background data plane (osd/recovery.py):
@@ -2017,15 +2067,18 @@ class PG:
                         if rb and await self._try_log_rollback(
                             oid, s, target, authoritative
                         ):
+                            self.pg_stats.note_recovered(oid)
                             return
                         if tuple(authoritative) == (0, ""):
                             # no assemblable object behind the torn copy:
                             # nothing to reconstruct, just drop it
                             await self._remove_shard_copy(oid, s, target)
+                            self.pg_stats.note_recovered(oid)
                             return
                         await self.recover_shard(
                             oid, s, target, rollback=rb
                         )
+                        self.pg_stats.note_recovered(oid)
                     except asyncio.CancelledError:
                         raise
                     except Exception:  # noqa: BLE001 -- a failed recovery
@@ -2046,6 +2099,7 @@ class PG:
                             "version": ver, "omap": omap,
                             "remove": removed,
                         })
+                        self.pg_stats.note_recovered(oid)
                     except asyncio.CancelledError:
                         raise
                     except Exception:  # noqa: BLE001
@@ -2068,6 +2122,13 @@ class PG:
                 self._dirty_meta.add(oid)
             else:
                 self._dirty_meta.discard(oid)
+        # pg-stat epilogue mirroring the dirty maintenance: tracked
+        # objects that ended the pass clean drop their degraded
+        # markings (liveness victims included); unfinished ones stay
+        self.pg_stats.end_pass(
+            set(tracked) | set(tracked_meta) | action_oids,
+            unfinished | unfinished_meta | failed,
+        )
         self.perf.inc("peering_pass")
         return len(actions) + len(meta_actions)
 

@@ -304,6 +304,12 @@ class RecoveryCoalescer:
                     except Exception:  # noqa: BLE001 -- next pass retries
                         backend.perf.inc("recover_failed")
                         failed.add(oid)
+            # per-group completion tick: the incremental degraded count
+            # (pg_stats) drains as each batch lands, not at pass end --
+            # what makes the chaos gate's drain curve monotone
+            for oid in group:
+                if oid not in failed:
+                    backend.pg_stats.note_recovered(oid)
             await self.throttle.pace()
         return failed
 

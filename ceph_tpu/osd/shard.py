@@ -14,6 +14,7 @@ reference's OSD vs PG/PGBackend layering (src/osd/PGBackend.cc:533).
 from __future__ import annotations
 
 import asyncio
+import os
 import time
 from typing import Dict
 
@@ -76,6 +77,14 @@ class OSDShard:
 
         self.osd_id = osd_id
         self.name = f"osd.{osd_id}"
+        #: per-incarnation nonce (the reference's up_from epoch role):
+        #: carried in pg_log_info replies so peers detect a RESTARTED
+        #: daemon -- its in-memory log sequence space is new, so their
+        #: watermarks against the old incarnation are meaningless and
+        #: peering must take the backfill path (the multi-process
+        #: kill+revive wipe case: a memstore daemon revives empty with
+        #: head_seq 0, which would otherwise read as "quiet peer")
+        self.boot_id = os.urandom(8).hex()
         # reference ObjectStore::create (src/os/ObjectStore.cc:63): backend
         # chosen by name, data under the osd's own dir.  An empty data_path
         # propagates as-is so the factory rejects pathless persistent
@@ -520,6 +529,49 @@ class OSDShard:
             return 0
         return int(stats.get("promoted", 0))
 
+    def mgr_report_stats(self) -> dict:
+        """The MgrReport payload for this daemon (mgr/report.py schema).
+
+        Everything here is O(counters): store totals are maintained
+        incrementally by the object stores, per-PG degraded/misplaced
+        counts by the pg_stats seams -- building a report NEVER walks
+        the object store (the regression tests/test_telemetry.py pins).
+        """
+        from ceph_tpu.mgr.report import (REPORT_SCHEMA_VERSION,
+                                         filter_counters)
+        from ceph_tpu.utils.perf import histogram_marginals
+
+        tier = self.tier.status()
+        stats = {
+            "v": REPORT_SCHEMA_VERSION,
+            "kind": "osd",
+            "boot_id": self.boot_id,
+            "store": dict(self.store.stats()),
+            "perf": filter_counters(self.perf.snapshot()),
+            "pgs": {
+                pool: backend.pg_stats.pg_stat()
+                for pool, backend in self.pools.items()
+            },
+            "ops_in_flight": self.optracker.num_inflight(),
+            # scalar tier residency only (the full per-object listing
+            # stays an admin-socket affair)
+            "tier": {key: tier[key] for key in
+                     ("resident_bytes", "budget", "entries", "dirty",
+                      "hit", "miss")},
+            "hist": histogram_marginals(f"osd.{self.osd_id}."),
+        }
+        try:
+            # residency-ledger deltas ride along; co-located daemons
+            # share one process ledger (documented in
+            # docs/observability.md), so the mgr labels but does not
+            # sum these across daemons of one process
+            from ceph_tpu.analysis import residency
+
+            stats["residency"] = dict(residency.counters().snapshot())
+        except Exception:  # noqa: BLE001 -- reports must never fail
+            pass
+        return stats
+
     def _op_cost(self, msg) -> int:
         if isinstance(msg, ECSubWrite):
             return max(
@@ -670,6 +722,8 @@ class OSDShard:
                 "tail_seq": self.pglog.tail_seq,
                 "dup_head": self.pglog.dup_head_seq,
                 "nonempty": self._store_nonempty,
+                # incarnation nonce: pre-boot-id peers just .get() None
+                "boot_id": self.boot_id,
             })
             return
         if op == "pg_dups":
@@ -1249,6 +1303,17 @@ class OSDShard:
         op.finish()
         self.op_hist.inc(op.duration * 1e6,
                          len(msg.get("data") or b""))
+        if reply.get("ok"):
+            # rate-engine feed (mgr/pgmap.py): consecutive MgrReport
+            # deltas of these become the `ceph -s` io block (client
+            # ops/s + throughput, distinct from recovery_bytes)
+            self.perf.inc("client_ops")
+            wr = len(msg.get("data") or b"")
+            if wr:
+                self.perf.inc("client_wr_bytes", wr)
+            result = reply.get("result")
+            if isinstance(result, (bytes, bytearray)):
+                self.perf.inc("client_rd_bytes", len(result))
         if msg.get("oid"):
             self.hitsets.record(msg["oid"])
         fault = getattr(self.messenger, "fault", None)

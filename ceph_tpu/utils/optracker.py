@@ -179,6 +179,12 @@ class OpTracker:
             op.duration, complaint, segs or "no timeline recorded",
         )
 
+    def num_inflight(self) -> int:
+        """In-flight op count without rendering op dicts (the per-report
+        gauge: dump_ops_in_flight builds a full description per op)."""
+        with self._lock:
+            return len(self._inflight)
+
     def dump_ops_in_flight(self) -> dict:
         with self._lock:
             ops = [op.to_dict() for op in self._inflight.values()]

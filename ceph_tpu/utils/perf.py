@@ -220,6 +220,27 @@ def stage_histogram(name: str) -> PerfHistogram:
     )
 
 
+def histogram_marginals(prefix: str = "") -> Dict[str, dict]:
+    """Per-histogram x-axis marginal + bounds + sum/count, the compact
+    form MgrReport frames ship (a full 2-D grid per report would be
+    ~25x the bytes for no exposition gain: the prometheus series only
+    ever render the latency marginal)."""
+    with PerfCounters._collection_lock:
+        hists = list(PerfHistogram._collection.items())
+    out: Dict[str, dict] = {}
+    for name, h in hists:
+        if prefix and not name.startswith(prefix):
+            continue
+        snap = h.snapshot()
+        out[name] = {
+            "bounds": h.x.upper_bounds(),
+            "marginal": h.x_marginal(),
+            "sum": snap["x_sum"],
+            "count": snap["count"],
+        }
+    return out
+
+
 def histograms_prometheus_text() -> str:
     """Every registered PerfHistogram as prometheus histogram series:
     cumulative ``_bucket{le=...}`` over the x (latency) marginal, plus

@@ -1,0 +1,30 @@
+"""perf-counter-unexported fixture: counters must reach a telemetry
+surface (mgr/report.py schema or the prometheus renderer's literals).
+Scanned under a pseudo ceph_tpu/ path -- the rule is scoped there."""
+
+
+class Shard:
+    def __init__(self, perf):
+        self.perf = perf
+
+    def apply(self, n, backend):
+        # exported: exact name in REPORTED_COUNTERS
+        self.perf.inc("sub_write")
+        # exported: the qos_ prefix family ships wholesale
+        self.perf.inc("qos_gold_ops", n)
+        # exported: recovery_ prefix, through another receiver spelling
+        backend.perf.inc("recovery_bytes", n)
+        # a counter nobody ever exports: invisible in production
+        self.perf.inc("secret_debug_total")  # LINT: perf-counter-unexported
+        # hwm/tinc surfaces are covered too
+        self.perf.hwm("mystery_peak_bytes", n)  # LINT: perf-counter-unexported
+        self.perf.tinc("shadow_latency", 0.5)  # LINT: perf-counter-unexported
+        # dynamic keys are out of static scope (runtime families carry
+        # an exported prefix instead)
+        key = "computed_" + str(n)
+        self.perf.inc(key)
+        # justified local counter: the disable keeps it auditable
+        self.perf.inc("bench_only_probe")  # cephlint: disable=perf-counter-unexported
+        # non-perf receivers with the same method name stay untouched
+        self.counters = {}
+        self.counters.setdefault("inc", 0)

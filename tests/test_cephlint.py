@@ -50,6 +50,9 @@ FIXTURES = {
     "jax_percall_sharding_construction.py":
         "ceph_tpu/parallel/_fixture_sharding.py",
     "ceph_config_undeclared.py": None,
+    # PR-18 wire-fed telemetry: every counter must reach the report
+    # schema / exposition (or carry a justified disable)
+    "perf_counter_unexported.py": "ceph_tpu/osd/_fixture_perf_export.py",
     "async_rmw_across_await.py": None,
     "async_lock_across_await.py": None,
     # PR-14 background data plane: recovery/scrub loops must admit/pace

@@ -1,0 +1,536 @@
+"""Wire-fed cluster telemetry (round 18): MgrBeacon/MgrReport frames,
+the PGMap fold + staleness health, incremental degraded accounting, and
+the end-to-end degraded->clean chaos transition over real TCP.
+
+Reference roles: MgrClient/MMgrReport/MPGStats (src/mgr/MgrClient.cc),
+PGMap::apply_incremental + stale-PG detection (src/mon/PGMap.cc), and
+`ceph -s` io rates from consecutive report deltas."""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu.mgr.pgmap import PGMap
+from ceph_tpu.mgr.report import (MgrBeacon, MgrReport, ReportSender,
+                                 counter_reported, filter_counters)
+from ceph_tpu.msg.wire import decode_message, encode_message
+from ceph_tpu.utils.config import get_config
+from ceph_tpu.utils.encoding import Encoder
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+class VirtualClock:
+    def __init__(self):
+        self.now = 100.0
+
+    def __call__(self):
+        return self.now
+
+
+def _report(name, seq, *, perf=None, pgs=None, lag=None, store=None,
+            interval=1.0):
+    stats = {"v": 1, "kind": name.split(".")[0],
+             "perf": perf or {}, "pgs": pgs or {}}
+    if store:
+        stats["store"] = store
+    return MgrReport(name=name, seq=seq, interval=interval, stats=stats,
+                     lag_ms=lag)
+
+
+# -- wire frames ------------------------------------------------------------
+
+
+def test_beacon_report_wire_roundtrip():
+    b = decode_message(encode_message(MgrBeacon("osd.3", 17, 2.5)))
+    assert (b.name, b.seq, b.lag_ms) == ("osd.3", 17, 2.5)
+    r = decode_message(encode_message(_report(
+        "osd.3", 18, perf={"client_ops": 9, "recover": {
+            "avgcount": 2, "sum": 0.25}},
+        pgs={"p": {"state": "active+clean", "degraded": 0}},
+        store={"objects": 4, "bytes": 4096}, lag=0.125)))
+    assert r.name == "osd.3" and r.seq == 18
+    assert r.stats["perf"]["client_ops"] == 9
+    assert r.stats["pgs"]["p"]["state"] == "active+clean"
+    assert r.lag_ms == 0.125
+    assert isinstance(r.interval, float)
+
+
+def test_pre_lag_sender_interops():
+    """The trailing-optional evolution: a pre-lag peer's beacon/report
+    ends before the lag tail; the new decoder reads None (the
+    reqid/trace/qos_class discipline, pinned by cephlint wire-optional
+    declarations in msg/wire.py)."""
+    enc = Encoder()
+    enc.u8(5).string("osd.9").varint(4)  # _MSG_MGR_BEACON, no lag tail
+    b = decode_message(enc.bytes())
+    assert isinstance(b, MgrBeacon)
+    assert (b.name, b.seq, b.lag_ms) == ("osd.9", 4, None)
+    enc = Encoder()
+    enc.u8(6).string("osd.9").varint(5)  # _MSG_MGR_REPORT
+    enc.value(1.0).value({"v": 1, "pgs": {}})
+    r = decode_message(enc.bytes())
+    assert isinstance(r, MgrReport) and r.lag_ms is None
+
+
+def test_old_daemon_ignores_report_frames_over_tcp():
+    """Forward compat the other way: a peer that predates the report
+    frames (its decode_message raises on the new kinds) must DROP them
+    -- counted, connection intact, later traffic still delivered."""
+    from ceph_tpu.msg import tcp as tcp_mod
+    from ceph_tpu.msg.cluster_bench import free_ports
+    from ceph_tpu.msg.tcp import TCPMessenger
+
+    async def main():
+        ports = free_ports(2)
+        addr = {"a": ("127.0.0.1", ports[0]),
+                "b": ("127.0.0.1", ports[1])}
+        sender = TCPMessenger("a", addr)
+        receiver = TCPMessenger("b", addr)
+        await sender.start()
+        await receiver.start()
+        got = []
+
+        async def dispatch(src, msg):
+            got.append(msg)
+
+        receiver.register("b", dispatch)
+        real_decode = tcp_mod.decode_message
+
+        def pre_report_decode(body):
+            kind = body[0]
+            if kind in (5, 6):  # this "old build" has no mgr frames
+                raise ValueError(f"unknown message type {kind}")
+            return real_decode(body)
+
+        tcp_mod.decode_message = pre_report_decode
+        try:
+            await sender.send_message("a", "b", MgrBeacon("a", 1, 0.0))
+            await sender.send_message(
+                "a", "b", _report("a", 2, perf={"client_ops": 1}))
+            await sender.send_message("a", "b", {"op": "after"})
+            for _ in range(100):
+                if got:
+                    break
+                await asyncio.sleep(0.02)
+        finally:
+            tcp_mod.decode_message = real_decode
+        assert got == [{"op": "after"}], got
+        assert receiver.counters["unknown_msg_dropped"] == 2
+        await sender.shutdown()
+        await receiver.shutdown()
+
+    run(main())
+
+
+def test_report_schema_filter():
+    assert counter_reported("client_ops")
+    assert counter_reported("qos_gold_bytes")
+    assert not counter_reported("some_private_counter")
+    snap = {"client_ops": 3, "private": 9, "tier_hit": 2,
+            "recover": {"avgcount": 1, "sum": 0.5}}
+    assert set(filter_counters(snap)) == {"client_ops", "tier_hit",
+                                          "recover"}
+
+
+# -- the PGMap fold ---------------------------------------------------------
+
+
+def test_pgmap_staleness_osd_down_and_pg_stale():
+    clock = VirtualClock()
+    pgmap = PGMap(expected=["osd.0", "osd.1", "mon.0"], clock=clock)
+    # nothing has beaconed yet: every expected daemon is down
+    health = pgmap.health()
+    assert health["status"] == "HEALTH_WARN"
+    assert "OSD_DOWN" in health["checks"]
+    assert "MON_DOWN" in health["checks"]
+    for name in ("osd.0", "osd.1", "mon.0"):
+        pgmap.apply(MgrBeacon(name, 1, 0.0))
+    pgmap.apply(_report("osd.0", 2,
+                        pgs={"p": {"state": "active+clean",
+                                   "degraded": 0}}))
+    assert pgmap.health()["status"] == "HEALTH_OK"
+    # a report-less daemon (beacon only) is UP, not a crash: osd.1
+    # never sent a report and health above still evaluated
+    # beacon silence past the grace: down again (advanced past the pg
+    # grace too, so the dead primary's slice reads stale)
+    clock.now += max(pgmap.beacon_grace, pgmap.pg_stale_grace) + 0.1
+    pgmap.apply(MgrBeacon("osd.1", 2, 0.0))
+    pgmap.apply(MgrBeacon("mon.0", 2, 0.0))
+    health = pgmap.health()
+    assert "OSD_DOWN" in health["checks"]
+    assert "osd.0" in health["checks"]["OSD_DOWN"]["summary"]
+    # ... and its pg slice goes stale past the pg grace
+    assert ("p", "osd.0") in pgmap.stale_pgs()
+    assert "PG_STALE" in health["checks"]
+    assert "stale+active+clean" in pgmap.pg_states()
+
+
+def test_pgmap_rate_engine_and_restart_reset():
+    clock = VirtualClock()
+    pgmap = PGMap(expected=["osd.0"], clock=clock)
+    pgmap.apply(_report("osd.0", 1, perf={
+        "client_ops": 100, "client_wr_bytes": 1 << 20,
+        "recovery_bytes": 0}))
+    clock.now += 2.0
+    pgmap.apply(_report("osd.0", 2, perf={
+        "client_ops": 300, "client_wr_bytes": 5 << 20,
+        "recovery_bytes": 1 << 20}))
+    io = pgmap.io_rates()
+    assert io["client_ops_per_sec"] == pytest.approx(100.0)
+    assert io["client_wr_bytes_per_sec"] == pytest.approx(2 << 20)
+    assert io["recovery_bytes_per_sec"] == pytest.approx((1 << 20) / 2)
+    # daemon restart: counters regress -> rate resets to 0, no negatives
+    clock.now += 1.0
+    pgmap.apply(_report("osd.0", 1, perf={"client_ops": 5}))
+    assert pgmap.io_rates()["client_ops_per_sec"] == 0.0
+
+
+def test_pgmap_degraded_totals_and_health():
+    clock = VirtualClock()
+    pgmap = PGMap(expected=["osd.0"], clock=clock)
+    pgmap.apply(_report("osd.0", 1, pgs={
+        "p": {"state": "active+undersized+degraded+recovering",
+              "degraded": 7, "misplaced": 2, "recovering": 3,
+              "scrub_errors": 0}}))
+    health = pgmap.health()
+    assert "PG_DEGRADED" in health["checks"]
+    assert "OBJECT_MISPLACED" in health["checks"]
+    assert pgmap.totals()["degraded"] == 7
+    stat = pgmap.pg_stat()
+    assert stat["degraded"] == 7 and stat["recovering"] == 3
+    # scrub errors escalate to HEALTH_ERR
+    pgmap.apply(_report("osd.0", 2, pgs={
+        "p": {"state": "active+clean", "degraded": 0,
+              "scrub_errors": 1}}))
+    assert pgmap.health()["status"] == "HEALTH_ERR"
+
+
+def test_daemon_lag_health_requires_sustained_lag():
+    clock = VirtualClock()
+    pgmap = PGMap(expected=["osd.0"], clock=clock)
+    warn = pgmap.lag_warn_ms
+    # one spike: no check (a GC pause must not page an operator)
+    pgmap.apply(MgrBeacon("osd.0", 1, warn * 2))
+    assert "DAEMON_LAG" not in pgmap.health()["checks"]
+    pgmap.apply(MgrBeacon("osd.0", 2, 0.0))  # recovered: streak resets
+    for seq in range(3, 3 + pgmap.lag_sustain):
+        pgmap.apply(MgrBeacon("osd.0", seq, warn * 2))
+    health = pgmap.health()
+    assert "DAEMON_LAG" in health["checks"]
+    assert "osd.0" in health["checks"]["DAEMON_LAG"]["summary"]
+
+
+def test_pgmap_prometheus_scrape_roundtrip():
+    from ceph_tpu.mgr.telemetry_bench import _parse_prometheus
+
+    clock = VirtualClock()
+    pgmap = PGMap(expected=["osd.0", "osd.1"], clock=clock)
+    pgmap.apply(_report(
+        "osd.0", 1,
+        perf={"client_ops": 10, "sub_write": 4},
+        pgs={"p": {"state": "active+degraded", "degraded": 3}},
+        store={"objects": 6, "bytes": 12345}, lag=1.5))
+    text = pgmap.prometheus_text()
+    samples = _parse_prometheus(text)
+    assert samples['ceph_osd_up{ceph_daemon="osd.0"}'] == 1
+    assert samples['ceph_osd_up{ceph_daemon="osd.1"}'] == 0
+    assert samples["ceph_degraded_objects"] == 3
+    assert samples['ceph_pg_degraded{pool="p",ceph_daemon="osd.0"}'] == 3
+    assert samples['ceph_osd_bytes_used{ceph_daemon="osd.0"}'] == 12345
+    assert samples[
+        'ceph_osd_perf{ceph_daemon="osd.0",counter="sub_write"}'] == 4
+    assert samples['ceph_daemon_lag_ms{ceph_daemon="osd.0"}'] == 1.5
+    assert "ceph_client_ops_per_sec" in samples
+
+
+# -- incremental degraded accounting (the full-scan kill) -------------------
+
+
+def test_incremental_degraded_matches_deep_scan_and_never_walks_stores():
+    from ceph_tpu.mgr.mgr import ClusterState, health_checks
+    from ceph_tpu.osd.cluster import ECCluster
+
+    async def main():
+        c = ECCluster(6, {"k": "2", "m": "1"})
+        for i in range(12):
+            await c.write(f"o{i}", bytes([i]) * 3000)
+        cs = ClusterState(c)
+        assert cs.degraded_objects() == []
+        assert cs.degraded_objects(deep=True) == []
+        acting = c.backend.acting_set("o5")
+        c.kill_osd(acting[0])
+        inc = set(cs.degraded_objects())
+        deep = set(cs.degraded_objects(deep=True))
+        assert deep and deep <= inc, (deep, inc)
+        health = health_checks(cs.dump())
+        assert {"OSD_DOWN", "PG_DEGRADED"} <= set(health["checks"])
+        c.revive_osd(acting[0])
+        assert cs.degraded_objects() == []
+        assert health_checks(cs.dump())["status"] == "HEALTH_OK"
+        # wipe markings persist through the revive-irrelevant path and
+        # drain only when recovery rebuilds
+        c.wipe_osd(acting[0])
+        assert cs.degraded_objects()
+        await c.shutdown()
+
+    run(main())
+
+
+def test_scrape_cost_does_not_grow_with_object_count():
+    """THE regression pin for the killed full scan: ClusterState.dump()
+    and OSDShard.mgr_report_stats() perform ZERO object-store walks, at
+    any object count (the O(objects x shards) per-scrape census is
+    deep-verify-only)."""
+    from ceph_tpu.mgr.mgr import ClusterState
+    from ceph_tpu.osd import memstore as ms
+    from ceph_tpu.osd.cluster import ECCluster
+
+    async def walks_during_scrape(n_objects: int) -> int:
+        c = ECCluster(4, {"k": "2", "m": "1"})
+        for i in range(n_objects):
+            await c.write(f"o{i}", b"x" * 1024)
+        cs = ClusterState(c)
+        calls = {"n": 0}
+        orig = ms.MemStore.list_objects
+
+        def counting(self):
+            calls["n"] += 1
+            return orig(self)
+
+        ms.MemStore.list_objects = counting
+        try:
+            cs.dump()
+            for osd in c.osds:
+                osd.mgr_report_stats()
+        finally:
+            ms.MemStore.list_objects = orig
+        await c.shutdown()
+        return calls["n"]
+
+    async def main():
+        assert await walks_during_scrape(4) == 0
+        assert await walks_during_scrape(40) == 0
+
+    run(main())
+
+
+def test_memstore_stats_incremental_exactness():
+    from ceph_tpu.osd.memstore import MemStore
+    from ceph_tpu.osd.types import Transaction
+
+    store = MemStore()
+    store.queue_transaction(
+        Transaction().write("a@0", 0, b"x" * 100)
+        .write("a@1", 0, b"y" * 50))
+    store.queue_transaction(
+        Transaction().omap_setkeys("a@meta", {"k": b"v"}))
+    st = store.stats()
+    assert st == {"objects": 3, "shards": 2, "metas": 1, "bytes": 150}
+    store.queue_transaction(Transaction().write("a@0", 0, b"z" * 300))
+    assert store.stats()["bytes"] == 350
+    store.queue_transaction(Transaction().truncate("a@0", 10))
+    assert store.stats()["bytes"] == 60
+    store.queue_transaction(Transaction().remove("a@1"))
+    st = store.stats()
+    assert st["shards"] == 1 and st["bytes"] == 10
+    # exactness against the ground-truth scan
+    truth = sum(store.stat(oid) for oid in store.list_objects())
+    assert st["bytes"] + 0 == truth + 0 - 0  # a@meta has no data bytes
+    assert st["objects"] == len(store.list_objects())
+
+
+def test_boot_id_change_forces_backfill_discovery():
+    """The multi-process wipe case in-process: an OSD 'process restart'
+    (fresh OSDShard, empty store, NEW boot_id, same entity) must force
+    peers off their watermarks onto the backfill path so the lost
+    shards are rediscovered and rebuilt -- head_seq 0 from the new
+    incarnation must NOT read as a quiet peer."""
+    from ceph_tpu.osd.cluster import ECCluster
+    from ceph_tpu.osd.shard import OSDShard
+
+    async def main():
+        c = ECCluster(4, {"k": "2", "m": "1"})
+        for i in range(8):
+            await c.write(f"o{i}", bytes([i]) * 4000)
+        # one clean peering pass so every peer holds watermarks
+        for osd in c.osds:
+            for b in osd.pools.values():
+                await b.peering_pass()
+        victim = c.osds[1]
+        held = [s for s in victim.store.list_objects()
+                if not s.endswith("@meta")]
+        assert held, "victim held no shards; pick another topology"
+        # 'restart' osd.1: new shard object, new boot_id, empty store
+        replacement = OSDShard(1, c.messenger)
+        replacement.host_pool(c.pool, c.ec, 4, c.placement)
+        c.osds[1] = replacement
+        assert replacement.boot_id != victim.boot_id
+        restarted = 0
+        for osd in c.osds:
+            if osd is replacement:
+                continue
+            for b in osd.pools.values():
+                await b.peering_pass()
+                restarted += b.perf.snapshot().get(
+                    "peering_peer_restarted", 0)
+        assert restarted > 0, "no peer noticed the new incarnation"
+        # the lost shards were rediscovered and rebuilt
+        deadline = 40
+        while deadline and await c.degraded_report():
+            for osd in c.osds:
+                for b in osd.pools.values():
+                    await b.peering_pass()
+            deadline -= 1
+        assert not await c.degraded_report()
+        for s in held:
+            assert replacement.store.exists(s), f"{s} never rebuilt"
+        for i in range(8):
+            assert await c.read(f"o{i}") == bytes([i]) * 4000
+        await c.shutdown()
+
+    run(main())
+
+
+# -- end to end over real TCP ----------------------------------------------
+
+
+def test_wire_fed_health_wipe_to_clean_over_tcp():
+    """The acceptance transition on one real-TCP cluster: HEALTH_OK
+    from wire-fed reports -> wipe -> PG_DEGRADED with degraded > 0 ->
+    monotone drain -> HEALTH_OK.  Every byte of telemetry crossed a
+    socket as a typed beacon/report frame."""
+    from ceph_tpu.mgr.pgmap import MgrServer
+    from ceph_tpu.msg.cluster_bench import free_ports
+    from ceph_tpu.msg.tcp import TCPMessenger
+    from ceph_tpu.osd.objecter import Objecter
+    from ceph_tpu.osd.placement import CrushPlacement
+    from ceph_tpu.osd.shard import OSDShard
+    from ceph_tpu.osd.types import Transaction
+    from ceph_tpu.plugins import registry as registry_mod
+
+    cfg = get_config()
+    tuned = {"mgr_beacon_interval": 0.05, "mgr_report_interval": 0.1,
+             "mgr_daemon_beacon_grace": 1.0, "mgr_pg_stale_grace": 2.0,
+             "osd_tick_interval": 0.25, "osd_recovery_sleep": 0.05,
+             "osd_recovery_batch_bytes": 32 << 10}
+    prior = {k: cfg.get_val(k) for k in tuned}
+
+    async def main():
+        n = 4
+        ec = registry_mod.instance().factory(
+            "jerasure", {"k": "2", "m": "1",
+                         "technique": "reed_sol_van"})
+        km = ec.get_chunk_count()
+        ports = free_ports(n + 2)
+        addr = {f"osd.{i}": ("127.0.0.1", ports[i]) for i in range(n)}
+        addr["mgr.0"] = ("127.0.0.1", ports[n])
+        addr["client"] = ("127.0.0.1", ports[n + 1])
+        placement = CrushPlacement(n, km)
+        shards, messengers, senders = [], [], []
+        for i in range(n):
+            mess = TCPMessenger(f"osd.{i}", addr)
+            await mess.start()
+            shard = OSDShard(i, mess)
+            shard.host_pool("p", ec, n, placement)
+            shard.start_tick(0.25)
+            sender = ReportSender(shard.name, mess,
+                                  shard.mgr_report_stats, ["mgr.0"],
+                                  perf=shard.perf)
+            sender.start()
+            shards.append(shard)
+            messengers.append(mess)
+            senders.append(sender)
+        mgr_mess = TCPMessenger("mgr.0", addr)
+        await mgr_mess.start()
+        mgr = MgrServer("mgr.0", mgr_mess, addr_map=addr)
+        client_mess = TCPMessenger("client", addr)
+        await client_mess.start()
+        client = Objecter(client_mess, km, n, placement=placement,
+                          pool="p")
+        for i in range(24):
+            await client.write(f"w{i}", bytes([i]) * 8192)
+        for _ in range(60):
+            await asyncio.sleep(0.1)
+            if mgr.pgmap.health()["status"] == "HEALTH_OK" and \
+                    mgr.pgmap.reports_folded > n:
+                break
+        assert mgr.pgmap.health()["status"] == "HEALTH_OK"
+        # client op rates flowed from report deltas at some point
+        # (writes above happened across several report intervals)
+        # -- wipe osd.1 in place (replacement disk) --------------------
+        victim = shards[1]
+        for other in shards:
+            b = other.pools["p"]
+            for stored in victim.store.list_objects():
+                base = stored.rpartition("@")[0]
+                if base:
+                    acting = b.acting_set(base)
+                    for s in range(b.km):
+                        if b._shard_up(acting, s):
+                            shards[acting[s]].pools[
+                                "p"].pg_stats.note_down_victims(
+                                "wipe:osd.1", [base])
+                            break
+            break
+        txn = Transaction()
+        for stored in victim.store.list_objects():
+            txn.remove(stored)
+        victim.store.queue_transaction(txn)
+        victim._applied_version.clear()
+        victim._store_nonempty = False
+        victim._scrub_bases = None
+        for other in shards:
+            for b in other.pools.values():
+                b._peer_seq.pop(victim.name, None)
+                b._peer_dup_seq.pop(victim.name, None)
+        for shard in shards:
+            shard.request_peering()
+        series = []
+        for _ in range(200):
+            await asyncio.sleep(0.1)
+            series.append(mgr.pgmap.totals()["degraded"])
+            if series[-1] == 0 and max(series) > 0 and \
+                    mgr.pgmap.health()["status"] == "HEALTH_OK":
+                break
+        assert max(series) > 0, f"wipe raised no degraded: {series}"
+        assert series[-1] == 0, f"never drained: {series[-10:]}"
+        peak = series.index(max(series))
+        upticks = sum(1 for a, b2 in zip(series[peak:],
+                                         series[peak + 1:]) if b2 > a)
+        assert upticks <= 1, f"drain not monotone: {series[peak:]}"
+        assert mgr.pgmap.health()["status"] == "HEALTH_OK"
+        # data integrity after the rebuild
+        for i in range(24):
+            assert await client.read(f"w{i}") == bytes([i]) * 8192
+        # the aggregated exposition carries the wire-fed series
+        text = mgr.pgmap.prometheus_text()
+        assert "ceph_degraded_objects 0" in text
+        assert 'ceph_osd_up{ceph_daemon="osd.1"} 1' in text
+        for sender in senders:
+            sender.stop()
+        await mgr.stop()
+        for mess in messengers + [mgr_mess, client_mess]:
+            await mess.shutdown()
+
+    cfg.apply_changes(tuned)
+    try:
+        run(main())
+    finally:
+        cfg.apply_changes(prior)
+
+
+def test_telemetry_bench_smoke():
+    from ceph_tpu.mgr.telemetry_bench import run_telemetry_bench
+
+    result = run_telemetry_bench(smoke=True)
+    assert result["telemetry_overhead_pct"] <= result[
+        "overhead_limit_pct"]
+    assert result["reports_folded"] > 0
+    assert result["chaos"]["degraded_max"] > 0
+    assert result["chaos"]["health_final"] == "HEALTH_OK"
+    assert result["scrape"]["series_parsed"] > 10

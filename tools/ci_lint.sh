@@ -49,6 +49,14 @@ if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
     JAX_PLATFORMS=cpu python tools/ec_benchmark.py --workload qos-path \
         --smoke > /dev/null
     echo "cephlint: qos-path scale-harness smoke passed" >&2
+    # telemetry smoke (round 18): a REAL multi-process vstart cluster
+    # (OSD + mgr daemons) must reach HEALTH_OK from wire-fed reports
+    # alone, then survive an OSD wipe: PG_DEGRADED with a nonzero,
+    # monotonically-draining degraded count back to HEALTH_OK --
+    # asserted end-to-end from the mgr's admin socket
+    JAX_PLATFORMS=cpu python -m ceph_tpu.mgr.telemetry_bench \
+        --vstart-smoke > /dev/null
+    echo "cephlint: wire-fed telemetry health smoke passed" >&2
     # multichip dryrun on simulated devices: jax_num_cpu_devices where
     # the jax supports it, the XLA_FLAGS device-count override otherwise
     JAX_PLATFORMS=cpu \

@@ -40,12 +40,12 @@ def parse_args(argv):
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
                             "recovery-path", "mesh-path", "trace-path",
-                            "qos-path"])
+                            "qos-path", "telemetry-path"])
     p.add_argument("--smoke", action="store_true",
-                   help="qos-path only: the fast CI shape (a few "
-                        "hundred clients, a few seconds per sub-stage) "
-                        "instead of the full >=1000-client acceptance "
-                        "run")
+                   help="qos-path/telemetry-path: the fast CI shape "
+                        "(shrunk client counts and durations, loose "
+                        "overhead limits) instead of the full "
+                        "acceptance run")
     p.add_argument("--stages", default=None,
                    choices=["overload", "chaos", "scale"],
                    help="qos-path only: run a single sub-stage")
@@ -200,6 +200,33 @@ def main(argv=None) -> int:
             f"{result.get('qos_path_reservation_ratio', '?')}, fairness "
             f"spread {result.get('qos_path_fairness_spread_max', '?')}, "
             f"cas exact {result.get('qos_path_cas_exact', '?')}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "telemetry-path":
+        # Wire-fed telemetry stage (round 18): MgrClient report-loop
+        # overhead vs reports-off on the storage-path workload,
+        # exposition scrape-parse roundtrip, and the chaos health gate
+        # (mid-run OSD wipe -> PG_DEGRADED draining monotonically to
+        # HEALTH_OK over real TCP).  Any gate violation exits nonzero.
+        import json
+
+        from ceph_tpu.mgr.telemetry_bench import run_telemetry_bench
+
+        result = run_telemetry_bench(
+            n_objects=args.objects, obj_bytes=args.size,
+            writers=args.writers, iters=max(1, args.iterations),
+            smoke=args.smoke,
+        )
+        print(json.dumps(result))
+        print(
+            f"telemetry-path: report-loop overhead "
+            f"{result['telemetry_overhead_pct']}% "
+            f"(limit {result['overhead_limit_pct']}%), "
+            f"{result['reports_folded']} reports folded, chaos "
+            f"degraded peak {result['chaos']['degraded_max']} -> "
+            f"{result['chaos']['health_final']}",
             file=sys.stderr,
         )
         return 0

@@ -9,6 +9,9 @@ listing; the admin-socket union serves the same operator need on the
 mini-cluster).
 
 Usage:
+  rados_cli.py --dir RUN status                  (`ceph -s`, wire-fed)
+  rados_cli.py --dir RUN health [detail]
+  rados_cli.py --dir RUN pg stat
   rados_cli.py --dir RUN put <obj> <file>
   rados_cli.py --dir RUN get <obj> [<file>]      (default: stdout)
   rados_cli.py --dir RUN rm <obj>
@@ -60,7 +63,71 @@ def _asoks(run_dir: str):
                   + glob.glob(os.path.join(run_dir, "data", "osd.*.asok")))
 
 
+def _mgr_asoks(run_dir: str):
+    return sorted(glob.glob(os.path.join(run_dir, "mgr.*.asok"))
+                  + glob.glob(os.path.join(run_dir, "data", "mgr.*.asok")))
+
+
+async def _mgr_command(run_dir: str, prefix: str, **kw):
+    """First answering mgr's reply, or None when no mgr is reachable
+    (telemetry-off clusters)."""
+    for sock in _mgr_asoks(run_dir):
+        try:
+            reply = await admin_command(sock, prefix, **kw)
+        except (OSError, ValueError):
+            continue
+        if isinstance(reply, dict) and "error" in reply:
+            continue
+        return reply
+    return None
+
+
 async def _run(args) -> int:
+    if args.cmd == "status":
+        # `ceph -s` against the live cluster: everything below arrived
+        # over the wire as beacon/report frames and was folded into the
+        # mgr's PGMap -- no in-process introspection anywhere
+        st = await _mgr_command(args.dir, "status text")
+        if st is None:
+            print("no reachable mgr (cluster started with --mgrs 0?)",
+                  file=sys.stderr)
+            return 1
+        sys.stdout.write(st["text"])
+        return 0
+    if args.cmd == "health":
+        health = await _mgr_command(args.dir, "health")
+        if health is None:
+            print("no reachable mgr (cluster started with --mgrs 0?)",
+                  file=sys.stderr)
+            return 1
+        print(health["status"])
+        if args.args and args.args[0] == "detail":
+            for name, chk in sorted(health["checks"].items()):
+                print(f"[{chk['severity']}] {name}: {chk['summary']}")
+        return 0
+    if args.cmd == "pg":
+        # `ceph pg stat`: the per-(pool, primary) slice histogram +
+        # degraded/misplaced totals + the io rate block
+        which = args.args[0] if args.args else "stat"
+        if which != "stat":
+            print(f"unknown pg view {which!r} (stat)", file=sys.stderr)
+            return 1
+        stat = await _mgr_command(args.dir, "pg stat")
+        if stat is None:
+            print("no reachable mgr (cluster started with --mgrs 0?)",
+                  file=sys.stderr)
+            return 1
+        bits = "; ".join(f"{n} {state}"
+                         for state, n in sorted(stat["by_state"].items()))
+        io = stat["io"]
+        print(f"{stat['num_pg_slices']} pg slices: {bits or 'none'}; "
+              f"{stat['degraded']} degraded, {stat['misplaced']} "
+              f"misplaced ({stat['recovering']} rebuilding); "
+              f"io {io['client_ops_per_sec']} op/s, "
+              f"{io['client_wr_bytes_per_sec']} B/s wr, "
+              f"{io['client_rd_bytes_per_sec']} B/s rd; "
+              f"recovery {io['recovery_bytes_per_sec']} B/s")
+        return 0
     if args.cmd == "ls":
         seen = set()
         for sock in _asoks(args.dir):

@@ -49,7 +49,8 @@ def _daemon_env():
 
 
 def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
-                  op_queue="wpq", wait=10.0, auth=False, n_mons=0):
+                  op_queue="wpq", wait=10.0, auth=False, n_mons=0,
+                  n_mgrs=1):
     """Boot n_osds daemon processes; returns the addr map path.
     Library entry point used by the CLI and the standalone tests.
     With auth=True a keyring is generated and every connection runs the
@@ -59,13 +60,21 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
     With ``n_mons`` > 0 the cluster is MONITOR-INTEGRATED (the reference
     vstart.sh shape: mons boot first, pools are created through the mon,
     OSDs boot INTO the mon and learn pools from osdmap broadcasts,
-    peer heartbeats drive mon mark-down)."""
+    peer heartbeats drive mon mark-down).
+
+    ``n_mgrs`` (default 1, like vstart.sh) spawns mgr daemon processes:
+    every OSD/mon discovers ``mgr.*`` in the address map and runs its
+    MgrClient report loop against them, so ``rados_cli status / health
+    / pg stat`` work against the live cluster from wire-fed telemetry
+    alone.  0 disables telemetry entirely (the reports-off baseline)."""
     os.makedirs(run_dir, exist_ok=True)
-    ports = _free_ports(n_osds + n_mons + 1)
+    ports = _free_ports(n_osds + n_mons + n_mgrs + 1)
     addr_map = {f"osd.{i}": ("127.0.0.1", ports[i]) for i in range(n_osds)}
     for r in range(n_mons):
         addr_map[f"mon.{r}"] = ("127.0.0.1", ports[n_osds + r])
-    addr_map["client"] = ("127.0.0.1", ports[n_osds + n_mons])
+    for r in range(n_mgrs):
+        addr_map[f"mgr.{r}"] = ("127.0.0.1", ports[n_osds + n_mons + r])
+    addr_map["client"] = ("127.0.0.1", ports[n_osds + n_mons + n_mgrs])
     map_path = os.path.join(run_dir, "addr_map.json")
     with open(map_path, "w") as f:
         json.dump(addr_map, f)
@@ -75,12 +84,14 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
         ring = KeyRing()
         if n_mons:
             # mon-backed provisioning (the ceph-deploy/ceph-authtool
-            # bootstrap flow): only the mon + bootstrap-client keys are
-            # generated locally; OSD keys are minted THROUGH the
-            # AuthMonitor (`auth get-or-create`) during bootstrap and
-            # appended to the keyring before the OSDs spawn
+            # bootstrap flow): only the mon + bootstrap-client + mgr
+            # keys are generated locally; OSD keys are minted THROUGH
+            # the AuthMonitor (`auth get-or-create`) during bootstrap
+            # and appended to the keyring before the OSDs spawn
             for r in range(n_mons):
                 ring.add(f"mon.{r}")
+            for r in range(n_mgrs):
+                ring.add(f"mgr.{r}")
             ring.add("client")
         else:
             for entity in addr_map:
@@ -89,7 +100,7 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
     with open(os.path.join(run_dir, "cluster.json"), "w") as f:
         json.dump({"profile": profile, "n_osds": n_osds,
                    "objectstore": objectstore, "auth": auth,
-                   "n_mons": n_mons}, f)
+                   "n_mons": n_mons, "n_mgrs": n_mgrs}, f)
     data_path = os.path.join(run_dir, "data")
     if n_mons:
         mon_deadline = time.time() + wait
@@ -107,6 +118,15 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
         _asyncio.new_event_loop().run_until_complete(
             _bootstrap_pools(run_dir, n_osds, profile, auth=auth)
         )
+    if n_mgrs:
+        # mgr daemons boot alongside: they only LISTEN for beacon/report
+        # frames, so ordering vs OSDs does not matter -- but their port
+        # must be up before rados_cli's first status call
+        mgr_pids = {r: spawn_mgr(run_dir, r, data_path=data_path,
+                                 auth=auth)
+                    for r in range(n_mgrs)}
+        with open(os.path.join(run_dir, "mgr_pids"), "w") as f:
+            json.dump({str(r): p for r, p in mgr_pids.items()}, f)
     pids = {}
     for i in range(n_osds):
         pids[i] = spawn_osd(run_dir, i, objectstore=objectstore,
@@ -118,6 +138,8 @@ def start_cluster(run_dir, n_osds, profile, objectstore="memstore",
     deadline = time.time() + wait
     for i in range(n_osds):
         _wait_port(addr_map[f"osd.{i}"], deadline, f"osd.{i}")
+    for r in range(n_mgrs):
+        _wait_port(addr_map[f"mgr.{r}"], deadline, f"mgr.{r}")
     if n_mons:
         # mon-integrated daemons learn their pools from the osdmap
         # SUBSCRIPTION after boot: a client dispatching the instant the
@@ -167,6 +189,25 @@ def _wait_port(addr, deadline, who):
             if time.time() > deadline:
                 raise TimeoutError(f"{who} did not come up")
             time.sleep(0.05)
+
+
+def spawn_mgr(run_dir, rank, data_path=None, auth=False):
+    """Start one mgr daemon process (wire-fed telemetry endpoint);
+    returns its pid.  The admin socket lands next to the OSDs' so
+    rados_cli finds it with the same glob."""
+    data_path = data_path or os.path.join(run_dir, "data")
+    os.makedirs(data_path, exist_ok=True)
+    log = open(os.path.join(run_dir, f"mgr.{rank}.log"), "ab")
+    cmd = [sys.executable, "-m", "ceph_tpu.daemon.mgr",
+           "--rank", str(rank),
+           "--addr-map", os.path.join(run_dir, "addr_map.json"),
+           "--admin-socket", os.path.join(data_path, f"mgr.{rank}.asok")]
+    if auth:
+        cmd += ["--keyring", os.path.join(run_dir, "keyring")]
+    proc = subprocess.Popen(
+        cmd, stdout=log, stderr=log, env=_daemon_env(), cwd=REPO,
+    )
+    return proc.pid
 
 
 def spawn_mon(run_dir, rank, n_mons, auth=False):
@@ -337,11 +378,13 @@ def revive_osd(run_dir, osd_id):
 
 def stop_cluster(run_dir):
     pids = dict(_load_pids(run_dir))
-    try:
-        with open(os.path.join(run_dir, "mon_pids")) as f:
-            pids.update({f"mon.{k}": v for k, v in json.load(f).items()})
-    except FileNotFoundError:
-        pass
+    for extra in ("mon_pids", "mgr_pids"):
+        try:
+            with open(os.path.join(run_dir, extra)) as f:
+                pids.update({f"{extra[:3]}.{k}": v
+                             for k, v in json.load(f).items()})
+        except FileNotFoundError:
+            pass
     for pid in pids.values():
         try:
             os.kill(pid, signal.SIGTERM)
@@ -376,10 +419,11 @@ def stop_cluster(run_dir):
                 break
             time.sleep(0.05)
     _save_pids(run_dir, {})
-    try:
-        os.remove(os.path.join(run_dir, "mon_pids"))
-    except FileNotFoundError:
-        pass
+    for extra in ("mon_pids", "mgr_pids"):
+        try:
+            os.remove(os.path.join(run_dir, extra))
+        except FileNotFoundError:
+            pass
 
 
 async def _client(run_dir):
@@ -423,6 +467,10 @@ def main(argv=None):
                     help="monitor count; >0 boots a mon quorum, creates "
                          "the pool through it, and OSDs boot into the mon "
                          "(heartbeat mark-down, map-driven pools)")
+    ap.add_argument("--mgrs", type=int, default=1,
+                    help="mgr daemon count (wire-fed telemetry: daemons "
+                         "report to mgr.* from the address map; 0 = "
+                         "telemetry off)")
     args = ap.parse_args(argv)
 
     if args.cmd == "start":
@@ -433,9 +481,10 @@ def main(argv=None):
                        "m": str(args.m)}
         start_cluster(args.dir, args.osds, profile,
                       objectstore=args.objectstore, auth=args.auth,
-                      n_mons=args.mons)
+                      n_mons=args.mons, n_mgrs=args.mgrs)
         print(f"cluster up: {args.osds} osds"
               + (f", {args.mons} mons" if args.mons else "")
+              + (f", {args.mgrs} mgrs" if args.mgrs else "")
               + f", profile {profile}"
               + (" [cephx auth]" if args.auth else ""))
     elif args.cmd == "stop":
