@@ -71,6 +71,7 @@ def all_rules() -> Dict[str, Rule]:
     from ceph_tpu.analysis import rules_interleave  # noqa: F401
     from ceph_tpu.analysis import rules_jax  # noqa: F401
     from ceph_tpu.analysis import rules_perf  # noqa: F401
+    from ceph_tpu.analysis import rules_profile  # noqa: F401
     from ceph_tpu.analysis import rules_residency  # noqa: F401
     from ceph_tpu.analysis import rules_trace  # noqa: F401
     from ceph_tpu.analysis import rules_wire  # noqa: F401
@@ -207,6 +208,18 @@ _RESIDENT_BEGIN = _re.compile(
     r"#\s*cephlint:\s*device-resident-section\s+([A-Za-z0-9_.\-]+)")
 _RESIDENT_END = _re.compile(r"#\s*cephlint:\s*end-device-resident-section\b")
 
+#: declared wire hot sections: ``cephlint: wire-hot-section <name>`` ...
+#: ``cephlint: end-wire-hot-section``.  Inside the markers the
+#: ``wire-hot-path-alloc`` rule (rules_profile) flags per-frame bytes
+#: concatenation -- the allocation class the zero-copy part-list
+#: discipline (docs/messenger.md) exists to avoid.  Advisory: the
+#: declared regions are the per-frame seams the wire-tax profiler
+#: instruments, where one stray ``a + b`` on bytes costs a copy per
+#: frame.
+_WIREHOT_BEGIN = _re.compile(
+    r"#\s*cephlint:\s*wire-hot-section\s+([A-Za-z0-9_.\-]+)")
+_WIREHOT_END = _re.compile(r"#\s*cephlint:\s*end-wire-hot-section\b")
+
 
 @dataclasses.dataclass(frozen=True)
 class AtomicSection:
@@ -288,6 +301,13 @@ def parse_resident_sections(lines) -> "Tuple[List[AtomicSection], List[Tuple[int
     return _parse_marked_sections(
         lines, _RESIDENT_BEGIN, _RESIDENT_END,
         "device-resident-section", "end-device-resident-section")
+
+
+def parse_wire_hot_sections(lines) -> "Tuple[List[AtomicSection], List[Tuple[int, str]]]":  # noqa: E501
+    """(sections, problems) for declared wire hot sections."""
+    return _parse_marked_sections(
+        lines, _WIREHOT_BEGIN, _WIREHOT_END,
+        "wire-hot-section", "end-wire-hot-section")
 
 
 def module_str_constants(tree: ast.Module) -> Dict[str, str]:

@@ -62,6 +62,11 @@ async def serve(args) -> None:
         asok.register("pg stat", lambda cmd: mgr.pgmap.pg_stat())
         asok.register("metrics",
                       lambda cmd: {"text": mgr.pgmap.prometheus_text()})
+        # the mgr-local cluster event log (clog analogue): health
+        # transitions + slow-op warnings, rendered by `rados_cli log`
+        asok.register("log last", lambda cmd: {
+            "lines": mgr.pgmap.clog.last(int(cmd.get("count", 20))),
+        })
         asok.register("mgr status", lambda cmd: {
             "name": name,
             "http_port": http_port,

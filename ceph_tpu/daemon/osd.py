@@ -329,6 +329,23 @@ async def serve(args) -> None:
 
         asok.register("trace status", _trace_status)
         asok.register("trace dump", _trace_dump)
+
+        # wire-tax profiler hooks (ceph_tpu/profiling/): status/dump/
+        # reset; enable at runtime via `config set profile_mode on`
+        # (the config-set hook below re-applies through configure())
+        from ceph_tpu import profiling
+
+        asok.register("profile status",
+                      lambda cmd: dict(profiling.asok_status(cmd),
+                                       name=name))
+        asok.register("profile dump", profiling.asok_dump)
+        asok.register("profile reset", profiling.asok_reset)
+        # a runtime `config set profile_mode on` installs/uninstalls
+        # the profiler arms through the normal observer plumbing
+        get_config().add_observer(
+            lambda changed: profiling.configure()
+            if "profile_mode" in changed else None)
+        profiling.configure()  # apply env/conf-selected mode at boot
         asok.register(
             "config show", lambda cmd: get_config().show_config()
         )

@@ -356,6 +356,16 @@ def prometheus_text(state: dict) -> str:
             lines.append(hist_text)
     except Exception:  # noqa: BLE001 -- exposition must never fail
         pass
+    try:
+        # wire-tax profiler cost centers (ceph_tpu/profiling/): empty
+        # string when profile_mode is off
+        from ceph_tpu import profiling as _profiling
+
+        prof_text = _profiling.prometheus_text()
+        if prof_text:
+            lines.append(prof_text)
+    except Exception:  # noqa: BLE001 -- exposition must never fail
+        pass
     lines += ["# HELP ceph_pool_objects logical objects in the pool",
               "# TYPE ceph_pool_objects gauge",
               f"ceph_pool_objects {state['pools']['num_objects']}",

@@ -25,9 +25,41 @@ from __future__ import annotations
 
 import asyncio
 import time
+from collections import deque
 from typing import Dict, List, Optional
 
 from ceph_tpu.mgr.report import MgrBeacon, MgrReport
+
+
+class ClusterLog:
+    """Bounded mgr-local cluster event log (the ``clog`` analogue).
+
+    Health transitions and slow-op WARNINGs append here as they are
+    OBSERVED by the mgr (health is computed lazily at read time, so a
+    transition lands on the first health read that sees it; slow-op
+    deltas land at report fold).  Mgr-local only -- no new wire frames;
+    ``rados_cli log last [n]`` renders it over the admin socket."""
+
+    def __init__(self, keep: int = 256, clock=None):
+        self.clock = clock if clock is not None else time.time
+        self._ring: deque = deque(maxlen=keep)
+        self._seq = 0
+
+    def append(self, severity: str, message: str) -> None:
+        self._seq += 1
+        self._ring.append({
+            "seq": self._seq,
+            "stamp": round(self.clock(), 3),
+            "severity": severity,  # INF | WRN | ERR
+            "message": message,
+        })
+
+    def last(self, n: int = 20) -> List[dict]:
+        entries = list(self._ring)
+        return entries[-max(0, int(n)):]
+
+    def __len__(self) -> int:
+        return len(self._ring)
 
 #: perf counters whose per-interval deltas become rates (the io block):
 #: key -> (rate name, unit scale note)
@@ -49,7 +81,8 @@ def fold_health(checks: Dict[str, dict]) -> dict:
 
 class _DaemonState:
     __slots__ = ("name", "kind", "last_beacon", "last_report", "seq",
-                 "lag_ms", "lag_over", "stats", "rates", "prev")
+                 "lag_ms", "lag_over", "stats", "rates", "prev",
+                 "slow_ops_seen")
 
     def __init__(self, name: str):
         self.name = name
@@ -64,6 +97,8 @@ class _DaemonState:
         self.rates: Dict[str, float] = {}
         #: (clock, {rate counter: value}) of the previous report
         self.prev: Optional[tuple] = None
+        #: slow_ops counter watermark (clog slow-op WARNING deltas)
+        self.slow_ops_seen = 0
 
 
 class PGMap:
@@ -87,6 +122,12 @@ class PGMap:
         self.pgs: Dict[str, Dict[str, dict]] = {}
         self.reports_folded = 0
         self.beacons_folded = 0
+        #: mgr-local cluster event log: health transitions + slow-op
+        #: warnings (rados_cli `log last [n]`)
+        self.clog = ClusterLog()
+        #: last health view this map rendered (transition detection)
+        self._health_prev: Dict[str, str] = {}
+        self._status_prev: Optional[str] = None
 
     # -- fold ---------------------------------------------------------------
 
@@ -123,6 +164,17 @@ class PGMap:
             d.stats = msg.stats or {}
             self._note_lag(d, msg.lag_ms)
             self._fold_rates(d, now)
+            # slow-op WARNINGs ride the event log: a report whose
+            # slow_ops counter advanced logs the delta (counter going
+            # BACKWARD = daemon restart: re-baseline silently)
+            slow = (d.stats.get("perf") or {}).get("slow_ops", 0)
+            if isinstance(slow, (int, float)):
+                if slow > d.slow_ops_seen:
+                    self.clog.append(
+                        "WRN",
+                        f"{int(slow - d.slow_ops_seen)} slow op(s) on "
+                        f"{msg.name} ({int(slow)} total)")
+                d.slow_ops_seen = slow
             for pool, stat in (d.stats.get("pgs") or {}).items():
                 entry = dict(stat)
                 entry["t"] = now
@@ -271,7 +323,31 @@ class PGMap:
                 "summary": f"event-loop lag >= {self.lag_warn_ms:g}ms "
                            f"sustained on: " + " ".join(lagging),
             }
-        return fold_health(checks)
+        folded = fold_health(checks)
+        self._note_health_transitions(folded)
+        return folded
+
+    def _note_health_transitions(self, folded: dict) -> None:
+        """Append health-state changes to the event log.  Health is
+        computed lazily, so a transition lands on the first health read
+        that observes it; repeated reads of the same state append
+        nothing (idempotent by construction)."""
+        checks = folded["checks"]
+        cur = {name: chk["summary"] for name, chk in checks.items()}
+        for name in sorted(set(cur) - set(self._health_prev)):
+            sev = "ERR" if checks[name]["severity"] == "HEALTH_ERR" \
+                else "WRN"
+            self.clog.append(sev, f"{name}: {cur[name]}")
+        for name in sorted(set(self._health_prev) - set(cur)):
+            self.clog.append("INF", f"{name} cleared")
+        self._health_prev = cur
+        status = folded["status"]
+        if status != self._status_prev:
+            if self._status_prev is not None:
+                self.clog.append(
+                    "INF" if status == "HEALTH_OK" else "WRN",
+                    f"cluster health {self._status_prev} -> {status}")
+            self._status_prev = status
 
     # -- renderings ---------------------------------------------------------
 
@@ -443,7 +519,35 @@ class PGMap:
                         f'ceph_osd_perf{{ceph_daemon="{name}",'
                         f'counter="{counter}"}} {value}')
         lines.extend(self._histogram_lines())
+        lines.extend(self._profile_lines())
         return "\n".join(lines) + "\n"
+
+    def _profile_lines(self) -> List[str]:
+        """Wire-fed wire-tax profiler exposition: per-daemon per-stage
+        cumulative seconds from the report frames' ``profile`` slice
+        (daemons with profiling off ship no slice and render nothing)."""
+        lines: List[str] = []
+        rows = []
+        for name, d in sorted(self.daemons.items()):
+            prof = d.stats.get("profile")
+            if not isinstance(prof, dict):
+                continue
+            for stage, ns in sorted((prof.get("stages") or {}).items()):
+                if isinstance(ns, (int, float)):
+                    rows.append((name, stage, ns))
+        if not rows:
+            return lines
+        lines += [
+            "# HELP ceph_profile_stage_seconds_total exclusive seconds "
+            "per wire-tax profiler stage (wire-fed report slice)",
+            "# TYPE ceph_profile_stage_seconds_total counter",
+        ]
+        for name, stage, ns in rows:
+            lines.append(
+                f'ceph_profile_stage_seconds_total{{'
+                f'ceph_daemon="{name}",stage="{stage}"}} '
+                f"{ns / 1e9:.6f}")
+        return lines
 
     def _histogram_lines(self) -> List[str]:
         """Reported histogram marginals as real prometheus histogram

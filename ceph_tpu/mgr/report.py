@@ -129,38 +129,66 @@ class MgrReport:
 
 
 class LoopLagProbe:
-    """Sampled sleep-drift gauge: sleep ``interval``, measure oversleep.
+    """Event-loop lag gauge with ONE source of truth per daemon.
+
+    When the wire-tax profiler's event-loop arm is active
+    (``profile_mode`` on/full, ``ceph_tpu/profiling/loopmon.py``), the
+    probe reads ITS scheduling-latency EWMA -- every timer callback's
+    oversleep, not a 10 Hz sample -- and spawns nothing: one lag number
+    feeds both the MgrReport ``lag_ms`` field and the profiler ledger,
+    and there is no second sampled-sleep task competing with the loop
+    it measures.  With profiling off, the round-18 sampled sleeper is
+    the fallback: sleep ``interval``, measure oversleep, EWMA-smooth.
 
     Oversleep is exactly the time this daemon's event loop spent unable
     to schedule a ready task -- the per-daemon Python-wire-loop stall
-    metric.  EWMA-smoothed (``alpha``) plus a high-water mark; the hwm
-    also lands in the perf registry (``loop_lag_hwm_us``) so it rides
-    the normal counter plumbing."""
+    metric.  The hwm also lands in the perf registry
+    (``loop_lag_hwm_us``) so it rides the normal counter plumbing."""
 
     def __init__(self, perf=None, interval: float = 0.1,
                  alpha: float = 0.25):
         self.perf = perf
         self.interval = interval
         self.alpha = alpha
-        self.lag_ms = 0.0
-        self.lag_hwm_ms = 0.0
+        self._lag_ms = 0.0
+        self._lag_hwm_ms = 0.0
         self._task: Optional[asyncio.Task] = None
+
+    @staticmethod
+    def _monitor():
+        from ceph_tpu import profiling
+
+        return profiling.loop_monitor()
+
+    @property
+    def lag_ms(self) -> float:
+        mon = self._monitor()
+        return mon.lag_ms if mon is not None else self._lag_ms
+
+    @property
+    def lag_hwm_ms(self) -> float:
+        mon = self._monitor()
+        return mon.lag_hwm_ms if mon is not None else self._lag_hwm_ms
 
     async def _run(self) -> None:
         loop = asyncio.get_event_loop()
         while True:
             t0 = loop.time()
             await asyncio.sleep(self.interval)
+            if self._monitor() is not None:
+                continue  # the profiler arm took over mid-run: idle
             drift_ms = max(0.0, (loop.time() - t0 - self.interval) * 1e3)
-            self.lag_ms += self.alpha * (drift_ms - self.lag_ms)
-            if drift_ms > self.lag_hwm_ms:
-                self.lag_hwm_ms = drift_ms
+            self._lag_ms += self.alpha * (drift_ms - self._lag_ms)
+            if drift_ms > self._lag_hwm_ms:
+                self._lag_hwm_ms = drift_ms
                 if self.perf is not None:
                     self.perf.hwm("loop_lag_hwm_us", int(drift_ms * 1e3))
 
     def start(self, messenger=None, name: str = "lagprobe") -> None:
         if self._task is not None:
             return
+        if self._monitor() is not None:
+            return  # profiler loop arm active: it IS the lag source
         self._task = asyncio.get_event_loop().create_task(self._run())
         if messenger is not None:
             messenger.adopt_task(f"{name}.lagprobe", self._task)

@@ -71,8 +71,23 @@ from typing import Awaitable, Callable, Dict, Iterable, List, Optional, \
 from ceph_tpu.msg.fault import FaultInjector
 from ceph_tpu.msg.wire import decode_message, message_encoder
 from ceph_tpu.native.gf_native import crc32c
+from ceph_tpu.profiling import ledger as _profiler
 from ceph_tpu.utils.encoding import Decoder, Encoder, crc32c_parts, \
     frame, frame_parts, unframe
+
+#: wire-tax profiler cost centers (ceph_tpu/profiling/): markers are
+#: fetched ONCE here so the per-frame cost is the `with` protocol on a
+#: preallocated object -- one global-bool branch when profiling is off.
+#: Stage blocks are yield-free by construction (a stage spanning an
+#: await would bill other tasks' work to itself).
+_PS_ENCODE = _profiler.stage("wire.encode")        # envelope + part list
+_PS_SEAL = _profiler.stage("wire.crc_seal")        # crc fold + sign + frame
+_PS_CORK = _profiler.stage("wire.cork_append")     # cork-queue append
+_PS_WRITE = _profiler.stage("wire.writelines")     # the send syscall
+_PS_PARSE = _profiler.stage("wire.parse")          # _FrameReader frame scan
+_PS_ENVELOPE = _profiler.stage("wire.envelope")    # inbound head/seq/ack
+_PS_FANIN = _profiler.stage("wire.dispatch_fanin")  # per-msg dispatch prep
+_PS_DECODE = _profiler.stage("wire.decode_body")   # typed body decode
 
 #: v4 adds the trailing piggyback-ack varint on MSG frames and corked
 #: multi-frame bursts; acceptors take any version in
@@ -212,6 +227,7 @@ class _FrameReader:
         rec, _pos = unframe(header + payload, 0)
         return rec  # None if magic/crc check failed
 
+    # cephlint: wire-hot-section msgr-frame-parse
     async def next_frame(self) -> Optional[bytes]:
         """The next framed record; None on EOF or a corrupt frame (the
         caller drops the connection either way)."""
@@ -220,17 +236,19 @@ class _FrameReader:
         while True:
             buf, pos = self._buf, self._pos
             if len(buf) - pos >= 12:
-                _magic, length, _crc = struct.unpack_from("<III", buf, pos)
-                if len(buf) - pos >= 12 + length:
-                    rec, _next = unframe(buf, pos)  # magic+crc validated
-                    if rec is None:
-                        return None  # corrupt/forged: drop the connection
-                    pos += 12 + length
-                    if pos >= len(buf):
-                        self._buf, self._pos = b"", 0
-                    else:
-                        self._pos = pos
-                    return rec
+                with _PS_PARSE:
+                    _magic, length, _crc = struct.unpack_from(
+                        "<III", buf, pos)
+                    if len(buf) - pos >= 12 + length:
+                        rec, _next = unframe(buf, pos)  # magic+crc checked
+                        if rec is None:
+                            return None  # corrupt/forged: drop the conn
+                        pos += 12 + length
+                        if pos >= len(buf):
+                            self._buf, self._pos = b"", 0
+                        else:
+                            self._pos = pos
+                        return rec
             try:
                 chunk = await self._reader.read(1 << 16)
             except (ConnectionError, OSError):
@@ -239,6 +257,7 @@ class _FrameReader:
                 return None
             self._buf = buf[pos:] + chunk if pos < len(buf) else chunk
             self._pos = 0
+    # cephlint: end-wire-hot-section
 
 
 async def _read_frame(framer) -> Optional[bytes]:
@@ -439,29 +458,35 @@ class TCPMessenger:
                     item = queue.get_nowait()
 
     async def _dispatch_one(self, name: str, item) -> None:
-        src, msg = item[0], item[1]
-        cost = item[2] if len(item) > 2 else 0
-        release = None
-        claimed = [False]
-        if cost:
-            released = [False]
+        # the fan-in bookkeeping (budget hand-off plumbing) is a
+        # declared cost center; the dispatcher's own execution is the
+        # event-loop arm's territory (it awaits)
+        with _PS_FANIN:
+            src, msg = item[0], item[1]
+            cost = item[2] if len(item) > 2 else 0
+            release = None
+            claimed = [False]
+            if cost:
+                released = [False]
 
-            def release(released=released, cost=cost):
-                if not released[0]:
-                    released[0] = True
-                    self.dispatch_throttle.put(cost)
+                def release(released=released, cost=cost):
+                    if not released[0]:
+                        released[0] = True
+                        self.dispatch_throttle.put(cost)
 
-            if isinstance(msg, dict) and "op" in msg:
-                # budget hand-off: a dispatcher that only ENQUEUES the
-                # op (OSDShard's QoS queue) may claim the budget and
-                # release it when the op actually executes -- that is
-                # what makes the byte cap a real memory bound for
-                # daemons instead of a transit-only throttle.  Blocking
-                # here instead would deadlock: sub-op replies for
-                # in-flight ops arrive through this same loop.
-                msg["_budget_release"] = release
-                msg["_budget_claim"] = (
-                    lambda claimed=claimed: claimed.__setitem__(0, True))
+                if isinstance(msg, dict) and "op" in msg:
+                    # budget hand-off: a dispatcher that only ENQUEUES
+                    # the op (OSDShard's QoS queue) may claim the budget
+                    # and release it when the op actually executes --
+                    # that is what makes the byte cap a real memory
+                    # bound for daemons instead of a transit-only
+                    # throttle.  Blocking here instead would deadlock:
+                    # sub-op replies for in-flight ops arrive through
+                    # this same loop.
+                    msg["_budget_release"] = release
+                    msg["_budget_claim"] = (
+                        lambda claimed=claimed:
+                        claimed.__setitem__(0, True))
         try:
             if name not in self._marked_down:
                 try:
@@ -574,29 +599,31 @@ class TCPMessenger:
                 continue
             if kind != _K_MSG:
                 continue  # ACK frames never arrive on an inbound socket
-            for head, hsrc, hdst in heads:
-                if rec.startswith(head):
-                    src, dst = hsrc, hdst
-                    dec = Decoder(rec, len(head))
-                    break
-            else:
-                dec = Decoder(rec, 1)
-                src = dec.string()
-                dst = dec.string()
-                heads.append((rec[:dec._pos], src, dst))
-            seq = dec.varint()
-            body = dec.blob()
-            # v4 piggyback: a trailing cumulative ack for OUR reverse
-            # stream to this peer rides the data frame (v3 senders never
-            # append it; v3 receivers never read this far)
-            # cephlint: wire-optional -- v3 senders end at the blob
-            if dec.remaining():
-                back_ack = dec.varint()
-                if back_ack:
-                    sess = self._sessions.get(peer_node)
-                    if sess is not None:
-                        self._prune_acked(sess, back_ack)
-                    self.counters["acks_piggybacked_recv"] += 1
+            with _PS_ENVELOPE:
+                for head, hsrc, hdst in heads:
+                    if rec.startswith(head):
+                        src, dst = hsrc, hdst
+                        dec = Decoder(rec, len(head))
+                        break
+                else:
+                    dec = Decoder(rec, 1)
+                    src = dec.string()
+                    dst = dec.string()
+                    heads.append((rec[:dec._pos], src, dst))
+                seq = dec.varint()
+                body = dec.blob()
+                # v4 piggyback: a trailing cumulative ack for OUR
+                # reverse stream to this peer rides the data frame (v3
+                # senders never append it; v3 receivers never read this
+                # far)
+                # cephlint: wire-optional -- v3 senders end at the blob
+                if dec.remaining():
+                    back_ack = dec.varint()
+                    if back_ack:
+                        sess = self._sessions.get(peer_node)
+                        if sess is not None:
+                            self._prune_acked(sess, back_ack)
+                        self.counters["acks_piggybacked_recv"] += 1
             if seq:
                 # lossless stream (in order per TCP connection).  A dst
                 # we do not host YET (the boot window between
@@ -634,7 +661,8 @@ class TCPMessenger:
                 self._in_seqs[in_key] = seq
                 # cephlint: end-atomic-section
             try:
-                msg = decode_message(body)
+                with _PS_DECODE:
+                    msg = decode_message(body)
             except ValueError:
                 # a frame kind this build does not know (a NEWER peer's
                 # message type -- e.g. mgr report frames reaching a
@@ -964,19 +992,28 @@ class TCPMessenger:
         # the kind|src|dst head is byte-identical for every message on
         # one (src, dst) stream: encode it once and reuse (entity names
         # are a small fixed set per daemon)
-        head = self._head_cache.get((src, dst))
-        if head is None:
-            head = self._head_cache[(src, dst)] = (
-                Encoder().u8(_K_MSG).string(src).string(dst).bytes())
-        body_parts = message_encoder(msg)._parts
-        body_len = sum(map(len, body_parts))
-        pre = head + _varint_bytes(seq) + _varint_bytes(body_len)
-        if len(pre) + body_len <= _JOIN_BELOW:
-            return _QueuedMsg(seq, [b"".join([pre, *body_parts])])
-        enc = Encoder()
-        enc._parts = [pre] + body_parts
-        return _QueuedMsg(seq, enc.parts(_JOIN_BELOW))
+        with _PS_ENCODE:
+            head = self._head_cache.get((src, dst))
+            if head is None:
+                head = self._head_cache[(src, dst)] = (
+                    Encoder().u8(_K_MSG).string(src).string(dst).bytes())
+            body_parts = message_encoder(msg)._parts
+            body_len = sum(map(len, body_parts))
+            pre = head + _varint_bytes(seq) + _varint_bytes(body_len)
+            if len(pre) + body_len <= _JOIN_BELOW:
+                entry = _QueuedMsg(seq, [b"".join([pre, *body_parts])])
+            else:
+                enc = Encoder()
+                enc._parts = [pre] + body_parts
+                entry = _QueuedMsg(seq, enc.parts(_JOIN_BELOW))
+            _PS_ENCODE.add_bytes(entry.nbytes)
+            return entry
 
+    # The per-frame seal/flush seams below are DECLARED wire hot
+    # sections: payloads must cross as part lists (the zero-copy
+    # contract, docs/messenger.md) -- the wire-hot-path-alloc rule
+    # flags any provable per-frame bytes concatenation inside.
+    # cephlint: wire-hot-section msgr-seal-flush
     def _entry_frames(self, entry: _QueuedMsg, session_key,
                       ack: int) -> List:
         """On-wire buffer list for one queued message: cached payload
@@ -984,21 +1021,23 @@ class TCPMessenger:
         the frame crc EXTENDED over the tail instead of recomputed over
         the payload (the double-crc audit: each digest runs once per
         burst element, retransmits included)."""
-        crc = entry.crc
-        if crc is None:
-            crc = entry.crc = crc32c_parts(entry.parts)
-        parts = entry.parts
-        if ack:
-            tail = _varint_bytes(ack)
-            parts = parts + [tail]
-            crc = crc32c(tail, crc)
-        if session_key is not None:
-            from ceph_tpu.auth.cephx import sign_parts
+        with _PS_SEAL:
+            crc = entry.crc
+            if crc is None:
+                crc = entry.crc = crc32c_parts(entry.parts)
+            parts = entry.parts
+            if ack:
+                tail = _varint_bytes(ack)
+                parts = parts + [tail]
+                crc = crc32c(tail, crc)
+            if session_key is not None:
+                from ceph_tpu.auth.cephx import sign_parts
 
-            sig = sign_parts(session_key, parts)
-            parts = parts + [sig]
-            crc = crc32c(sig, crc)
-        return frame_parts(parts, crc)
+                sig = sign_parts(session_key, parts)
+                parts = parts + [sig]
+                crc = crc32c(sig, crc)
+            _PS_SEAL.add_bytes(entry.nbytes)
+            return frame_parts(parts, crc)
 
     def _piggy_ack_value(self, node: str) -> int:
         """Cumulative delivered watermark of the reverse stream from
@@ -1017,19 +1056,20 @@ class TCPMessenger:
         flush discipline applied to the wire.  Deadlock-free for the
         same reason: a flush depends only on the event loop running,
         never on another message's completion."""
-        q = self._cork_queues.get(node)
-        if q is None:
-            q = self._cork_queues[node] = _CorkQueue()
-        q.entries.append(entry)
-        q.nbytes += entry.nbytes
-        self.counters["msgs_sent"] += 1
-        if q.flushing:
-            return  # the slow-path flusher re-checks after its drain
-        if q.nbytes >= self.cork_bytes:
-            self._flush_now(node, q)
-        elif not q.scheduled:
-            q.scheduled = True
-            asyncio.get_event_loop().call_soon(self._cork_tick, node)
+        with _PS_CORK:
+            q = self._cork_queues.get(node)
+            if q is None:
+                q = self._cork_queues[node] = _CorkQueue()
+            q.entries.append(entry)
+            q.nbytes += entry.nbytes
+            self.counters["msgs_sent"] += 1
+            if q.flushing:
+                return  # the slow-path flusher re-checks after its drain
+            if q.nbytes >= self.cork_bytes:
+                self._flush_now(node, q)
+            elif not q.scheduled:
+                q.scheduled = True
+                asyncio.get_event_loop().call_soon(self._cork_tick, node)
 
     def _cork_tick(self, node: str) -> None:
         q = self._cork_queues.get(node)
@@ -1075,6 +1115,8 @@ class TCPMessenger:
             writer.transport.abort()
             self._conn_failed(node, writer, lossless)
             return
+        prof_on = _profiler.enabled()
+        t_burst = _time.perf_counter_ns() if prof_on else 0
         for i, entry in enumerate(batch):
             # the cumulative piggyback rides the LAST frame of the
             # burst; the receiver processes in order, one watermark
@@ -1082,11 +1124,18 @@ class TCPMessenger:
             bufs.extend(self._entry_frames(
                 entry, skey, ack if i == last else 0))
         try:
-            writer.writelines(bufs)
+            with _PS_WRITE:
+                writer.writelines(bufs)
         except (ConnectionError, OSError, RuntimeError):
             self._conn_failed(node, writer, lossless)
             return
         nbytes = sum(len(b) for b in bufs)
+        if prof_on:
+            # per-connection per-burst sub-accounting: frames/burst,
+            # bytes/burst, ns/frame percentiles (the decomposition's
+            # syscall-shape evidence)
+            _profiler.note_burst(node, len(batch), nbytes,
+                                 _time.perf_counter_ns() - t_burst)
         self.counters["bursts"] += 1
         self.counters["frames_sent"] += len(batch)
         self.counters["bytes_sent"] += nbytes
@@ -1101,6 +1150,7 @@ class TCPMessenger:
             task = asyncio.get_event_loop().create_task(
                 self._drain_conn(node, q, conn))
             self.adopt_task(f"drain.{node}.{self._cork_seq}", task)
+    # cephlint: end-wire-hot-section
 
     def _conn_failed(self, node: str, writer, lossless: bool) -> None:
         """Shared dead-connection handling for the sync send path."""
@@ -1173,11 +1223,20 @@ class TCPMessenger:
                             writer.transport.abort()
                             raise ConnectionResetError(
                                 "injected mid-burst connection kill")
+                        prof_on = _profiler.enabled()
+                        t_burst = _time.perf_counter_ns() if prof_on \
+                            else 0
                         bufs: List = []
                         for i, entry in enumerate(batch):
                             bufs.extend(self._entry_frames(
                                 entry, skey, ack if i == last else 0))
-                        writer.writelines(bufs)
+                        with _PS_WRITE:
+                            writer.writelines(bufs)
+                        if prof_on:
+                            _profiler.note_burst(
+                                node, len(batch),
+                                sum(len(b) for b in bufs),
+                                _time.perf_counter_ns() - t_burst)
                         await writer.drain()
                 except (ConnectionError, OSError, RuntimeError):
                     self._conn_failed(node, writer, lossless)

@@ -43,8 +43,19 @@ from __future__ import annotations
 import asyncio
 from typing import Awaitable, Callable, List, Optional, Sequence
 
+from ceph_tpu.profiling import ledger as _profiler
 from ceph_tpu.utils import trace
 from ceph_tpu.utils.perf import PerfCounters
+
+#: wire-tax cost centers on the submit path (ceph_tpu/profiling/):
+#: the sync gather bookkeeping and the fused dispatch call.  The
+#: dispatch marker uses the paired stage_enter/stage_exit form because
+#: dispatch_many may return a coroutine that must be awaited OUTSIDE
+#: the stage (a stage spanning an await would bill other tasks' work
+#: to itself) -- the cephlint rule `profile-stage-unpaired` checks
+#: every enter reaches an exit on all CFG paths.
+_PS_SUBMIT = _profiler.stage("coalescer.submit")
+_PS_DISPATCH = _profiler.stage("coalescer.dispatch")
 
 #: default flush thresholds: a batch larger than this dispatches without
 #: waiting for the tick to end
@@ -109,25 +120,26 @@ class BatchCoalescer:
 
     async def submit(self, item, nbytes: int = 0):
         """Queue one work item; resolves with its dispatch result."""
-        loop = asyncio.get_event_loop()
-        fut = loop.create_future()
-        # batch fan-in tracing: remember the submitting op's span so the
-        # shared dispatch becomes ONE span child of every rider (cheap:
-        # a contextvar read; NULL_SPAN rides as False)
-        span = trace.current()
-        span.event(self._ev_submit)
-        self._pending.append((item, fut, nbytes, span))
-        self._pending_bytes += nbytes
-        if (
-            len(self._pending) >= self.max_batch
-            or self._pending_bytes >= self.max_bytes
-        ):
-            self._spawn_flush(loop)
-        elif not self._flush_scheduled:
-            # queue-drain flush: end of the current tick, so every task
-            # runnable RIGHT NOW can still join this batch
-            self._flush_scheduled = True
-            loop.call_soon(self._on_tick_end, loop)
+        with _PS_SUBMIT:
+            loop = asyncio.get_event_loop()
+            fut = loop.create_future()
+            # batch fan-in tracing: remember the submitting op's span so
+            # the shared dispatch becomes ONE span child of every rider
+            # (cheap: a contextvar read; NULL_SPAN rides as False)
+            span = trace.current()
+            span.event(self._ev_submit)
+            self._pending.append((item, fut, nbytes, span))
+            self._pending_bytes += nbytes
+            if (
+                len(self._pending) >= self.max_batch
+                or self._pending_bytes >= self.max_bytes
+            ):
+                self._spawn_flush(loop)
+            elif not self._flush_scheduled:
+                # queue-drain flush: end of the current tick, so every
+                # task runnable RIGHT NOW can still join this batch
+                self._flush_scheduled = True
+                loop.call_soon(self._on_tick_end, loop)
         return await fut
 
     def _on_tick_end(self, loop) -> None:
@@ -152,6 +164,20 @@ class BatchCoalescer:
             refs = self._tasks = set()
         refs.add(task)
         task.add_done_callback(refs.discard)
+
+    def _dispatch_staged(self, items: List):
+        """The staged dispatch call, paired-marker form: the
+        synchronous ``dispatch_many`` invocation is a cost center; a
+        coroutine result is awaited by the CALLER, outside the stage
+        (stages never span a yield -- a suspended stage would bill
+        other tasks' work to itself).  profile-stage-unpaired checks
+        the enter reaches the exit on every CFG path."""
+        _profiler.stage_enter(_PS_DISPATCH)
+        try:
+            results = self._dispatch_many(items)
+        finally:
+            _profiler.stage_exit(_PS_DISPATCH)
+        return results
 
     async def _run_batch(self, batch: List[tuple]) -> None:
         admission = self.admission
@@ -179,7 +205,7 @@ class BatchCoalescer:
                 self._span_name, [sp for _i, _f, _nb, sp in batch])
             try:
                 with trace.use_span(fanin):
-                    results = self._dispatch_many(items)
+                    results = self._dispatch_staged(items)
                     if asyncio.iscoroutine(results):
                         results = await results
             except asyncio.CancelledError:

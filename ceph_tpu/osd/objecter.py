@@ -37,7 +37,12 @@ import random
 from typing import Dict, List, Optional
 
 from ceph_tpu.osd.ecbackend import ObjectIncomplete
+from ceph_tpu.profiling import ledger as _profiler
 from ceph_tpu.utils import trace
+
+#: wire-tax cost center: the client-side synchronous submit work
+#: (reqid/tid mint, op-dict build, trace stamping) per send attempt
+_PS_SUBMIT = _profiler.stage("objecter.submit")
 from ceph_tpu.utils.optracker import OpTracker
 from ceph_tpu.utils.perf import PerfCounters
 
@@ -308,16 +313,17 @@ class Objecter:
                               conflict_retries, reqid, resends, op,
                               wire_ctx):
         while True:
-            self._tid += 1
-            tid = self._tid
-            fut = loop.create_future()
-            self._pending[tid] = fut
-            msg = dict(fields, op="client_op", tid=tid, kind=kind, oid=oid,
-                       pool=self.pool, reqid=list(reqid))
-            if self.qos_class is not None:
-                msg["qos_class"] = self.qos_class
-            if wire_ctx is not None:
-                msg["trace"] = wire_ctx
+            with _PS_SUBMIT:
+                self._tid += 1
+                tid = self._tid
+                fut = loop.create_future()
+                self._pending[tid] = fut
+                msg = dict(fields, op="client_op", tid=tid, kind=kind,
+                           oid=oid, pool=self.pool, reqid=list(reqid))
+                if self.qos_class is not None:
+                    msg["qos_class"] = self.qos_class
+                if wire_ctx is not None:
+                    msg["trace"] = wire_ctx
             try:
                 primary = self._primary_abs(oid)
                 await self.messenger.send_message(self.name, primary, msg)

@@ -570,6 +570,17 @@ class OSDShard:
             stats["residency"] = dict(residency.counters().snapshot())
         except Exception:  # noqa: BLE001 -- reports must never fail
             pass
+        try:
+            # wire-tax profiler slice (ceph_tpu/profiling/): per-stage
+            # ns + loop/GC scalars; None (omitted) when profile_mode is
+            # off.  Same one-ledger-per-process caveat as residency.
+            from ceph_tpu import profiling
+
+            prof_slice = profiling.report_slice()
+            if prof_slice is not None:
+                stats["profile"] = prof_slice
+        except Exception:  # noqa: BLE001 -- reports must never fail
+            pass
         return stats
 
     def _op_cost(self, msg) -> int:

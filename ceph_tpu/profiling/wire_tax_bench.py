@@ -1,0 +1,265 @@
+"""Wire-tax benchmark stage: the ranked bill of costs for ROADMAP 2.
+
+Round 17 measured the saturated cluster-path ceiling (~250 ops/s at
+99% CPU in the Python wire loop) but could not say WHERE the 99% goes;
+this stage runs the same saturated full-stack path (client Objecter ->
+primary -> k+m fan-out over real localhost TCP, ``msg/cluster_bench.py``
+harness) under the wire-tax profiler and emits the decomposition table
+ROADMAP item 2's native transport will execute against.
+
+Four gates, every one raising on violation:
+
+* **coverage**: the decomposition (declared stages + GC + event-loop
+  residual) must sum to >= ``coverage_min_pct`` (90%) of the measured
+  saturated wall -- an attribution that misses a tenth of the wall is
+  aimed blind;
+* **enabled overhead**: profiling ``on`` (ledger + loop/GC arms) must
+  cost <= ``overhead_limit_pct`` (3%) vs off, measured as per-block
+  off/on ratios (modes back to back so machine drift cancels, min
+  ratio across blocks, bounded retries -- the trace_bench discipline);
+* **disabled overhead**: exactly zero ALLOCATIONS -- the deterministic
+  form of "exactly zero": a ``sys.getallocatedblocks`` delta of 0
+  across thousands of disabled marker cycles (a wall-clock zero is not
+  measurable against noise; the off path is the same code minus one
+  branch, and the alloc pin is what keeps it that way).  The off/off
+  wall ratio is also reported, un-gated, as evidence;
+* **export contract**: a short ``full``-mode segment must produce a
+  speedscope document with the schema's required keys and at least one
+  stage-attributed profile.
+
+Used by bench.py (``wire_tax_host`` + the ``wire_tax_*`` headline
+keys), ``tools/ec_benchmark.py --workload wire-tax [--smoke]``, and
+``tools/ci_lint.sh --profile-smoke``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import gc
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ceph_tpu import profiling
+
+
+def _restore_mode(prior: str) -> None:
+    profiling.configure(mode=prior if prior in ("off", "on", "full")
+                        else "off")
+
+
+async def _cycle(harness, payloads: Dict[str, bytes],
+                 writers: int) -> float:
+    write_s = await harness.run_writes(payloads, writers)
+    read_s, got = await harness.run_reads(payloads, writers)
+    for oid, data in payloads.items():
+        if got.get(oid) != data:
+            raise AssertionError(
+                f"wire-tax: read-back of {oid} mismatched")
+    return write_s + read_s
+
+
+def _alloc_pin(cycles: int = 20000) -> int:
+    """The off-mode zero-allocation pin: disabled marker enter/exit
+    must allocate NOTHING beyond the bare loop scaffolding.  The
+    measurement is control-subtracted -- the identical loop without the
+    markers is measured alongside, so interpreter bookkeeping (range
+    iterators, freelist growth) cancels and the returned delta is the
+    markers' own contribution, deterministically."""
+    if profiling.enabled():
+        raise AssertionError("wire-tax: alloc pin must run with "
+                             "profiling off")
+    m1 = profiling.stage("wire.encode")
+    m2 = profiling.stage("wire.crc32c")
+
+    def marked():
+        for _ in range(cycles):
+            with m1:
+                with m2:
+                    pass
+
+    def control():
+        for _ in range(cycles):
+            pass
+
+    def measure(fn):
+        base = sys.getallocatedblocks()
+        fn()
+        return sys.getallocatedblocks() - base
+
+    marked()  # warm: bytecode/freelist steady state
+    control()
+    gc.disable()
+    try:
+        deltas = [measure(marked) - measure(control)
+                  for _trial in range(3)]
+    finally:
+        gc.enable()
+    return min(deltas)
+
+
+def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
+                       obj_bytes: int = 16 << 10, writers: int = 12,
+                       iters: int = 2, seed: int = 191,
+                       coverage_min_pct: float = 90.0,
+                       overhead_limit_pct: float = 3.0,
+                       retries: int = 3,
+                       n_osds: Optional[int] = None,
+                       top_n: int = 5) -> dict:
+    """The full stage; raises on any gate violation.  Returns the
+    JSON-ready dict bench.py records as ``wire_tax_host``."""
+    from ceph_tpu.msg.cluster_bench import ClusterHarness, make_payloads
+
+    if ec is None:
+        from ceph_tpu.plugins import registry as registry_mod
+
+        ec = registry_mod.instance().factory(
+            "jerasure", {"k": "4", "m": "2",
+                         "technique": "reed_sol_van"})
+    if n_osds is None:
+        n_osds = ec.get_chunk_count()
+    payloads = make_payloads(n_objects, obj_bytes, seed)
+    from ceph_tpu.utils.config import get_config
+
+    prior_mode = str(get_config().get_val("profile_mode"))
+    profiling.configure(mode="off")
+    # gate 3 first: it requires profiling off and is deterministic
+    alloc_delta = _alloc_pin()
+    if alloc_delta != 0:
+        raise AssertionError(
+            f"wire-tax: disabled markers allocated {alloc_delta} "
+            "blocks over the pin loop -- the off path must be "
+            "allocation-free")
+    loop = asyncio.new_event_loop()
+    harness = ClusterHarness(ec, n_osds, cork=True, pool="wiretaxpool")
+    out: dict = {
+        "n_objects": n_objects, "obj_bytes": obj_bytes,
+        "writers": writers, "n_osds": n_osds,
+        "coverage_min_pct": coverage_min_pct,
+        "overhead_limit_pct": overhead_limit_pct,
+        "wire_tax_alloc_blocks_off": alloc_delta,
+    }
+    try:
+        loop.run_until_complete(harness.start())
+        for oid in payloads:
+            harness.objecter.acting_set(oid)
+        # warm: TCP sessions, codec tables, placement -- off-profile
+        loop.run_until_complete(_cycle(harness, payloads, writers))
+
+        # -- overhead: per-block off/on (+ off/off evidence) ratios ---
+        ratios: List[float] = []
+        off_off: List[float] = []
+        attempts = 0
+        while True:
+            attempts += 1
+            for _ in range(max(1, iters)):
+                profiling.configure(mode="off")
+                off_a = loop.run_until_complete(
+                    _cycle(harness, payloads, writers))
+                off_b = loop.run_until_complete(
+                    _cycle(harness, payloads, writers))
+                profiling.configure(mode="on")
+                profiling.reset()
+                on_s = loop.run_until_complete(
+                    _cycle(harness, payloads, writers))
+                ratios.append(on_s / min(off_a, off_b))
+                off_off.append(off_b / off_a)
+            overhead = (min(ratios) - 1) * 100
+            if overhead <= overhead_limit_pct or \
+                    attempts >= max(1, retries):
+                break
+        if overhead > overhead_limit_pct:
+            raise AssertionError(
+                f"wire-tax: enabled-profiler overhead {overhead:.2f}% "
+                f"exceeds the {overhead_limit_pct}% gate after "
+                f"{attempts} attempts")
+        out["wire_tax_overhead_pct_enabled"] = round(overhead, 3)
+        out["wire_tax_overhead_pct_off"] = round(
+            (min(off_off) - 1) * 100, 3)
+        out["overhead_attempts"] = attempts
+
+        # -- the decomposition segment (the artifact) -----------------
+        profiling.configure(mode="on")
+        profiling.reset()
+        t0 = time.perf_counter_ns()
+        seg_cycles = max(2, iters)
+        for _ in range(seg_cycles):
+            loop.run_until_complete(_cycle(harness, payloads, writers))
+        wall_ns = time.perf_counter_ns() - t0
+        decomp = profiling.decomposition(wall_ns)
+        snap = profiling.snapshot()
+        if decomp["coverage_pct"] < coverage_min_pct:
+            raise AssertionError(
+                f"wire-tax: decomposition covers "
+                f"{decomp['coverage_pct']}% of the saturated wall, "
+                f"below the {coverage_min_pct}% gate -- the "
+                "attribution is missing a cost center")
+        ops = seg_cycles * 2 * n_objects  # writes + reads
+        out["wire_tax_ops_per_sec"] = round(ops / (wall_ns / 1e9), 1)
+        out["wire_tax_coverage_pct"] = decomp["coverage_pct"]
+        out["decomposition"] = decomp
+        out["wire_tax_top"] = [
+            {"stage": r["stage"], "pct": r["pct"], "ns": r["ns"],
+             "calls": r["calls"]}
+            for r in decomp["rows"][:top_n]
+        ]
+        out["bursts"] = snap["bursts"]
+        out["loop"] = {
+            k: snap["loop"][k]
+            for k in ("lag_ms", "lag_hwm_ms", "gc_ns",
+                      "gc_collections", "callbacks", "callback_ns")
+        } if "loop" in snap else None
+
+        # -- export contract: a short full-mode sampled segment -------
+        profiling.configure(mode="full")
+        loop.run_until_complete(_cycle(harness, payloads, writers))
+        sampler = profiling.current_sampler()
+        time.sleep(0.05)  # let the sampler thread land its last snap
+        speedscope = sampler.speedscope()
+        for key in ("$schema", "shared", "profiles"):
+            if key not in speedscope:
+                raise AssertionError(
+                    f"wire-tax: speedscope export missing {key!r}")
+        if not speedscope["profiles"] or \
+                not speedscope["shared"]["frames"]:
+            raise AssertionError(
+                "wire-tax: speedscope export carries no samples")
+        out["sampler"] = {
+            "samples": sampler.samples,
+            "stage_shares": sampler.stage_shares(),
+            "speedscope_profiles": len(speedscope["profiles"]),
+            "collapsed_lines": len(sampler.collapsed().splitlines()),
+        }
+    finally:
+        try:
+            loop.run_until_complete(harness.shutdown())
+        finally:
+            loop.close()
+            _restore_mode(prior_mode)
+    return out
+
+
+def main(argv=None) -> int:
+    """``python -m ceph_tpu.profiling.wire_tax_bench [--smoke]``: the
+    ci_lint --profile-smoke arm -- tiny shapes, loose gates, every gate
+    still armed."""
+    import argparse
+    import json
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes + loose coverage/overhead gates "
+                         "(CI; bench.py runs the real gates)")
+    args = ap.parse_args(argv)
+    if args.smoke:
+        result = run_wire_tax_bench(
+            n_objects=8, obj_bytes=4096, writers=4, iters=1,
+            coverage_min_pct=50.0, overhead_limit_pct=50.0)
+    else:
+        result = run_wire_tax_bench()
+    print(json.dumps(result, indent=2), file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -88,8 +88,12 @@ class AdminSocket:
 
 
 async def admin_command(path: str, prefix: str, **fields):
-    """Client side (the ``ceph daemon <sock> <cmd>`` role)."""
-    reader, writer = await asyncio.open_unix_connection(path)
+    """Client side (the ``ceph daemon <sock> <cmd>`` role).  The read
+    limit is raised well past asyncio's 64 KiB default: one-line JSON
+    replies grow with the daemon (the prometheus exposition and the
+    profiler's speedscope dump both cross 64 KiB on a busy daemon)."""
+    reader, writer = await asyncio.open_unix_connection(
+        path, limit=64 << 20)
     writer.write(json.dumps(dict(fields, prefix=prefix)).encode() + b"\n")
     await writer.drain()
     line = await reader.readline()

@@ -242,6 +242,23 @@ OPTIONS: Dict[str, Option] = {
              "slowest finished root spans retained past ring churn "
              "(the optracker historic-slow discipline)",
              see_also=("trace_keep",)),
+        _opt("profile_mode", str, "off", LEVEL_ADVANCED,
+             "wire-tax profiler (ceph_tpu/profiling/): 'off' (default "
+             "-- instrumented seams cost one branch, allocate nothing), "
+             "'on' (stage cost ledger + event-loop/GC arms; the <=3%-"
+             "overhead configuration the bench wire_tax stage gates), "
+             "'full' ('on' plus the continuous stack sampler for "
+             "speedscope/flamegraph export)",
+             see_also=("profile_sample_hz", "profile_topk")),
+        _opt("profile_sample_hz", float, 87.0, LEVEL_ADVANCED,
+             "stack-sampler frequency in profile_mode=full (off the "
+             "round numbers so it cannot phase-lock with periodic "
+             "work)",
+             see_also=("profile_mode",)),
+        _opt("profile_topk", int, 20, LEVEL_ADVANCED,
+             "slow-callback and stage rows returned by the profile "
+             "admin-socket/CLI views",
+             see_also=("profile_mode",)),
         _opt("osd_op_complaint_time", float, 5.0, LEVEL_ADVANCED,
              "an op slower than this logs a slow-op warning with its "
              "full decomposed timeline and is retained by "

@@ -40,6 +40,11 @@ from typing import Any, List, Optional, Tuple
 import numpy as np
 
 from ceph_tpu.native.gf_native import crc32c
+from ceph_tpu.profiling import ledger as _profiler
+
+#: wire-tax cost center for the incremental frame digest (one marker,
+#: fetched once; a global-bool branch when profiling is off)
+_PS_CRC = _profiler.stage("wire.crc32c")
 
 _MAGIC = 0xCE9B10C5
 
@@ -288,10 +293,15 @@ def crc32c_parts(parts, crc: Optional[int] = None) -> int:
     castagnoli chains, so ``crc(a||b) == crc32c(b, crc32c(a))``.  Pass
     ``crc`` to continue a digest already folded over earlier parts (the
     messenger caches each queued message's payload crc once and only
-    folds the per-transmission tail on retransmit)."""
-    for p in parts:
-        crc = crc32c(p) if crc is None else crc32c(p, crc)
-    return crc32c(b"") if crc is None else crc
+    folds the per-transmission tail on retransmit).
+
+    A wire-tax cost center (``wire.crc32c``): runs once per burst
+    element, nested inside the messenger's ``wire.crc_seal`` stage --
+    exclusive accounting splits the digest from the seal bookkeeping."""
+    with _PS_CRC:
+        for p in parts:
+            crc = crc32c(p) if crc is None else crc32c(p, crc)
+        return crc32c(b"") if crc is None else crc
 
 
 def frame(payload: bytes) -> bytes:

@@ -1,0 +1,84 @@
+"""profile-stage-unpaired fixture: every ``stage_enter`` must reach a
+``stage_exit`` on every CFG path (try/finally is the idiom); the
+``with stage(...):`` form closes itself.  Annotated lines are the
+rule's exact expected findings."""
+
+import asyncio
+
+from ceph_tpu.profiling import ledger as profiling
+
+_PS = profiling.stage("fixture.stage")
+_PS2 = profiling.stage("fixture.other")
+
+
+def work():
+    return 1
+
+
+def leak_no_exit():
+    profiling.stage_enter(_PS)  # LINT: profile-stage-unpaired
+    return work()
+
+
+def leak_one_branch(flag):
+    profiling.stage_enter(_PS)  # LINT: profile-stage-unpaired
+    if flag:
+        return None  # this path leaves the stage open
+    profiling.stage_exit(_PS)
+    return flag
+
+
+async def leak_enter_then_await():
+    profiling.stage_enter(_PS)  # LINT: profile-stage-unpaired
+    await asyncio.sleep(0)
+
+
+def ok_paired():
+    profiling.stage_enter(_PS)
+    out = work()
+    profiling.stage_exit(_PS)
+    return out
+
+
+def ok_try_finally():
+    profiling.stage_enter(_PS)
+    try:
+        out = work()
+    finally:
+        profiling.stage_exit(_PS)
+    return out
+
+
+async def ok_exit_before_await():
+    # the coalescer-dispatch idiom: stage the sync call in a
+    # try/finally, exit, THEN await the coroutine outside the stage
+    profiling.stage_enter(_PS2)
+    try:
+        coro = asyncio.sleep(0)
+    finally:
+        profiling.stage_exit(_PS2)
+    await coro
+
+
+def leak_return_inside_try(flag):
+    # a `return` inside the try jumps straight out: the CFG (and the
+    # interpreter, for the value expression) leaves before the exit
+    profiling.stage_enter(_PS)  # LINT: profile-stage-unpaired
+    if flag:
+        return work()
+    profiling.stage_exit(_PS)
+    return None
+
+
+def ok_with_form():
+    with profiling.stage("fixture.with"):
+        return work()
+
+
+def ok_every_branch_exits(flag):
+    profiling.stage_enter(_PS)
+    if flag:
+        profiling.stage_exit(_PS)
+        return 1
+    profiling.stage_exit(_PS)
+    return 0

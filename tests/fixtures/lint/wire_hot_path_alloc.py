@@ -1,0 +1,37 @@
+"""wire-hot-path-alloc fixture: per-frame bytes concatenation inside a
+declared ``cephlint: wire-hot-section`` region.  Part lists, joins and
+out-of-section code are clean; annotated lines are the rule's exact
+expected findings."""
+
+
+def hot_seal_loop(frames):
+    out = []
+    # cephlint: wire-hot-section fixture-hot
+    buf = b""
+    for f in frames:
+        buf = buf + f  # LINT: wire-hot-path-alloc
+        pre = b"\x00\x01" + f  # LINT: wire-hot-path-alloc
+        buf += b"tail"  # LINT: wire-hot-path-alloc
+        out.append(pre)  # clean: part-list append
+        parts = [pre] + [f]  # clean: list concatenation
+        total = len(pre) + len(f)  # clean: int arithmetic
+    # cephlint: end-wire-hot-section
+    joined = b"".join(out)  # clean: outside the section
+    tail = joined + b"!"  # clean: outside the section
+    return buf, parts, total, tail
+
+
+def hot_inferred_chain(chunks):
+    # cephlint: wire-hot-section fixture-inferred
+    head = bytes(8)
+    for c in chunks:
+        rec = head + c  # LINT: wire-hot-path-alloc
+        head = rec[2:]  # a slice of bytes stays bytes (inference)
+    # cephlint: end-wire-hot-section
+    return head
+
+
+def malformed_section(x):
+    # an end marker with no begin is a declaration bug, not silence
+    # cephlint: end-wire-hot-section  # LINT: wire-hot-path-alloc
+    return x

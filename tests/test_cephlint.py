@@ -64,6 +64,10 @@ FIXTURES = {
     # PR-16 observability: started spans must reach finish() on every
     # CFG path (or escape / ride a `with` block)
     "trace_span_unfinished.py": None,
+    # PR-19 wire-tax profiler: paired stage markers must close on every
+    # CFG path, and declared wire hot sections stay concatenation-free
+    "profile_stage_unpaired.py": None,
+    "wire_hot_path_alloc.py": None,
     "suppressions.py": None,
 }
 

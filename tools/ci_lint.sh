@@ -18,6 +18,10 @@
 #      just one the diff happened to touch.
 #
 # Usage: tools/ci_lint.sh [sarif-output-path]
+#        tools/ci_lint.sh --profile-smoke
+#   --profile-smoke runs ONLY the wire-tax profiler smoke
+#   (ec_benchmark --workload wire-tax --smoke: every attribution gate
+#   armed at CI shape) and exits with its status.
 #   CEPHLINT_SARIF_OUT overrides the default cephlint.sarif.
 #   CEPHLINT_NO_SMOKE=1 skips the transfer + multichip smokes
 #   (lint-only runners).
@@ -25,6 +29,17 @@
 set -eu
 
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--profile-smoke" ]; then
+    # wire-tax profiler smoke (round 19): the saturated-path cost
+    # decomposition, profiler overhead and off-mode zero-allocation
+    # pins all stay armed at smoke shape; any violation exits nonzero
+    JAX_PLATFORMS=cpu python tools/ec_benchmark.py --workload wire-tax \
+        --smoke > /dev/null
+    echo "cephlint: wire-tax profiler smoke passed" >&2
+    exit 0
+fi
+
 out="${1:-${CEPHLINT_SARIF_OUT:-cephlint.sarif}}"
 
 python tools/cephlint.py --changed --format sarif > "$out"

@@ -40,7 +40,7 @@ def parse_args(argv):
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
                             "recovery-path", "mesh-path", "trace-path",
-                            "qos-path", "telemetry-path"])
+                            "qos-path", "telemetry-path", "wire-tax"])
     p.add_argument("--smoke", action="store_true",
                    help="qos-path/telemetry-path: the fast CI shape "
                         "(shrunk client counts and durations, loose "
@@ -227,6 +227,37 @@ def main(argv=None) -> int:
             f"{result['reports_folded']} reports folded, chaos "
             f"degraded peak {result['chaos']['degraded_max']} -> "
             f"{result['chaos']['health_final']}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "wire-tax":
+        # Wire-tax attribution stage (round 19): the saturated cluster
+        # path under the hot-path profiler (ceph_tpu/profiling/) --
+        # decomposition coverage >=90%, enabled overhead <=3%, off-mode
+        # allocations exactly zero, speedscope export contract.  Any
+        # gate violation exits nonzero.
+        import json
+
+        from ceph_tpu.profiling.wire_tax_bench import run_wire_tax_bench
+
+        if args.smoke:
+            result = run_wire_tax_bench(
+                n_objects=8, obj_bytes=4096, writers=4, iters=1,
+                coverage_min_pct=50.0, overhead_limit_pct=50.0)
+        else:
+            result = run_wire_tax_bench(
+                n_objects=args.objects, obj_bytes=args.size,
+                writers=args.writers, iters=max(1, args.iterations))
+        print(json.dumps(result))
+        top = ", ".join(
+            f"{r['stage']} {r['pct']}%" for r in result["wire_tax_top"])
+        print(
+            f"wire-tax: {result['wire_tax_ops_per_sec']} ops/s "
+            f"decomposed at {result['wire_tax_coverage_pct']}% "
+            f"coverage (enabled overhead "
+            f"{result['wire_tax_overhead_pct_enabled']}%, off allocs "
+            f"{result['wire_tax_alloc_blocks_off']}); top: {top}",
             file=sys.stderr,
         )
         return 0

@@ -22,6 +22,8 @@ Usage:
   rados_cli.py --dir RUN recovery status
   rados_cli.py --dir RUN ops [in-flight|historic|slow]
   rados_cli.py --dir RUN trace [status|<trace_id>]
+  rados_cli.py --dir RUN profile [status|dump|reset]
+  rados_cli.py --dir RUN log last [n]
   rados_cli.py --dir RUN setomapval <obj> <key> <value>
   rados_cli.py --dir RUN listomapvals <obj>
 """
@@ -258,6 +260,65 @@ async def _run(args) -> int:
         if not found:
             print("no daemons with a trace admin socket",
                   file=sys.stderr)
+            return 1
+        return 0
+    if args.cmd == "log":
+        # the mgr-local cluster event log (clog analogue): health
+        # transitions and slow-op warnings in arrival order
+        n = 20
+        if args.args and args.args[0] == "last" and len(args.args) > 1:
+            n = int(args.args[1])
+        reply = await _mgr_command(args.dir, "log last", count=n)
+        if reply is None:
+            print("no reachable mgr (cluster started with --mgrs 0?)",
+                  file=sys.stderr)
+            return 1
+        for entry in reply["lines"]:
+            print(f"{entry['stamp']:.3f} {entry['severity']} "
+                  f"{entry['message']}")
+        return 0
+    if args.cmd == "profile":
+        # wire-tax profiler (ceph_tpu/profiling/): per-daemon cost
+        # centers over the admin socket
+        want = args.args[0] if args.args else "status"
+        found = False
+        for sock in _asoks(args.dir):
+            if want == "status":
+                st = await admin_command(sock, "profile status")
+                if "error" in st:
+                    continue
+                found = True
+                print(f"{st.get('name', sock)}\tmode {st['mode']}\t"
+                      f"stages {st['stages_active']} "
+                      f"({st['stage_ns_total']}ns)\t"
+                      f"lag {st.get('lag_ms', '-')}ms\t"
+                      f"gc {st.get('gc_collections', '-')} pauses")
+            elif want == "reset":
+                st = await admin_command(sock, "profile reset")
+                if "error" in st:
+                    continue
+                found = True
+                print(f"{os.path.basename(sock)}\treset")
+            else:  # dump
+                st = await admin_command(sock, "profile dump")
+                if "error" in st:
+                    continue
+                found = True
+                daemon = os.path.basename(sock).rsplit(".asok", 1)[0]
+                print(f"{daemon}\tmode {st['mode']}")
+                for stage, row in sorted(
+                        st["stages"].items(),
+                        key=lambda kv: -kv[1]["ns"]):
+                    print(f"  {stage}\t{row['ns']}ns\t"
+                          f"{row['calls']} calls\t{row['bytes']}B")
+                bursts = st.get("bursts") or {}
+                if bursts.get("frames_observed"):
+                    print(f"  ns/frame p50 {bursts['ns_per_frame_p50']}"
+                          f" p99 {bursts['ns_per_frame_p99']} over "
+                          f"{bursts['frames_observed']} frames")
+        if not found:
+            print("no daemons with a profile admin socket "
+                  "(profile_mode off?)", file=sys.stderr)
             return 1
         return 0
     if args.cmd == "residency" or args.cmd == "residency-status":
