@@ -848,6 +848,28 @@ def main() -> int:
             wt_host["wire_tax_alloc_blocks_off"] if wt_host else None),
         "wire_tax_top": (
             wt_host["wire_tax_top"] if wt_host else None),
+        # round-20 native-codec A/B (gated inside the stage: frame
+        # bytes identical across codecs, serialization share <= half
+        # the python-mode share, ops/s >= 1.5x the python baseline)
+        "wire_codec_native_enabled": (
+            wt_host.get("wire_codec_native_enabled") if wt_host
+            else None),
+        "wire_codec_native_ops_per_sec": (
+            wt_host.get("wire_codec_native_ops_per_sec") if wt_host
+            else None),
+        "wire_codec_python_ops_per_sec": (
+            wt_host.get("wire_codec_python_ops_per_sec") if wt_host
+            else None),
+        "wire_codec_gain": (
+            wt_host.get("wire_codec_gain") if wt_host else None),
+        "wire_codec_serialization_share_native_pct": (
+            wt_host.get("wire_codec_serialization_share_native_pct")
+            if wt_host else None),
+        "wire_codec_serialization_share_python_pct": (
+            wt_host.get("wire_codec_serialization_share_python_pct")
+            if wt_host else None),
+        "wire_codec_share_ratio": (
+            wt_host.get("wire_codec_share_ratio") if wt_host else None),
         "wire_tax_host": wt_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
@@ -923,8 +945,12 @@ def main() -> int:
         f" ops/s decomposed at "
         f"{wt_host['wire_tax_coverage_pct'] if wt_host else '?'}% "
         f"coverage (top: "
-        f"{wt_host['wire_tax_top'][0]['stage'] if wt_host else '?'}) on "
-        f"{jax.devices()[0].platform}",
+        f"{wt_host['wire_tax_top'][0]['stage'] if wt_host else '?'}), "
+        f"native-codec gain "
+        f"{wt_host.get('wire_codec_gain') if wt_host else '?'}x "
+        f"(serialization share ratio "
+        f"{wt_host.get('wire_codec_share_ratio') if wt_host else '?'}) "
+        f"on {jax.devices()[0].platform}",
         file=sys.stderr,
     )
     print(json.dumps(result))

@@ -78,6 +78,11 @@ async def serve(args) -> None:
         await asok.start()
     print(f"{name} up http {http_port}", flush=True)
 
+    # startup warm-up is over: freeze the boot heap out of the
+    # collector (gc_freeze_on_start; the r19 gc-pause-tax fix)
+    from ceph_tpu.utils import gcopt
+
+    gcopt.freeze_after_warmup()
     stop = asyncio.Event()
     loop = asyncio.get_event_loop()
     for sig in (signal.SIGTERM, signal.SIGINT):

@@ -102,6 +102,11 @@ async def serve(args) -> None:
         f"{name}.bootstrap",
         asyncio.get_event_loop().create_task(bootstrap()))
 
+    # startup warm-up is over: freeze the boot heap out of the
+    # collector (gc_freeze_on_start; the r19 gc-pause-tax fix)
+    from ceph_tpu.utils import gcopt
+
+    gcopt.freeze_after_warmup()
     stop = asyncio.get_event_loop().create_future()
 
     def _stop(*_a):

@@ -53,6 +53,10 @@ class ClientGroup:
     mode: str = "closed"          # "closed" | "open"
     rate_ops_s: float = 2.0       # per client, open-loop only
     think_s: float = 0.0          # closed-loop think time
+    #: closed-loop vectorized submit: > 1 drives put/get through
+    #: Objecter.submit_many in chunks of this many sampled ops (one
+    #: submit stage crossing + one wire burst per chunk)
+    batch_ops: int = 1
 
 
 @dataclasses.dataclass(frozen=True)
@@ -274,6 +278,7 @@ class ScenarioRunner:
                     objecter, PROFILES[group.profile],
                     random.Random(self.scenario.seed * 1000 + seq),
                     arrival=arrival, perf=self.perf,
+                    batch_ops=group.batch_ops,
                 )
                 members.append(client)
                 self.clients.append(client)

@@ -270,6 +270,23 @@ def prometheus_text(state: dict) -> str:
     # device), so these carry no ceph_daemon label -- a rising
     # jit_retraces under steady traffic means the batch-shape bucketing
     # regressed; a rising d2h on the write path means residency broke.
+    # native wire codec availability (the degraded-build gauge: 0 means
+    # the pure-Python codec is serving the wire -- gated off or no
+    # toolchain; wire bytes identical, serialization share is not)
+    try:
+        from ceph_tpu.native import wire_codec as _wire_codec
+
+        _wc = _wire_codec.status()
+        lines += [
+            "# HELP ceph_wire_codec_native whether the batched native "
+            "wire codec (_wire_native) is serving the frame path",
+            "# TYPE ceph_wire_codec_native gauge",
+            f'ceph_wire_codec_native{{enabled='
+            f'"{"true" if _wc["enabled"] else "false"}"}} '
+            f'{1 if _wc["enabled"] else 0}',
+        ]
+    except Exception:  # noqa: BLE001 -- the scrape must never break on
+        pass           # an optional native-extension probe
     try:
         from ceph_tpu.analysis import residency as _residency
 

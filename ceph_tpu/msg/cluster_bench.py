@@ -103,22 +103,31 @@ class ClusterHarness:
         self.messengers.append(self.client)
 
     async def run_writes(self, payloads: Dict[str, bytes],
-                         writers: int) -> float:
+                         writers: int, batch: int = 0) -> float:
         """Write every payload with ``writers`` concurrent client
-        workers; returns the wall time."""
+        workers; returns the wall time.  ``batch`` > 1 drives the
+        vectorized submit path: each worker hands ``batch``-sized op
+        chunks to ``Objecter.write_many`` -- one submit stage crossing
+        and one wire burst per chunk instead of per op."""
         queue = list(payloads.items())
         t0 = time.perf_counter()
 
         async def worker():
             while queue:
-                oid, data = queue.pop()
-                await self.objecter.write(oid, data)
+                if batch > 1:
+                    chunk = [queue.pop() for _ in
+                             range(min(batch, len(queue)))]
+                    if chunk:
+                        await self.objecter.write_many(chunk)
+                else:
+                    oid, data = queue.pop()
+                    await self.objecter.write(oid, data)
 
         await asyncio.gather(*(worker() for _ in range(max(1, writers))))
         return time.perf_counter() - t0
 
     async def run_reads(self, payloads: Dict[str, bytes],
-                        readers: int) -> tuple:
+                        readers: int, batch: int = 0) -> tuple:
         """Read every object back; returns (wall, {oid: bytes})."""
         queue = list(payloads)
         got: Dict[str, bytes] = {}
@@ -126,8 +135,16 @@ class ClusterHarness:
 
         async def worker():
             while queue:
-                oid = queue.pop()
-                got[oid] = await self.objecter.read(oid)
+                if batch > 1:
+                    chunk = [queue.pop() for _ in
+                             range(min(batch, len(queue)))]
+                    if chunk:
+                        for oid, data in zip(
+                                chunk, await self.objecter.read_many(chunk)):
+                            got[oid] = data
+                else:
+                    oid = queue.pop()
+                    got[oid] = await self.objecter.read(oid)
 
         await asyncio.gather(*(worker() for _ in range(max(1, readers))))
         return time.perf_counter() - t0, got

@@ -70,6 +70,7 @@ from typing import Awaitable, Callable, Dict, Iterable, List, Optional, \
 
 from ceph_tpu.msg.fault import FaultInjector
 from ceph_tpu.msg.wire import decode_message, message_encoder
+from ceph_tpu.native import wire_codec
 from ceph_tpu.native.gf_native import crc32c
 from ceph_tpu.profiling import ledger as _profiler
 from ceph_tpu.utils.encoding import Decoder, Encoder, crc32c_parts, \
@@ -114,6 +115,11 @@ _ACK_DELAY = 0.025
 #: larger payloads stay scatter-gather so big blobs cross by reference
 _JOIN_BELOW = 4096
 
+#: serve-loop sentinel for an inbound body this build cannot decode
+#: (a newer peer's frame kind): distinct from None, which is a
+#: perfectly legal MSG_VALUE payload
+_UNDECODABLE = object()
+
 
 def _varint_bytes(v: int) -> bytes:
     """LEB128 unsigned varint as standalone bytes (the piggyback-ack
@@ -138,11 +144,16 @@ class _QueuedMsg:
 
     __slots__ = ("seq", "parts", "crc", "nbytes", "t_enq")
 
-    def __init__(self, seq: int, parts: List):
+    def __init__(self, seq: int, parts: List, crc: Optional[int] = None,
+                 nbytes: Optional[int] = None):
         self.seq = seq
         self.parts = parts
-        self.crc: Optional[int] = None
-        self.nbytes = sum(len(p) for p in parts)
+        #: payload crc32c: the native encoder folds it in the same pass
+        #: that composes the parts; the Python path computes it lazily
+        #: on first transmit (_entry_frames)
+        self.crc = crc
+        self.nbytes = sum(len(p) for p in parts) if nbytes is None \
+            else nbytes
         #: enqueue stamp: ack-lag (enqueue -> delivery-ack prune) feeds
         #: the per-node ack_lag latency histogram (observability)
         self.t_enq = _time.monotonic()
@@ -206,13 +217,22 @@ class _FrameReader:
     cluster-path bench A/Bs the whole wire architecture, not just the
     send side."""
 
-    __slots__ = ("_reader", "_buf", "_pos", "_buffered")
+    __slots__ = ("_reader", "_buf", "_pos", "_buffered", "_native",
+                 "_pending", "_pending_idx", "_corrupt")
 
-    def __init__(self, reader: asyncio.StreamReader, buffered: bool = True):
+    def __init__(self, reader: asyncio.StreamReader, buffered: bool = True,
+                 native=None):
         self._reader = reader
         self._buf = b""
         self._pos = 0
         self._buffered = buffered
+        #: the loaded _wire_native extension (or None = pure Python):
+        #: a whole received burst is scanned + crc-validated in ONE
+        #: GIL-released pass and served frame by frame from _pending
+        self._native = native
+        self._pending: List[bytes] = []
+        self._pending_idx = 0
+        self._corrupt = False
 
     async def _next_frame_per_message(self) -> Optional[bytes]:
         try:
@@ -233,6 +253,8 @@ class _FrameReader:
         caller drops the connection either way)."""
         if not self._buffered:
             return await self._next_frame_per_message()
+        if self._native is not None:
+            return await self._next_frame_native()
         while True:
             buf, pos = self._buf, self._pos
             if len(buf) - pos >= 12:
@@ -249,6 +271,42 @@ class _FrameReader:
                         else:
                             self._pos = pos
                         return rec
+            try:
+                chunk = await self._reader.read(1 << 16)
+            except (ConnectionError, OSError):
+                return None
+            if not chunk:
+                return None
+            self._buf = buf[pos:] + chunk if pos < len(buf) else chunk
+            self._pos = 0
+
+    async def _next_frame_native(self) -> Optional[bytes]:
+        """Native burst parse: every complete frame buffered on the
+        socket is located and crc-validated in one GIL-released C pass
+        (_wire_native.parse_burst); frames are then served from the
+        pending list with zero per-frame Python parsing."""
+        while True:
+            if self._pending_idx < len(self._pending):
+                rec = self._pending[self._pending_idx]
+                self._pending_idx += 1
+                return rec
+            if self._corrupt:
+                return None  # forged/torn frame: drop the conn
+            buf, pos = self._buf, self._pos
+            if len(buf) - pos >= 12:
+                with _PS_PARSE:
+                    frames, newpos, ok = self._native.parse_burst(buf, pos)
+                if frames:
+                    self._pending = frames
+                    self._pending_idx = 0
+                    self._corrupt = not ok
+                    if newpos >= len(buf):
+                        self._buf, self._pos = b"", 0
+                    else:
+                        self._pos = newpos
+                    continue
+                if not ok:
+                    return None
             try:
                 chunk = await self._reader.read(1 << 16)
             except (ConnectionError, OSError):
@@ -331,6 +389,12 @@ class TCPMessenger:
         self.cork = bool(cfg.get_val("osd_msgr_cork")) if cork is None \
             else bool(cork)
         self.cork_bytes = int(cfg.get_val("osd_msgr_cork_bytes"))
+        #: batched native wire codec (_wire_native via
+        #: ceph_tpu/native/wire_codec.py), resolved once per messenger:
+        #: None = the pure-Python codec (gated off, no toolchain, or
+        #: osd_wire_codec_native=false -- the A/B baseline).  Every
+        #: native seam below falls back bit-exactly through msg/wire.py.
+        self._native = wire_codec.native()
         self._cork_queues: Dict[str, _CorkQueue] = {}
         self._cork_seq = 0
         #: (src entity, dst entity) -> encoded kind|src|dst MSG head
@@ -525,7 +589,8 @@ class TCPMessenger:
     async def _serve_connection_inner(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
-        framer = _FrameReader(reader, buffered=self.cork)
+        framer = _FrameReader(reader, buffered=self.cork,
+                              native=self._native)
         banner = await framer.next_frame()
         if banner is None:
             writer.close()
@@ -603,27 +668,57 @@ class TCPMessenger:
                 for head, hsrc, hdst in heads:
                     if rec.startswith(head):
                         src, dst = hsrc, hdst
-                        dec = Decoder(rec, len(head))
+                        hlen = len(head)
                         break
                 else:
                     dec = Decoder(rec, 1)
                     src = dec.string()
                     dst = dec.string()
                     heads.append((rec[:dec._pos], src, dst))
-                seq = dec.varint()
-                body = dec.blob()
-                # v4 piggyback: a trailing cumulative ack for OUR
-                # reverse stream to this peer rides the data frame (v3
-                # senders never append it; v3 receivers never read this
-                # far)
-                # cephlint: wire-optional -- v3 senders end at the blob
-                if dec.remaining():
-                    back_ack = dec.varint()
-                    if back_ack:
-                        sess = self._sessions.get(peer_node)
-                        if sess is not None:
-                            self._prune_acked(sess, back_ack)
-                        self.counters["acks_piggybacked_recv"] += 1
+                    hlen = dec._pos
+            # envelope tail + typed body: the native codec parses both
+            # in one C pass straight from the record buffer; a decode
+            # it cannot express (never a well-formed peer's frame, by
+            # the interop property tests) re-parses through the pure
+            # Python codec below, so behavior is identical either way.
+            # ``msg is _UNDECODABLE`` = unknown body kind: the
+            # watermark still advances and the frame is counted-and-
+            # dropped -- forward compat for newer peers' frame kinds.
+            nat = self._native
+            seq = None
+            if nat is not None:
+                try:
+                    with _PS_DECODE:
+                        seq, msg, back_ack = nat.decode_msg(rec, hlen)
+                    if msg is nat.UNKNOWN:
+                        msg = _UNDECODABLE
+                except ValueError:
+                    seq = None
+            if seq is None:
+                with _PS_ENVELOPE:
+                    dec = Decoder(rec, hlen)
+                    seq = dec.varint()
+                    body = dec.blob()
+                    # v4 piggyback: a trailing cumulative ack for OUR
+                    # reverse stream to this peer rides the data frame
+                    # (v3 senders never append it; v3 receivers never
+                    # read this far)
+                    # cephlint: wire-optional -- v3 senders end at the
+                    # blob
+                    back_ack = dec.varint() if dec.remaining() else None
+                try:
+                    with _PS_DECODE:
+                        msg = decode_message(body)
+                except ValueError:
+                    # a frame kind this build does not know (a NEWER
+                    # peer's message type): ignore-and-count below,
+                    # after the watermark bookkeeping
+                    msg = _UNDECODABLE
+            if back_ack:
+                sess = self._sessions.get(peer_node)
+                if sess is not None:
+                    self._prune_acked(sess, back_ack)
+                self.counters["acks_piggybacked_recv"] += 1
             if seq:
                 # lossless stream (in order per TCP connection).  A dst
                 # we do not host YET (the boot window between
@@ -660,12 +755,8 @@ class TCPMessenger:
                     continue  # duplicate from a replay: already delivered
                 self._in_seqs[in_key] = seq
                 # cephlint: end-atomic-section
-            try:
-                with _PS_DECODE:
-                    msg = decode_message(body)
-            except ValueError:
-                # a frame kind this build does not know (a NEWER peer's
-                # message type -- e.g. mgr report frames reaching a
+            if msg is _UNDECODABLE:
+                # unknown frame kind (e.g. mgr report frames reaching a
                 # pre-report daemon): the watermark already advanced, so
                 # ignore-and-count is exactly "old daemon ignores report
                 # frames" forward compat; tearing the connection down
@@ -781,7 +872,8 @@ class TCPMessenger:
 
         host, port = self.addr_map[node]
         reader, writer = await asyncio.open_connection(host, port)
-        framer = _FrameReader(reader, buffered=self.cork)
+        framer = _FrameReader(reader, buffered=self.cork,
+                              native=self._native)
         nonce = AuthHandshake.new_nonce() if self.keyring is not None else b""
         banner = (
             Encoder().string(_BANNER).varint(_PROTOCOL_VERSION)
@@ -997,6 +1089,19 @@ class TCPMessenger:
             if head is None:
                 head = self._head_cache[(src, dst)] = (
                     Encoder().u8(_K_MSG).string(src).string(dst).bytes())
+            nat = self._native
+            if nat is not None:
+                try:
+                    # one C pass: head + seq/length varints + typed body
+                    # as a scatter part list, payload crc folded along
+                    # the way (the transmit-time seal only extends it)
+                    parts, nbytes, crc = nat.encode_entry(head, seq, msg)
+                except nat.FallbackError:
+                    pass  # a value outside the C model: python encodes
+                else:
+                    entry = _QueuedMsg(seq, parts, crc=crc, nbytes=nbytes)
+                    _PS_ENCODE.add_bytes(nbytes)
+                    return entry
             body_parts = message_encoder(msg)._parts
             body_len = sum(map(len, body_parts))
             pre = head + _varint_bytes(seq) + _varint_bytes(body_len)
@@ -1117,19 +1222,31 @@ class TCPMessenger:
             return
         prof_on = _profiler.enabled()
         t_burst = _time.perf_counter_ns() if prof_on else 0
-        for i, entry in enumerate(batch):
-            # the cumulative piggyback rides the LAST frame of the
-            # burst; the receiver processes in order, one watermark
-            # covers every earlier frame too
-            bufs.extend(self._entry_frames(
-                entry, skey, ack if i == last else 0))
+        nat = self._native
+        if nat is not None and skey is None:
+            # the whole batch sealed in one C call: frame headers +
+            # piggyback-ack tail composed natively, cached payload
+            # crcs extended (signed connections keep the Python seal:
+            # the hmac runs per transmission either way)
+            with _PS_SEAL:
+                bufs, nbytes = nat.seal_frames(batch, ack)
+                _PS_SEAL.add_bytes(nbytes)
+        else:
+            for i, entry in enumerate(batch):
+                # the cumulative piggyback rides the LAST frame of the
+                # burst; the receiver processes in order, one watermark
+                # covers every earlier frame too
+                bufs.extend(self._entry_frames(
+                    entry, skey, ack if i == last else 0))
+            nbytes = -1
         try:
             with _PS_WRITE:
                 writer.writelines(bufs)
         except (ConnectionError, OSError, RuntimeError):
             self._conn_failed(node, writer, lossless)
             return
-        nbytes = sum(len(b) for b in bufs)
+        if nbytes < 0:
+            nbytes = sum(len(b) for b in bufs)
         if prof_on:
             # per-connection per-burst sub-accounting: frames/burst,
             # bytes/burst, ns/frame percentiles (the decomposition's
@@ -1227,9 +1344,15 @@ class TCPMessenger:
                         t_burst = _time.perf_counter_ns() if prof_on \
                             else 0
                         bufs: List = []
-                        for i, entry in enumerate(batch):
-                            bufs.extend(self._entry_frames(
-                                entry, skey, ack if i == last else 0))
+                        nat = self._native
+                        if nat is not None and skey is None:
+                            with _PS_SEAL:
+                                bufs, _nb = nat.seal_frames(batch, ack)
+                                _PS_SEAL.add_bytes(_nb)
+                        else:
+                            for i, entry in enumerate(batch):
+                                bufs.extend(self._entry_frames(
+                                    entry, skey, ack if i == last else 0))
                         with _PS_WRITE:
                             writer.writelines(bufs)
                         if prof_on:

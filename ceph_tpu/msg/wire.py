@@ -15,7 +15,10 @@ from __future__ import annotations
 
 from typing import Tuple
 
+import numpy as np
+
 from ceph_tpu.mgr.report import MgrBeacon, MgrReport
+from ceph_tpu.native import wire_codec
 from ceph_tpu.osd.types import (
     ECSubRead,
     ECSubReadReply,
@@ -26,6 +29,21 @@ from ceph_tpu.osd.types import (
     TxnOp,
 )
 from ceph_tpu.utils.encoding import Decoder, Encoder
+
+# hand the native batched codec (ceph_tpu/native/wire_native.c) the
+# message dataclasses it constructs: the C decode calls the SAME
+# constructors this module does, and the C encode is property-tested
+# byte-identical to the functions below (tests/test_wire_native.py).
+# The functions in this module stay pure Python on purpose -- they are
+# the fallback the transport runs bit-exactly when the extension is
+# gated off (CEPH_TPU_NATIVE=0 / osd_wire_codec_native=false) or the
+# host has no toolchain.
+wire_codec.initialize(
+    ec_sub_write=ECSubWrite, ec_sub_write_reply=ECSubWriteReply,
+    ec_sub_read=ECSubRead, ec_sub_read_reply=ECSubReadReply,
+    transaction=Transaction, txn_op=TxnOp, log_entry=LogEntry,
+    mgr_beacon=MgrBeacon, mgr_report=MgrReport, np_integer=np.integer,
+)
 
 # message type codes (the reference's CEPH_MSG_* / MSG_OSD_EC_* ids)
 _MSG_VALUE = 0

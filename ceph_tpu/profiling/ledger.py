@@ -10,11 +10,13 @@ schedule first, then re-arrange it.
 Design constraints (all load-bearing):
 
 * **Markers are cached and reusable.**  ``stage(name)`` returns ONE
-  :class:`StageMarker` per name for the process lifetime; instrumented
-  modules fetch their markers at import time, so the per-frame cost is
-  the ``with`` protocol on a preallocated object -- no dict lookup, no
-  allocation on the hot path.  Enabled-mode bookkeeping touches only
-  ``__slots__`` ints and two ``perf_counter_ns`` reads.
+  marker per name for the process lifetime; instrumented modules fetch
+  their markers at import time, so the per-frame cost is the ``with``
+  protocol on a preallocated object -- no dict lookup, no allocation
+  on the hot path.  Round 20: the marker is ``_wire_native.Stage``
+  (C: two ``clock_gettime`` reads + struct-field math) when the native
+  extension loads, and the pure-Python :class:`StageMarker` twin
+  otherwise -- identical semantics, selected once at import.
 * **Off is (allocation-)free.**  Disabled markers take one global-bool
   branch in ``__enter__``/``__exit__`` and allocate NOTHING -- the
   off-mode pin in tests/test_profiling.py asserts a zero
@@ -122,12 +124,32 @@ class StageMarker:
             self.nbytes += n
 
 
+#: round 20: the marker hot path moves to C with the native wire
+#: extension (_wire_native.Stage -- identical exclusive-time semantics
+#: at clock_gettime cost).  Selected ONCE at profiler import: against
+#: the native codec's halved wire wall the Python markers' ~0.6us/pair
+#: became a >3% enabled overhead, failing the wire-tax stage's own
+#: gate; the C twin restores the r19 contract.  Python markers remain
+#: the degraded-build fallback (CEPH_TPU_NATIVE=0 / no toolchain), and
+#: reset()/snapshot()/gc_credit speak to both through the same
+#: attribute surface.
+_native_stages = None
+try:
+    from ceph_tpu.native import wire_codec as _wire_codec
+
+    _native_stages = _wire_codec.native()
+except Exception:  # noqa: BLE001 -- any loader surprise means the
+    _native_stages = None  # Python markers carry the ledger
+
+
 def stage(name: str) -> StageMarker:
     """The process-wide marker for ``name`` (created on first use;
     instrumented modules call this once at import)."""
     m = _markers.get(name)
     if m is None:
-        m = _markers[name] = StageMarker(name)
+        impl = StageMarker if _native_stages is None \
+            else _native_stages.Stage
+        m = _markers[name] = impl(name)
     return m
 
 
@@ -153,6 +175,9 @@ def gc_credit(ns: int) -> None:
     clock ran through the collector, so pushing its start stamp forward
     by the pause keeps stage time and gc time disjoint (the
     decomposition sums without double counting)."""
+    if _native_stages is not None:
+        _native_stages.stage_gc_credit(ns)
+        return
     cur = _current
     if cur is not None:
         cur._t0 += ns
@@ -161,6 +186,8 @@ def gc_credit(ns: int) -> None:
 def current_stage_name() -> Optional[str]:
     """The innermost active stage (the sampler's attribution read;
     racy by design -- a sample is a sample)."""
+    if _native_stages is not None:
+        return _native_stages.stage_current_name()
     cur = _current
     return cur.name if cur is not None else None
 
@@ -216,6 +243,8 @@ def set_enabled(on: bool) -> None:
     _enabled = bool(on)
     if not on:
         _current = None
+    if _native_stages is not None:
+        _native_stages.stage_set_enabled(_enabled)
 
 
 def stages_snapshot() -> Dict[str, dict]:

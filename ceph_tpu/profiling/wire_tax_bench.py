@@ -25,7 +25,15 @@ Four gates, every one raising on violation:
   wall ratio is also reported, un-gated, as evidence;
 * **export contract**: a short ``full``-mode segment must produce a
   speedscope document with the schema's required keys and at least one
-  stage-attributed profile.
+  stage-attributed profile;
+* **native-codec A/B** (round 20): the r14/r19 architecture (pure
+  Python codec, per-op submit) vs the r20 one (native batched codec +
+  vectorized Objecter submit) on the same payloads -- frame bytes
+  byte-identical across codecs asserted in the gate itself, the
+  serialization cost centers at <= half their python-mode share of the
+  saturated wall, and ops/s at >= 1.5x the remeasured python-mode
+  baseline.  Skipped (and recorded) when the native codec is
+  unavailable -- the graceful-fallback contract.
 
 Used by bench.py (``wire_tax_host`` + the ``wire_tax_*`` headline
 keys), ``tools/ec_benchmark.py --workload wire-tax [--smoke]``, and
@@ -49,14 +57,70 @@ def _restore_mode(prior: str) -> None:
 
 
 async def _cycle(harness, payloads: Dict[str, bytes],
-                 writers: int) -> float:
-    write_s = await harness.run_writes(payloads, writers)
-    read_s, got = await harness.run_reads(payloads, writers)
+                 writers: int, batch: int = 0) -> float:
+    write_s = await harness.run_writes(payloads, writers, batch=batch)
+    read_s, got = await harness.run_reads(payloads, writers, batch=batch)
     for oid, data in payloads.items():
         if got.get(oid) != data:
             raise AssertionError(
                 f"wire-tax: read-back of {oid} mismatched")
     return write_s + read_s
+
+
+def _serialization_share(decomp: dict) -> float:
+    """The serialization cost centers' summed share of the wall: the
+    r19 bill's wire.encode + wire.decode_body + wire.envelope rows --
+    exactly what the native codec exists to shrink."""
+    return round(sum(
+        row["pct"] for row in decomp["rows"]
+        if row["stage"] in ("wire.encode", "wire.decode_body",
+                            "wire.envelope")), 3)
+
+
+def _codec_frame_bytes_gate() -> None:
+    """Native and Python codecs must emit byte-identical frame bodies
+    for representative typed messages -- asserted INSIDE the A/B gate,
+    so a codec drift can never hide behind a throughput win."""
+    from ceph_tpu.msg import wire
+    from ceph_tpu.native import wire_codec
+    from ceph_tpu.osd.types import (ECSubRead, ECSubReadReply,
+                                    ECSubWrite, ECSubWriteReply,
+                                    LogEntry, Transaction)
+
+    nat = wire_codec.native()
+    if nat is None:
+        raise AssertionError("wire-tax codec A/B: native codec gone "
+                             "mid-run")
+    txn = Transaction().write("o@1", 0, b"\xa5" * 16384)
+    txn.setattr("o@1", "hinfo", {"crc": [1, 2, 3, 4], "sz": 16384})
+    sample = [
+        ECSubWrite(1, 7, "o@1", txn, (3, "osd.1"),
+                   [LogEntry(3, "o@1", "append", 16)],
+                   reqid=("c", 12, 34), trace=[5, 1, 0],
+                   qos_class="gold"),
+        ECSubWriteReply(2, 7, committed=True, applied=True,
+                        current_version=(5, "osd.0")),
+        ECSubRead(0, 9, to_read={"a": [(0, 4096)]},
+                  attrs_to_read=["hinfo"]),
+        ECSubReadReply(3, 9,
+                       buffers_read={"a": [(0, b"\x5a" * 4096)]},
+                       attrs_read={"a": {}}, errors={}),
+        {"op": "client_op", "tid": 5, "kind": "write", "oid": "o",
+         "pool": "p", "data": b"d" * 16384, "reqid": ["c", 1, 2],
+         "snapc": None},
+        {"op": "client_reply", "tid": 5, "ok": True, "result": None},
+    ]
+    for msg in sample:
+        py = wire.encode_message(msg)
+        na = nat.encode_body(msg)
+        if py != na:
+            raise AssertionError(
+                "wire-tax codec A/B: native and Python codecs emitted "
+                f"different bytes for {type(msg).__name__}")
+        if wire.decode_message(na) != nat.decode_body(py):
+            raise AssertionError(
+                "wire-tax codec A/B: cross-decode mismatch for "
+                f"{type(msg).__name__}")
 
 
 def _alloc_pin(cycles: int = 20000) -> int:
@@ -105,7 +169,10 @@ def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
                        overhead_limit_pct: float = 3.0,
                        retries: int = 3,
                        n_osds: Optional[int] = None,
-                       top_n: int = 5) -> dict:
+                       top_n: int = 5,
+                       codec_gain_min: float = 1.5,
+                       codec_share_ratio_max: float = 0.5,
+                       codec_batch: int = 8) -> dict:
     """The full stage; raises on any gate violation.  Returns the
     JSON-ready dict bench.py records as ``wire_tax_host``."""
     from ceph_tpu.msg.cluster_bench import ClusterHarness, make_payloads
@@ -147,6 +214,14 @@ def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
         loop.run_until_complete(_cycle(harness, payloads, writers))
 
         # -- overhead: per-block off/on (+ off/off evidence) ratios ---
+        # Each measurement block runs TWO cycles: the native codec
+        # halved the cycle wall, and a single ~150ms cycle is inside
+        # this harness's machine-noise band -- the min-of-ratios
+        # defense needs blocks long enough that a ratio means anything.
+        async def _block():
+            return (await _cycle(harness, payloads, writers)
+                    + await _cycle(harness, payloads, writers))
+
         ratios: List[float] = []
         off_off: List[float] = []
         attempts = 0
@@ -154,14 +229,11 @@ def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
             attempts += 1
             for _ in range(max(1, iters)):
                 profiling.configure(mode="off")
-                off_a = loop.run_until_complete(
-                    _cycle(harness, payloads, writers))
-                off_b = loop.run_until_complete(
-                    _cycle(harness, payloads, writers))
+                off_a = loop.run_until_complete(_block())
+                off_b = loop.run_until_complete(_block())
                 profiling.configure(mode="on")
                 profiling.reset()
-                on_s = loop.run_until_complete(
-                    _cycle(harness, payloads, writers))
+                on_s = loop.run_until_complete(_block())
                 ratios.append(on_s / min(off_a, off_b))
                 off_off.append(off_b / off_a)
             overhead = (min(ratios) - 1) * 100
@@ -210,6 +282,91 @@ def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
                       "gc_collections", "callbacks", "callback_ns")
         } if "loop" in snap else None
 
+        # -- native-codec A/B (the round-20 architecture gate) --------
+        # The r14/r19 wire architecture (pure-Python codec, per-op
+        # submit) against the r20 one (native batched codec +
+        # vectorized Objecter submit), same payloads, each read-back
+        # gated inside its cycles.  Three gates when the native codec
+        # is available: frame bytes byte-identical across codecs
+        # (asserted directly, IN this gate), the serialization cost
+        # centers (wire.encode + wire.decode_body + wire.envelope) at
+        # <= codec_share_ratio_max of their python-mode share of the
+        # saturated wall, and ops/s >= codec_gain_min x the python-mode
+        # baseline (the ~250 ops/s r14 ceiling remeasured in-run).
+        # Native unavailable (no toolchain / CEPH_TPU_NATIVE=0) skips
+        # the gates and records the degraded state -- the graceful-
+        # fallback contract keeps this stage green everywhere.
+        from ceph_tpu.native import wire_codec as _wire_codec
+        from ceph_tpu.utils.config import get_config as _get_config
+
+        out["wire_codec_native_enabled"] = _wire_codec.enabled()
+        if out["wire_codec_native_enabled"]:
+            _codec_frame_bytes_gate()
+            out["wire_codec_frame_bytes_identical"] = True
+            cfg2 = _get_config()
+            prior_codec = bool(cfg2.get_val("osd_wire_codec_native"))
+            ab: Dict[str, dict] = {}
+            seg_cycles2 = max(2, iters)
+            try:
+                for mode, native_on, batch in (
+                        ("python", False, 0),
+                        ("native", True, codec_batch)):
+                    cfg2.apply_changes({"osd_wire_codec_native":
+                                        native_on})
+                    h2 = ClusterHarness(ec, n_osds, cork=True,
+                                        pool=f"wcab{mode}")
+                    loop.run_until_complete(h2.start())
+                    try:
+                        for oid in payloads:
+                            h2.objecter.acting_set(oid)
+                        loop.run_until_complete(
+                            _cycle(h2, payloads, writers, batch=batch))
+                        profiling.configure(mode="on")
+                        profiling.reset()
+                        t0 = time.perf_counter_ns()
+                        for _ in range(seg_cycles2):
+                            loop.run_until_complete(_cycle(
+                                h2, payloads, writers, batch=batch))
+                        wall2 = time.perf_counter_ns() - t0
+                        ab[mode] = {
+                            "ops_per_sec": round(
+                                seg_cycles2 * 2 * n_objects
+                                / (wall2 / 1e9), 1),
+                            "serialization_share_pct":
+                                _serialization_share(
+                                    profiling.decomposition(wall2)),
+                        }
+                        profiling.configure(mode="off")
+                    finally:
+                        loop.run_until_complete(h2.shutdown())
+            finally:
+                cfg2.apply_changes(
+                    {"osd_wire_codec_native": prior_codec})
+            gain = ab["native"]["ops_per_sec"] / \
+                max(1e-9, ab["python"]["ops_per_sec"])
+            ratio = ab["native"]["serialization_share_pct"] / \
+                max(1e-9, ab["python"]["serialization_share_pct"])
+            out["wire_codec_python_ops_per_sec"] = \
+                ab["python"]["ops_per_sec"]
+            out["wire_codec_native_ops_per_sec"] = \
+                ab["native"]["ops_per_sec"]
+            out["wire_codec_gain"] = round(gain, 3)
+            out["wire_codec_serialization_share_python_pct"] = \
+                ab["python"]["serialization_share_pct"]
+            out["wire_codec_serialization_share_native_pct"] = \
+                ab["native"]["serialization_share_pct"]
+            out["wire_codec_share_ratio"] = round(ratio, 3)
+            if ratio > codec_share_ratio_max:
+                raise AssertionError(
+                    f"wire-tax codec A/B: serialization share with the "
+                    f"native codec is {ratio:.2f}x the python-mode "
+                    f"share, above the {codec_share_ratio_max} gate")
+            if gain < codec_gain_min:
+                raise AssertionError(
+                    f"wire-tax codec A/B: {gain:.2f}x ops/s over the "
+                    f"python-codec baseline, below the "
+                    f"{codec_gain_min}x gate")
+
         # -- export contract: a short full-mode sampled segment -------
         profiling.configure(mode="full")
         loop.run_until_complete(_cycle(harness, payloads, writers))
@@ -254,7 +411,8 @@ def main(argv=None) -> int:
     if args.smoke:
         result = run_wire_tax_bench(
             n_objects=8, obj_bytes=4096, writers=4, iters=1,
-            coverage_min_pct=50.0, overhead_limit_pct=50.0)
+            coverage_min_pct=50.0, overhead_limit_pct=50.0,
+            codec_gain_min=0.5, codec_share_ratio_max=0.95)
     else:
         result = run_wire_tax_bench()
     print(json.dumps(result, indent=2), file=sys.stderr)

@@ -373,6 +373,20 @@ OPTIONS: Dict[str, Option] = {
              "many pending frame bytes flushes immediately instead of "
              "waiting for the end-of-tick flush",
              see_also=("osd_msgr_cork",)),
+        _opt("osd_wire_codec_native", bool, True, LEVEL_ADVANCED,
+             "batch-encode/decode v4 frame bodies through the "
+             "_wire_native C extension (ceph_tpu/native/wire_codec.py); "
+             "false runs the pure-Python codec in msg/wire.py -- wire "
+             "bytes are identical either way (the A/B baseline and the "
+             "no-toolchain degraded mode)",
+             see_also=("native", "osd_msgr_cork")),
+        _opt("gc_freeze_on_start", bool, True, LEVEL_ADVANCED,
+             "after daemon startup warm-up, gc.freeze() the boot-time "
+             "heap into the permanent generation and raise the gen0 "
+             "threshold: the r19 profiler measured gc pauses growing "
+             "2.6%->11.1% of the saturated wall on a loaded heap, and "
+             "the startup object graph (codecs, maps, config, jitted "
+             "callables) never becomes garbage while the daemon lives"),
         _opt("ms_inject_socket_failures", int, 0, LEVEL_DEV,
              "inject a message drop roughly every N messages"),
         _opt("ms_inject_internal_delays", float, 0.0, LEVEL_DEV,
@@ -386,6 +400,12 @@ OPTIONS: Dict[str, Option] = {
         # schema stays the single source of truth (cephlint
         # ceph-config-undeclared-key enforces it) and `config show`
         # surfaces them.  Defaults mirror the call-site fallbacks.
+        _opt("native", bool, True, LEVEL_DEV,
+             "master toggle for the native C extensions on the wire "
+             "path (CEPH_TPU_NATIVE=0 forces every codec seam to pure "
+             "Python -- the no-toolchain degraded mode, log-once + "
+             "ceph_wire_codec_native gauge)",
+             see_also=("osd_wire_codec_native",)),
         _opt("no_h2d_cache", bool, False, LEVEL_DEV,
              "disable the device-side H2D stripe cache in the batching "
              "pipeline (ops/pipeline.py; bench.py toggles this per run "

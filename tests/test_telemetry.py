@@ -99,6 +99,11 @@ def test_old_daemon_ignores_report_frames_over_tcp():
             got.append(msg)
 
         receiver.register("b", dispatch)
+        # the simulated old build predates the native codec too: pin
+        # the receiver to the pure-Python decode seam this test patches
+        # (the NATIVE receiver's unknown-kind drop is covered by
+        # tests/test_wire_native.py)
+        receiver._native = None
         real_decode = tcp_mod.decode_message
 
         def pre_report_decode(body):
@@ -414,10 +419,17 @@ def test_wire_fed_health_wipe_to_clean_over_tcp():
     from ceph_tpu.plugins import registry as registry_mod
 
     cfg = get_config()
-    tuned = {"mgr_beacon_interval": 0.05, "mgr_report_interval": 0.1,
+    # The wiped data must be big enough that the degraded window spans
+    # several report intervals: the round-20 native wire loop rebuilds
+    # a 24x8KiB wipe in tens of milliseconds -- faster than one report
+    # tick -- which made the transition invisible to the wire-fed
+    # series this test exists to observe.  256KiB objects (plus the
+    # faster report/sample cadence below) keep the drain observable
+    # without slowing the rebuild itself.
+    tuned = {"mgr_beacon_interval": 0.05, "mgr_report_interval": 0.05,
              "mgr_daemon_beacon_grace": 1.0, "mgr_pg_stale_grace": 2.0,
              "osd_tick_interval": 0.25, "osd_recovery_sleep": 0.05,
-             "osd_recovery_batch_bytes": 32 << 10}
+             "osd_recovery_batch_bytes": 256 << 10}
     prior = {k: cfg.get_val(k) for k in tuned}
 
     async def main():
@@ -453,7 +465,7 @@ def test_wire_fed_health_wipe_to_clean_over_tcp():
         client = Objecter(client_mess, km, n, placement=placement,
                           pool="p")
         for i in range(24):
-            await client.write(f"w{i}", bytes([i]) * 8192)
+            await client.write(f"w{i}", bytes([i]) * (256 << 10))
         for _ in range(60):
             await asyncio.sleep(0.1)
             if mgr.pgmap.health()["status"] == "HEALTH_OK" and \
@@ -491,8 +503,8 @@ def test_wire_fed_health_wipe_to_clean_over_tcp():
         for shard in shards:
             shard.request_peering()
         series = []
-        for _ in range(200):
-            await asyncio.sleep(0.1)
+        for _ in range(400):
+            await asyncio.sleep(0.05)
             series.append(mgr.pgmap.totals()["degraded"])
             if series[-1] == 0 and max(series) > 0 and \
                     mgr.pgmap.health()["status"] == "HEALTH_OK":
@@ -506,7 +518,7 @@ def test_wire_fed_health_wipe_to_clean_over_tcp():
         assert mgr.pgmap.health()["status"] == "HEALTH_OK"
         # data integrity after the rebuild
         for i in range(24):
-            assert await client.read(f"w{i}") == bytes([i]) * 8192
+            assert await client.read(f"w{i}") == bytes([i]) * (256 << 10)
         # the aggregated exposition carries the wire-fed series
         text = mgr.pgmap.prometheus_text()
         assert "ceph_degraded_objects 0" in text

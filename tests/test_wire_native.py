@@ -1,0 +1,539 @@
+"""Native batched wire codec: interop, fallback, vectorized submit.
+
+The round-20 contract (docs/messenger.md "Native wire codec"):
+
+* the C codec (`ceph_tpu/native/wire_native.c`) emits BYTE-IDENTICAL
+  frame bodies to the pure-Python codec in ``msg/wire.py`` and decodes
+  to equal message structs -- property-tested over a randomized corpus
+  and over real TCP in both directions (native sender -> forced-Python
+  receiver and back);
+* trailing-optional compat tails (pre-reqid / pre-trace / pre-qos /
+  pre-lag senders) decode identically through both codecs;
+* an unknown inbound frame kind is counted-and-dropped with the
+  connection intact (forward compat), native path included;
+* forcing the fallback (``osd_wire_codec_native=false`` or
+  ``CEPH_TPU_NATIVE=0``) keeps every wire path working pure-Python;
+* ``Objecter.submit_many`` (one submit stage crossing + one wire burst
+  per primary) is bit-exact vs per-op submit and keeps failover
+  semantics;
+* ``gc.freeze`` after warm-up shrinks full-collection pauses on a
+  loaded heap (the r19 gc-tax satellite), profiler-measured.
+"""
+
+import asyncio
+import gc
+import random
+
+import numpy as np
+import pytest
+
+from ceph_tpu.mgr.report import MgrBeacon, MgrReport
+from ceph_tpu.msg import wire
+from ceph_tpu.native import wire_codec
+from ceph_tpu.osd.types import (
+    ECSubRead,
+    ECSubReadReply,
+    ECSubWrite,
+    ECSubWriteReply,
+    LogEntry,
+    Transaction,
+    TxnOp,
+)
+from ceph_tpu.utils.config import get_config
+from ceph_tpu.utils.encoding import Encoder
+
+NATIVE = wire_codec.native()
+
+pytestmark = pytest.mark.skipif(
+    NATIVE is None, reason="native wire codec unavailable (degraded "
+    "build: the forced-fallback test below still runs)")
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+# -- corpus generation -------------------------------------------------------
+
+def _rand_value(rng: random.Random, depth: int = 0):
+    kinds = ["int", "negint", "str", "bytes", "none", "bool", "float"]
+    if depth < 3:
+        kinds += ["list", "tuple", "dict"]
+    kind = rng.choice(kinds)
+    if kind == "int":
+        return rng.randrange(1 << rng.randrange(1, 63))
+    if kind == "negint":
+        return -rng.randrange(1, 1 << 40)
+    if kind == "str":
+        return "".join(rng.choice("abcé中 xyz")
+                       for _ in range(rng.randrange(8)))
+    if kind == "bytes":
+        return bytes(rng.randrange(256)
+                     for _ in range(rng.randrange(32)))
+    if kind == "none":
+        return None
+    if kind == "bool":
+        return rng.random() < 0.5
+    if kind == "float":
+        return rng.random() * 1e6 - 5e5
+    if kind == "list":
+        return [_rand_value(rng, depth + 1)
+                for _ in range(rng.randrange(4))]
+    if kind == "tuple":
+        return tuple(_rand_value(rng, depth + 1)
+                     for _ in range(rng.randrange(4)))
+    return {f"k{i}": _rand_value(rng, depth + 1)
+            for i in range(rng.randrange(4))}
+
+
+def _rand_sub_write(rng: random.Random) -> ECSubWrite:
+    txn = Transaction()
+    for _ in range(rng.randrange(3)):
+        txn.write(f"o{rng.randrange(4)}@1", rng.randrange(1 << 20),
+                  bytes(rng.randrange(256)
+                        for _ in range(rng.randrange(5000))))
+    txn.ops.append(TxnOp("setattr", oid="o@1", attr_name="hinfo",
+                         attr_value=_rand_value(rng)))
+    return ECSubWrite(
+        rng.randrange(8), rng.randrange(1 << 30), f"o{rng.randrange(4)}@1",
+        txn, (rng.randrange(100), f"osd.{rng.randrange(8)}"),
+        [LogEntry(rng.randrange(100), "o@1",
+                  rng.choice(["append", "touch", "delete"]),
+                  rng.randrange(1 << 16))
+         for _ in range(rng.randrange(3))],
+        op_class=rng.choice(["client", "recovery"]),
+        rollback=rng.random() < 0.2,
+        prev_version=rng.choice([None, (3, "osd.1")]),
+        reqid=rng.choice([None, ("c", 12, rng.randrange(1 << 40))]),
+        trace=rng.choice([None, [rng.randrange(1 << 30), 4, 1]]),
+        qos_class=rng.choice([None, "gold", "bulk"]),
+    )
+
+
+def _corpus(seed: int = 11, n: int = 40):
+    rng = random.Random(seed)
+    out = []
+    for _ in range(n):
+        roll = rng.random()
+        if roll < 0.3:
+            out.append(_rand_sub_write(rng))
+        elif roll < 0.4:
+            out.append(ECSubWriteReply(
+                rng.randrange(8), rng.randrange(1 << 30),
+                committed=rng.random() < 0.5, applied=rng.random() < 0.5,
+                current_version=rng.choice(
+                    [None, (5, "osd.0"), [7, "osd.2"]]),
+                missed=rng.random() < 0.2))
+        elif roll < 0.5:
+            out.append(ECSubRead(
+                rng.randrange(8), rng.randrange(1 << 30),
+                to_read={f"o{i}": [(rng.randrange(1 << 12), 512)]
+                         for i in range(rng.randrange(3))},
+                attrs_to_read=["hinfo"] if rng.random() < 0.5 else [],
+                subchunks={"o0": [(0, 1)]} if rng.random() < 0.3 else {},
+                trace=rng.choice([None, (9, 2, 0)]),
+                qos_class=rng.choice([None, "gold"])))
+        elif roll < 0.6:
+            out.append(ECSubReadReply(
+                rng.randrange(8), rng.randrange(1 << 30),
+                buffers_read={"o0": [(0, bytes(rng.randrange(256)
+                                               for _ in range(4096)))]},
+                attrs_read={"o0": {"hinfo": _rand_value(rng)}},
+                errors={} if rng.random() < 0.7
+                else {"o1": "KeyError"}))
+        elif roll < 0.7:
+            out.append(MgrReport(
+                f"osd.{rng.randrange(8)}", rng.randrange(1 << 20),
+                rng.random() * 5,
+                {"pgs": {"1": [1, 2]}, "perf": {"x": rng.randrange(99)}},
+                lag_ms=rng.choice([None, rng.random() * 10])))
+        elif roll < 0.75:
+            out.append(MgrBeacon("mon.0", rng.randrange(1 << 20),
+                                 lag_ms=rng.choice([None, 0.5])))
+        else:
+            out.append(_rand_value(rng))
+    return out
+
+
+# -- codec interop -----------------------------------------------------------
+
+def test_encode_byte_identical_and_cross_decode():
+    """Property sweep: native encode == Python encode byte for byte,
+    and each codec decodes the OTHER's bytes to the same message."""
+    for i, msg in enumerate(_corpus()):
+        py = wire.encode_message(msg)
+        na = NATIVE.encode_body(msg)
+        assert py == na, f"encode bytes diverged for corpus[{i}]"
+        d_py = wire.decode_message(na)   # python decodes native bytes
+        d_na = NATIVE.decode_body(py)    # native decodes python bytes
+        assert d_py == d_na, f"cross-decode diverged for corpus[{i}]"
+        assert type(d_py) is type(d_na)
+
+
+def test_np_integer_values_encode_like_python():
+    msg = {"n": np.int64(7), "m": np.uint32(1 << 20)}
+    assert wire.encode_message(msg) == NATIVE.encode_body(msg)
+
+
+def test_trailing_optional_tails_decode_identically():
+    """Pre-reqid / pre-trace / pre-qos senders end the ECSubWrite body
+    early; both codecs must decode every truncation level to the same
+    struct (the `# cephlint: wire-optional` compat contract)."""
+    txn = Transaction().write("o@1", 0, b"z" * 64)
+    enc = Encoder().u8(1)  # _MSG_EC_SUB_WRITE
+    enc.varint(2).varint(9).string("o@1")
+    wire.encode_transaction(enc, txn)
+    enc.value((4, "osd.0"))
+    enc.varint(1)
+    enc.varint(4).string("o@1").string("append").varint(0)
+    enc.string("client")
+    enc.value(False)
+    enc.value(None)
+    pre_reqid = enc.bytes()
+    pre_trace = Encoder().value(("c", 1, 7))._parts
+    pre_trace = pre_reqid + b"".join(pre_trace)
+    pre_qos = pre_trace + Encoder().value([3, 1, 0]).bytes()
+    full = pre_qos + Encoder().value("gold").bytes()
+    for body, want in (
+            (pre_reqid, (None, None, None)),
+            (pre_trace, (("c", 1, 7), None, None)),
+            (pre_qos, (("c", 1, 7), [3, 1, 0], None)),
+            (full, (("c", 1, 7), [3, 1, 0], "gold"))):
+        d_py = wire.decode_message(body)
+        d_na = NATIVE.decode_body(body)
+        assert d_py == d_na
+        assert (d_na.reqid, d_na.trace, d_na.qos_class) == want
+
+
+def test_seal_frames_matches_python_entry_frames():
+    """The batch seal must put the same bytes on the wire as the
+    per-entry Python seal, piggyback-ack tail included, and cache the
+    payload crc on the entry (retransmits never re-digest)."""
+    from ceph_tpu.msg.tcp import TCPMessenger
+    from ceph_tpu.msg.cluster_bench import free_ports
+
+    port = free_ports(1)[0]
+    m = TCPMessenger("a", {"a": ("127.0.0.1", port)})
+    msgs = _corpus(seed=5, n=8)
+    native_entries = [m._msg_entry("a", "b", i + 1, msg)
+                      for i, msg in enumerate(msgs)]
+    m._native = None
+    python_entries = [m._msg_entry("a", "b", i + 1, msg)
+                      for i, msg in enumerate(msgs)]
+    for ne, pe in zip(native_entries, python_entries):
+        assert b"".join(bytes(p) for p in ne.parts) == \
+            b"".join(bytes(p) for p in pe.parts)
+        assert ne.crc is not None  # folded during encode
+    for ack in (0, 77):
+        bufs, nbytes = NATIVE.seal_frames(python_entries, ack)
+        flat = b"".join(bytes(b) for b in bufs)
+        ref = b""
+        for i, entry in enumerate(python_entries):
+            ref += b"".join(
+                bytes(b) for b in m._entry_frames(
+                    entry, None, ack if i == len(python_entries) - 1
+                    else 0))
+        assert flat == ref
+        assert nbytes == len(flat)
+    assert all(e.crc is not None for e in python_entries)
+
+
+def test_parse_burst_partial_and_corrupt():
+    from ceph_tpu.utils.encoding import frame
+
+    payloads = [wire.encode_message(m) for m in _corpus(seed=3, n=6)]
+    stream = b"".join(frame(p) for p in payloads)
+    frames, pos, ok = NATIVE.parse_burst(stream + stream[:7], 0)
+    assert ok and frames == payloads and pos == len(stream)
+    bad = bytearray(stream)
+    bad[len(frame(payloads[0])) + 14] ^= 0xFF  # corrupt frame 2's body
+    frames, _pos, ok = NATIVE.parse_burst(bytes(bad), 0)
+    assert not ok and frames == payloads[:1]
+
+
+# -- real-TCP interop both directions ---------------------------------------
+
+def _tcp_pair(native_a: bool, native_b: bool):
+    from ceph_tpu.msg.cluster_bench import free_ports
+    from ceph_tpu.msg.tcp import TCPMessenger
+
+    ports = free_ports(2)
+    addr = {"a": ("127.0.0.1", ports[0]), "b": ("127.0.0.1", ports[1])}
+    a, b = TCPMessenger("a", addr), TCPMessenger("b", addr)
+    if not native_a:
+        a._native = None
+    if not native_b:
+        b._native = None
+    return a, b
+
+
+@pytest.mark.parametrize("native_a,native_b", [
+    (True, False), (False, True), (True, True)])
+def test_tcp_roundtrip_between_codecs(native_a, native_b):
+    """Frames survive the real-TCP hop in both codec directions --
+    round-trip equality object for object, in order."""
+    msgs = _corpus(seed=21, n=24)
+
+    async def main():
+        a, b = _tcp_pair(native_a, native_b)
+        await a.start()
+        await b.start()
+        got = []
+
+        async def dispatch(src, msg):
+            got.append(msg)
+
+        b.register("b", dispatch)
+        try:
+            for msg in msgs:
+                await a.send_message("a", "b", msg)
+            for _ in range(300):
+                if len(got) >= len(msgs):
+                    break
+                await asyncio.sleep(0.01)
+            assert got == msgs
+        finally:
+            await a.shutdown()
+            await b.shutdown()
+
+    run(main())
+
+
+def test_unknown_frame_kind_counted_and_dropped_native():
+    """A NEWER peer's frame kind reaching a native receiver is dropped
+    and counted with the connection intact -- later traffic delivered
+    (the transport's forward-compat contract, native path)."""
+    from ceph_tpu.msg import tcp as tcp_mod
+
+    async def main():
+        a, b = _tcp_pair(True, True)
+        a._native = None  # sender uses the patched python encoder below
+        await a.start()
+        await b.start()
+        got = []
+
+        async def dispatch(src, msg):
+            got.append(msg)
+
+        b.register("b", dispatch)
+        real_encoder = tcp_mod.message_encoder
+
+        def future_kind_encoder(msg):
+            if msg == "from-the-future":
+                return Encoder().u8(200).string("mystery-payload")
+            return real_encoder(msg)
+
+        tcp_mod.message_encoder = future_kind_encoder
+        try:
+            await a.send_message("a", "b", "from-the-future")
+            await a.send_message("a", "b", {"op": "after"})
+            for _ in range(200):
+                if got:
+                    break
+                await asyncio.sleep(0.01)
+            assert got == [{"op": "after"}]
+            assert b.counters["unknown_msg_dropped"] == 1
+        finally:
+            tcp_mod.message_encoder = real_encoder
+            await a.shutdown()
+            await b.shutdown()
+
+    run(main())
+
+
+# -- forced fallback (degraded build) ---------------------------------------
+
+def test_forced_fallback_runs_pure_python():
+    """osd_wire_codec_native=false must pin new messengers to the pure
+    Python codec (the no-toolchain degraded mode) with the wire fully
+    functional, and the loader must report the gate."""
+    from ceph_tpu.msg.tcp import TCPMessenger
+    from ceph_tpu.msg.cluster_bench import free_ports
+
+    cfg = get_config()
+    prior = bool(cfg.get_val("osd_wire_codec_native"))
+    cfg.apply_changes({"osd_wire_codec_native": False})
+    try:
+        assert wire_codec.native() is None
+        assert wire_codec.enabled() is False
+        st = wire_codec.status()
+        assert st["gated_off"] is True and st["enabled"] is False
+        ports = free_ports(2)
+        addr = {"a": ("127.0.0.1", ports[0]),
+                "b": ("127.0.0.1", ports[1])}
+        a, b = TCPMessenger("a", addr), TCPMessenger("b", addr)
+        assert a._native is None and b._native is None
+
+        async def main():
+            await a.start()
+            await b.start()
+            got = []
+
+            async def dispatch(src, msg):
+                got.append(msg)
+
+            b.register("b", dispatch)
+            try:
+                msgs = _corpus(seed=31, n=8)
+                for msg in msgs:
+                    await a.send_message("a", "b", msg)
+                for _ in range(200):
+                    if len(got) >= len(msgs):
+                        break
+                    await asyncio.sleep(0.01)
+                assert got == msgs
+            finally:
+                await a.shutdown()
+                await b.shutdown()
+
+        run(main())
+    finally:
+        cfg.apply_changes({"osd_wire_codec_native": prior})
+    assert wire_codec.enabled() is True  # back on for the suite
+
+
+def test_wire_codec_gauge_in_prometheus():
+    from ceph_tpu.mgr.mgr import prometheus_text
+
+    text = prometheus_text({
+        "osd_stats": {}, "pools": {"num_objects": 0},
+        "degraded_objects": [],
+    })
+    assert "ceph_wire_codec_native" in text
+    assert 'ceph_wire_codec_native{enabled="true"} 1' in text
+
+
+# -- vectorized Objecter submit ---------------------------------------------
+
+def _harness(n_objects=12, obj_bytes=4096):
+    from ceph_tpu.msg.cluster_bench import ClusterHarness, make_payloads
+    from ceph_tpu.plugins import registry as registry_mod
+
+    ec = registry_mod.instance().factory(
+        "jerasure", {"k": "2", "m": "1", "technique": "reed_sol_van"})
+    return (ClusterHarness(ec, 3, cork=True, pool="wnsub"),
+            make_payloads(n_objects, obj_bytes, 77))
+
+
+def test_submit_many_bit_exact_and_batched():
+    """write_many/read_many round-trip bit-exactly and actually batch:
+    the whole submit must cost at most one wire burst per primary per
+    chunk (frames/burst strictly above the per-op shape)."""
+    h, payloads = _harness()
+
+    async def main():
+        await h.start()
+        try:
+            await h.objecter.write_many(list(payloads.items()))
+            got = await h.objecter.read_many(list(payloads))
+            assert dict(zip(payloads, got)) == payloads
+            # mixed-kind batch through the generic surface
+            res = await h.objecter.submit_many(
+                [("read", next(iter(payloads)), {"snap": None}),
+                 ("stat", next(iter(payloads)), {})])
+            assert res[0] == payloads[next(iter(payloads))]
+        finally:
+            await h.shutdown()
+
+    run(main())
+
+
+def test_submit_many_failover_to_next_shard():
+    """An op whose batch send hit a dead primary falls back to the
+    per-op retry loop: same reqid, next up shard answers, and the op
+    completes -- failover semantics identical to per-op submit."""
+    h, payloads = _harness(n_objects=6)
+    cfg = get_config()
+    prior = {k: cfg.get_val(k) for k in
+             ("client_probe_grace", "client_probe_retries",
+              "client_backoff_base")}
+    cfg.apply_changes({"client_probe_grace": 0.2,
+                       "client_probe_retries": 1,
+                       "client_backoff_base": 0.01})
+
+    async def main():
+        await h.start()
+        try:
+            await h.objecter.write_many(list(payloads.items()))
+            # kill one OSD's transport outright: batch ops whose
+            # primary died must fail over and still read back
+            victim = h.osds[0]
+            await h.messengers[0].shutdown()
+            got = await h.objecter.read_many(list(payloads))
+            assert dict(zip(payloads, got)) == payloads
+            assert victim is h.osds[0]  # the kill really happened
+        finally:
+            await h.shutdown()
+
+    try:
+        run(main())
+    finally:
+        cfg.apply_changes(prior)
+
+
+# -- gc freeze (the r19 pause-tax satellite) --------------------------------
+
+def test_gc_freeze_shrinks_collect_pause():
+    """Profiler-backed pin: with a loaded heap frozen out of the
+    collector, a full collection's measured pause (the profiling GC
+    arm's accounting) shrinks by a large factor -- the daemon-side fix
+    for the r19 2.6%->11.1% loaded-heap gc tax."""
+    from ceph_tpu import profiling
+    from ceph_tpu.utils import gcopt
+
+    heap = [{"i": i, "s": f"obj{i}", "t": (i, str(i))}
+            for i in range(150_000)]
+    assert heap
+    profiling.configure(mode="on")
+    try:
+        profiling.reset()
+        gc.collect()
+        before = profiling.snapshot()["loop"]["gc_ns"]
+        assert before > 0
+        applied = gcopt.freeze_after_warmup(force=True)
+        assert applied
+        assert gcopt.status()["frozen"]
+        assert gc.get_freeze_count() > 50_000
+        try:
+            profiling.reset()
+            gc.collect()
+            after = profiling.snapshot()["loop"]["gc_ns"]
+            # the frozen heap is out of every generation: the full
+            # collection no longer traces the 150k-object graph
+            assert after < before / 3, (before, after)
+        finally:
+            gcopt.unfreeze()
+        assert not gcopt.status()["frozen"]
+    finally:
+        profiling.configure(mode="off")
+
+
+def test_gc_freeze_respects_config_gate():
+    from ceph_tpu.utils import gcopt
+
+    cfg = get_config()
+    prior = bool(cfg.get_val("gc_freeze_on_start"))
+    cfg.apply_changes({"gc_freeze_on_start": False})
+    try:
+        assert gcopt.freeze_after_warmup() is False
+    finally:
+        cfg.apply_changes({"gc_freeze_on_start": prior})
+
+
+# -- bench smoke -------------------------------------------------------------
+
+def test_wire_codec_ab_bench_smoke():
+    """The wire-tax stage's codec A/B at smoke shape: every gate armed
+    (frame-bytes-identical, share ratio, gain floor loosened for CI
+    noise), plus the degraded-skip path exercised via config."""
+    from ceph_tpu.profiling.wire_tax_bench import run_wire_tax_bench
+
+    result = run_wire_tax_bench(
+        n_objects=8, obj_bytes=4096, writers=4, iters=1,
+        coverage_min_pct=50.0, overhead_limit_pct=50.0,
+        codec_gain_min=0.5, codec_share_ratio_max=0.95)
+    assert result["wire_codec_native_enabled"] is True
+    assert result["wire_codec_frame_bytes_identical"] is True
+    assert result["wire_codec_gain"] > 0.5
+    assert result["wire_codec_serialization_share_native_pct"] < \
+        result["wire_codec_serialization_share_python_pct"]

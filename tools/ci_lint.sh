@@ -19,6 +19,9 @@
 #
 # Usage: tools/ci_lint.sh [sarif-output-path]
 #        tools/ci_lint.sh --profile-smoke
+#   --native-codec-smoke builds the _wire_native codec extension from
+#   a clean tree and runs the codec interop round-trip, exiting with
+#   its status.
 #   --profile-smoke runs ONLY the wire-tax profiler smoke
 #   (ec_benchmark --workload wire-tax --smoke: every attribution gate
 #   armed at CI shape) and exits with its status.
@@ -29,6 +32,18 @@
 set -eu
 
 cd "$(dirname "$0")/.."
+
+if [ "${1:-}" = "--native-codec-smoke" ]; then
+    # native wire codec smoke (round 20): build _wire_native from a
+    # CLEAN tree (prebuilt .so removed first), then run the interop
+    # round-trip -- native and Python codecs byte-identical on a typed
+    # corpus + a real-TCP hop native->python and python->native
+    rm -f ceph_tpu/native/_wire_native*.so
+    JAX_PLATFORMS=cpu python -m ceph_tpu.native.wire_codec --smoke \
+        > /dev/null
+    echo "cephlint: native wire codec clean-tree smoke passed" >&2
+    exit 0
+fi
 
 if [ "${1:-}" = "--profile-smoke" ]; then
     # wire-tax profiler smoke (round 19): the saturated-path cost

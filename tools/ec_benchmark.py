@@ -244,7 +244,8 @@ def main(argv=None) -> int:
         if args.smoke:
             result = run_wire_tax_bench(
                 n_objects=8, obj_bytes=4096, writers=4, iters=1,
-                coverage_min_pct=50.0, overhead_limit_pct=50.0)
+                coverage_min_pct=50.0, overhead_limit_pct=50.0,
+                codec_gain_min=0.5, codec_share_ratio_max=0.95)
         else:
             result = run_wire_tax_bench(
                 n_objects=args.objects, obj_bytes=args.size,
@@ -257,7 +258,9 @@ def main(argv=None) -> int:
             f"decomposed at {result['wire_tax_coverage_pct']}% "
             f"coverage (enabled overhead "
             f"{result['wire_tax_overhead_pct_enabled']}%, off allocs "
-            f"{result['wire_tax_alloc_blocks_off']}); top: {top}",
+            f"{result['wire_tax_alloc_blocks_off']}, native-codec "
+            f"gain {result.get('wire_codec_gain')}x at share ratio "
+            f"{result.get('wire_codec_share_ratio')}); top: {top}",
             file=sys.stderr,
         )
         return 0
