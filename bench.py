@@ -707,12 +707,38 @@ def main() -> int:
         return {
             "total": data["lint_findings_total"],
             "by_rule": data["lint_findings_by_rule"],
+            # the native-pack slice (.c/.cpp boundary rules) broken out:
+            # drift here means the C codec disagrees with msg/wire.py
+            "native_total": sum(
+                n for rule, n in data["lint_findings_by_rule"].items()
+                if rule.startswith("native-")),
             "runtime_secs": data["lint_runtime_secs"],
             "changed_runtime_secs": changed_data.get("lint_runtime_secs"),
             "changed_files_scanned": changed_data.get("files_scanned"),
         }
 
     lint_stage = _secondary(_lint_stage)
+
+    def _san_smoke_stage():
+        """Sanitized-codec fuzz gate (round 21): the differential
+        fuzzer (tools/wire_fuzz.py) under the ASan/UBSan build of
+        _wire_native plus the repeated-pass leak gate, exactly as CI
+        runs it (tools/ci_lint.sh --san-smoke).  True means zero
+        divergences and zero sanitizer reports; CEPH_TPU_BENCH_NO_SAN=1
+        skips it (null) on toolchain-less runners."""
+        import subprocess
+
+        if os.environ.get("CEPH_TPU_BENCH_NO_SAN") == "1":
+            return None
+        root = __file__.rsplit("/", 1)[0]
+        proc = subprocess.run(
+            ["sh", os.path.join(root, "tools", "ci_lint.sh"),
+             "--san-smoke"],
+            capture_output=True, text=True, timeout=900,
+        )
+        return {"ok": proc.returncode == 0}
+
+    san_smoke = _secondary(_san_smoke_stage)
 
     def _r3(v):
         return round(v, 3) if v is not None else None
@@ -874,6 +900,9 @@ def main() -> int:
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
             lint_stage["by_rule"] if lint_stage else None),
+        "lint_native_findings_total": (
+            lint_stage["native_total"] if lint_stage else None),
+        "san_smoke_ok": san_smoke["ok"] if san_smoke else None,
         "lint_runtime_secs": (
             lint_stage["runtime_secs"] if lint_stage else None),
         "lint_changed_runtime_secs": (

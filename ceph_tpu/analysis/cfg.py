@@ -10,8 +10,11 @@ usual approximations:
   ``while True`` only when the test is non-constant) and a back edge;
 * every statement inside a ``try`` body may raise into each handler
   (call-free statements too -- the cheap over-approximation);
-* ``return`` goes to EXIT, ``raise`` to the innermost handlers (or
-  EXIT), ``break``/``continue`` to their loop targets.
+* ``return`` goes to EXIT -- through the enclosing ``finally`` block
+  first when there is one (the finalbody runs on the way out, so a
+  cleanup statement there IS on every return path); ``raise`` goes to
+  the innermost handlers (or EXIT), ``break``/``continue`` to their
+  loop targets.
 
 The rules ask one kind of question: *can execution flow from statement
 A to statement B, and does some such path cross a task-switch point?*
@@ -39,7 +42,7 @@ class CFG:
         self.succ: Dict[object, List[object]] = {}
         self.stmts: List[ast.stmt] = []
         self._stmt_set: Set[int] = set()
-        entry = self._block(fn.body, [EXIT], [], [], [EXIT])
+        entry = self._block(fn.body, [EXIT], [], [], [EXIT], [EXIT])
         self.entry: List[object] = entry
 
     # -- construction ------------------------------------------------------
@@ -58,68 +61,85 @@ class CFG:
 
     def _block(self, stmts: Sequence[ast.stmt], follow: List[object],
                breaks: List[object], continues: List[object],
-               raises: List[object]) -> List[object]:
-        """Wire a statement list; returns the block's entry points."""
+               raises: List[object],
+               returns: List[object]) -> List[object]:
+        """Wire a statement list; returns the block's entry points.
+        ``returns`` is where a ``return`` statement flows: EXIT
+        normally, the enclosing ``finally`` block's entry inside a
+        try/finally (the finalbody runs before the function leaves)."""
         if not stmts:
             return list(follow)
         entries: Optional[List[object]] = None
         # wire back-to-front so each statement knows its successor entry
         nxt: List[object] = list(follow)
         for stmt in reversed(stmts):
-            nxt = self._stmt(stmt, nxt, breaks, continues, raises)
+            nxt = self._stmt(stmt, nxt, breaks, continues, raises, returns)
         entries = nxt
         return entries
 
     def _stmt(self, stmt: ast.stmt, follow: List[object],
               breaks: List[object], continues: List[object],
-              raises: List[object]) -> List[object]:
+              raises: List[object], returns: List[object]) -> List[object]:
         """Wire one statement; returns its entry points (usually just
         ``[stmt]``)."""
         self._add(stmt)
         if isinstance(stmt, ast.If):
-            body = self._block(stmt.body, follow, breaks, continues, raises)
+            body = self._block(stmt.body, follow, breaks, continues, raises,
+                               returns)
             orelse = self._block(stmt.orelse, follow, breaks, continues,
-                                 raises) if stmt.orelse else list(follow)
+                                 raises, returns) \
+                if stmt.orelse else list(follow)
             self._edge(stmt, body)
             self._edge(stmt, orelse)
         elif isinstance(stmt, (ast.While,)):
-            body = self._block(stmt.body, [stmt], follow, [stmt], raises)
+            body = self._block(stmt.body, [stmt], follow, [stmt], raises,
+                               returns)
             self._edge(stmt, body)
             test = stmt.test
             infinite = isinstance(test, ast.Constant) and bool(test.value)
             if not infinite or stmt.orelse:
                 self._edge(stmt, self._block(
-                    stmt.orelse, follow, breaks, continues, raises)
+                    stmt.orelse, follow, breaks, continues, raises, returns)
                     if stmt.orelse else follow)
         elif isinstance(stmt, (ast.For, ast.AsyncFor)):
-            body = self._block(stmt.body, [stmt], follow, [stmt], raises)
+            body = self._block(stmt.body, [stmt], follow, [stmt], raises,
+                               returns)
             self._edge(stmt, body)
             self._edge(stmt, self._block(
-                stmt.orelse, follow, breaks, continues, raises)
+                stmt.orelse, follow, breaks, continues, raises, returns)
                 if stmt.orelse else follow)
         elif isinstance(stmt, ast.Try):
             handler_entries: List[object] = []
             final_entry = self._block(
-                stmt.finalbody, follow, breaks, continues, raises) \
+                stmt.finalbody, follow, breaks, continues, raises, returns) \
                 if stmt.finalbody else list(follow)
+            # a `return` under this try runs the finalbody on the way
+            # out, so it routes through final_entry, not straight to
+            # EXIT (over-approximated: the finalbody's fall-through
+            # edge to `follow` survives, which is the safe direction
+            # for every may-reach query)
+            inner_returns = final_entry if stmt.finalbody else returns
             for handler in stmt.handlers:
                 handler_entries.extend(self._block(
-                    handler.body, final_entry, breaks, continues, raises))
+                    handler.body, final_entry, breaks, continues, raises,
+                    inner_returns))
             inner_raises = handler_entries or final_entry or list(raises)
             after_body = self._block(
-                stmt.orelse, final_entry, breaks, continues, raises) \
+                stmt.orelse, final_entry, breaks, continues, raises,
+                inner_returns) \
                 if stmt.orelse else final_entry
             body = self._block(stmt.body, after_body, breaks, continues,
-                               inner_raises)
+                               inner_raises, inner_returns)
             self._edge(stmt, body)
             # any body statement may raise into the handlers
             for inner in self._own_stmts(stmt.body):
                 self._edge(inner, inner_raises)
         elif isinstance(stmt, (ast.With, ast.AsyncWith)):
-            body = self._block(stmt.body, follow, breaks, continues, raises)
+            body = self._block(stmt.body, follow, breaks, continues, raises,
+                               returns)
             self._edge(stmt, body)
         elif isinstance(stmt, ast.Return):
-            self._edge(stmt, [EXIT])
+            self._edge(stmt, returns or [EXIT])
         elif isinstance(stmt, ast.Raise):
             self._edge(stmt, raises or [EXIT])
         elif isinstance(stmt, ast.Break):

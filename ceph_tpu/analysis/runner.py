@@ -18,6 +18,14 @@ from ceph_tpu.analysis.core import (SEV_ERROR, FileContext, Finding, Rule,
 #: (each rule's positive examples) and would otherwise fail the gate
 DEFAULT_EXCLUDES = ("tests/fixtures/lint",)
 
+#: native-extension sources the ``native`` pack scans (everything else
+#: runs the Python-AST packs)
+NATIVE_EXTS = (".c", ".cpp", ".cc", ".h")
+
+
+def _is_native(path: str) -> bool:
+    return path.endswith(NATIVE_EXTS)
+
 
 def repo_root() -> str:
     return os.path.dirname(os.path.dirname(os.path.dirname(
@@ -28,7 +36,7 @@ def collect_files(paths: Iterable[str], root: Optional[str] = None,
                   excludes: Tuple[str, ...] = DEFAULT_EXCLUDES
                   ) -> List[str]:
     """Expand files/directories into a sorted list of repo-relative
-    posix paths to .py files."""
+    posix paths to .py and native (.c/.cpp) files."""
     root = root or repo_root()
     out = set()
     for p in paths:
@@ -40,7 +48,7 @@ def collect_files(paths: Iterable[str], root: Optional[str] = None,
                 dirnames[:] = [d for d in dirnames
                                if d not in ("__pycache__", ".git")]
                 for fn in filenames:
-                    if fn.endswith(".py"):
+                    if fn.endswith(".py") or _is_native(fn):
                         out.add(os.path.relpath(
                             os.path.join(dirpath, fn), root))
     rel = sorted(p.replace(os.sep, "/") for p in out)
@@ -101,15 +109,29 @@ def resolve_rules(names: Optional[Iterable[str]] = None) -> Dict[str, Rule]:
 
 def scan_file(path: str, source: str,
               rules: Optional[Dict[str, Rule]] = None) -> List[Finding]:
-    """All raw findings for one file (no suppression/baseline yet)."""
+    """All raw findings for one file (no suppression/baseline yet).
+    Native (.c/.cpp) sources run the ``native`` pack against the C
+    model; Python sources run every other pack against the AST."""
+    rule_set = rules if rules is not None else all_rules()
+    findings: List[Finding] = []
+    if _is_native(path):
+        from ceph_tpu.analysis.rules_native import NativeFileContext
+
+        nctx = NativeFileContext(path, source)
+        for r in rule_set.values():
+            if r.pack == "native":
+                findings.extend(r.check(nctx))
+        findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+        return findings
     try:
         tree = ast.parse(source)
     except SyntaxError as e:
         return [Finding("parse-error", path, e.lineno or 1, 0,
                         f"file does not parse: {e.msg}", SEV_ERROR)]
     ctx = FileContext(path, source, tree)
-    findings: List[Finding] = []
-    for r in (rules if rules is not None else all_rules()).values():
+    for r in rule_set.values():
+        if r.pack == "native":
+            continue
         findings.extend(r.check(ctx))
     findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
     return findings
@@ -152,9 +174,10 @@ def run_paths(paths: Iterable[str], root: Optional[str] = None,
 
 
 def changed_files(root: Optional[str] = None) -> List[str]:
-    """Repo-relative .py files differing from HEAD (staged, unstaged,
-    and untracked) -- the ``--changed`` scan scope.  Empty when git is
-    unavailable (callers fall back to a full scan or a no-op)."""
+    """Repo-relative .py and native .c/.cpp files differing from HEAD
+    (staged, unstaged, and untracked) -- the ``--changed`` scan scope.
+    Empty when git is unavailable (callers fall back to a full scan or
+    a no-op)."""
     import subprocess
 
     root = root or repo_root()
@@ -170,7 +193,7 @@ def changed_files(root: Optional[str] = None) -> List[str]:
             return []
         for line in proc.stdout.splitlines():
             line = line.strip()
-            if line.endswith(".py") and \
+            if (line.endswith(".py") or _is_native(line)) and \
                     os.path.exists(os.path.join(root, line)):
                 out.add(line.replace(os.sep, "/"))
     return sorted(out)

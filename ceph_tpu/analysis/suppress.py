@@ -12,6 +12,10 @@ right after the ``=``):
 deliberately line-scoped (no file/block scope): a suppression should sit
 next to the code it excuses, where review sees both together.  The
 baseline file is the mechanism for bulk legacy acceptance.
+
+Native (.c/.cpp) sources use their own comment syntax, so the marker
+also matches after ``//`` or inside ``/* ... */`` (the rule-name
+character class naturally excludes the closing ``*/``).
 """
 
 from __future__ import annotations
@@ -20,7 +24,7 @@ import re
 from typing import Dict, List, Set
 
 _RE = re.compile(
-    r"#\s*cephlint:\s*(disable|disable-next-line)\s*=\s*"
+    r"(?:#|//|/\*)\s*cephlint:\s*(disable|disable-next-line)\s*=\s*"
     r"([A-Za-z0-9_\-]+(?:\s*,\s*[A-Za-z0-9_\-]+)*)"
 )
 

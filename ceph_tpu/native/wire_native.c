@@ -281,6 +281,7 @@ static int emit_dict(Emit *e, PyObject *d) {
   while (PyDict_Next(d, &pos, &key, &val)) {
     if (!PyUnicode_Check(key)) {
       /* the Python encoder raises TypeError here -- same contract */
+      /* cephlint: disable-next-line=native-missing-fallback */
       PyErr_Format(PyExc_TypeError, "dict keys must be str, got %R",
                    (PyObject *)Py_TYPE(key));
       return -1;
@@ -363,6 +364,8 @@ static int emit_extent_map(Emit *e, PyObject *d) {
     PyObject *fast;
     Py_ssize_t i, n;
     if (!PyUnicode_Check(key)) {
+      /* the Python encoder raises TypeError here -- same contract */
+      /* cephlint: disable-next-line=native-missing-fallback */
       PyErr_Format(PyExc_TypeError, "dict keys must be str, got %R",
                    (PyObject *)Py_TYPE(key));
       return -1;
@@ -402,6 +405,8 @@ static int emit_buffers_read(Emit *e, PyObject *d) {
     PyObject *fast;
     Py_ssize_t i, n;
     if (!PyUnicode_Check(key)) {
+      /* the Python encoder raises TypeError here -- same contract */
+      /* cephlint: disable-next-line=native-missing-fallback */
       PyErr_Format(PyExc_TypeError, "dict keys must be str, got %R",
                    (PyObject *)Py_TYPE(key));
       return -1;
@@ -1021,6 +1026,13 @@ static int dec_varint(Dec *d, uint64_t *out) {
   int shift = 0;
   while (d->pos < d->end) {
     uint8_t b = d->data[d->pos++];
+    if (shift > 57 && ((uint64_t)(b & 0x7F) >> (64 - shift))) {
+      /* the group carries bits past 2^64: never silently truncate --
+       * lengths/counts this wide are forged or corrupt, and VALUE
+       * ints take the wide path in dec_varint_obj instead */
+      PyErr_SetString(PyExc_ValueError, "varint overflows u64");
+      return -1;
+    }
     v |= (uint64_t)(b & 0x7F) << shift;
     if (!(b & 0x80)) {
       *out = v;
@@ -1034,6 +1046,47 @@ static int dec_varint(Dec *d, uint64_t *out) {
   }
   PyErr_SetString(PyExc_ValueError, "decode past end of buffer");
   return -1;
+}
+
+/* Full-width varint as a PyLong.  The Python codec round-trips ints of
+ * any width the 10-group wire format holds (up to 70 bits), and its
+ * fallback encoder emits the 64..70-bit band the C emitter refuses
+ * (FallbackError), so the native DECODER must reconstruct that band
+ * exactly -- truncating to u64 here silently corrupts a mixed-codec
+ * peer pair. */
+static PyObject *dec_varint_obj(Dec *d) {
+  unsigned __int128 v = 0;
+  int shift = 0;
+  while (d->pos < d->end) {
+    uint8_t b = d->data[d->pos++];
+    v |= (unsigned __int128)(b & 0x7F) << shift;
+    if (!(b & 0x80)) {
+      if (v >> 64) {
+        /* cold path: only python-encoded fallback frames land here */
+        PyObject *hi = PyLong_FromUnsignedLongLong((uint64_t)(v >> 64));
+        PyObject *lo = PyLong_FromUnsignedLongLong((uint64_t)v);
+        PyObject *sixty_four = PyLong_FromLong(64);
+        PyObject *shifted = NULL, *out = NULL;
+        if (hi != NULL && lo != NULL && sixty_four != NULL) {
+          shifted = PyNumber_Lshift(hi, sixty_four);
+          if (shifted != NULL) out = PyNumber_Or(shifted, lo);
+        }
+        Py_XDECREF(shifted);
+        Py_XDECREF(sixty_four);
+        Py_XDECREF(hi);
+        Py_XDECREF(lo);
+        return out;
+      }
+      return PyLong_FromUnsignedLongLong((uint64_t)v);
+    }
+    shift += 7;
+    if (shift > 63) {
+      PyErr_SetString(PyExc_ValueError, "varint too long");
+      return NULL;
+    }
+  }
+  PyErr_SetString(PyExc_ValueError, "decode past end of buffer");
+  return NULL;
 }
 
 static int dec_take(Dec *d, size_t n, const uint8_t **out) {
@@ -1074,8 +1127,7 @@ static PyObject *dec_value(Dec *d) {
   tag = d->data[d->pos++];
   switch (tag) {
     case WT_INT:
-      if (dec_varint(d, &n) < 0) return NULL;
-      return PyLong_FromUnsignedLongLong(n);
+      return dec_varint_obj(d);
     case WT_BYTES:
       return dec_blob(d);
     case WT_STR:
@@ -1088,8 +1140,7 @@ static PyObject *dec_value(Dec *d) {
       Py_RETURN_FALSE;
     case WT_NEGINT: {
       PyObject *mag, *neg;
-      if (dec_varint(d, &n) < 0) return NULL;
-      mag = PyLong_FromUnsignedLongLong(n);
+      mag = dec_varint_obj(d);
       if (mag == NULL) return NULL;
       neg = PyNumber_Negative(mag);
       Py_DECREF(mag);
@@ -1182,12 +1233,6 @@ static PyObject *dec_value(Dec *d) {
   }
 }
 
-static PyObject *dec_varint_obj(Dec *d) {
-  uint64_t v;
-  if (dec_varint(d, &v) < 0) return NULL;
-  return PyLong_FromUnsignedLongLong(v);
-}
-
 /* kwargs-call a registered dataclass constructor; steals nothing */
 static PyObject *construct(PyObject *cls, PyObject *kwargs) {
   return PyObject_Call(cls, empty_tuple, kwargs);
@@ -1217,13 +1262,40 @@ static int listify_tuples(PyObject *lst) {
   return 0;
 }
 
-/* the extent-map decode transform: {k: [tuple(x) for x in v]} */
+/* the extent-map decode transform, a faithful twin of the Python
+ * comprehension {k: [tuple(x) for x in v]}: a non-dict input or a
+ * non-iterable v RAISES exactly where the comprehension would -- a
+ * corrupt frame must fail identically through both codecs, never
+ * decode to a struct the Python side refuses (differential-fuzz
+ * finding, tools/wire_fuzz.py) */
 static int mapify_tuples(PyObject *d) {
   PyObject *key, *val;
   Py_ssize_t pos = 0;
-  if (!PyDict_Check(d)) return 0;
+  if (!PyDict_Check(d)) {
+    PyErr_SetString(PyExc_ValueError, "extent map is not a dict");
+    return -1;
+  }
   while (PyDict_Next(d, &pos, &key, &val)) {
-    if (listify_tuples(val) < 0) return -1;
+    if (PyList_Check(val)) {
+      if (listify_tuples(val) < 0) return -1; /* in-place fast path */
+    } else {
+      /* the comprehension materializes any iterable v as a fresh
+       * list (str iterates chars, dict iterates keys) and raises
+       * TypeError on the rest; PySequence_List matches that */
+      PyObject *lst = PySequence_List(val);
+      if (lst == NULL) return -1;
+      if (listify_tuples(lst) < 0) {
+        Py_DECREF(lst);
+        return -1;
+      }
+      /* value replacement for an existing key: safe under
+       * PyDict_Next (the key set does not change) */
+      if (PyDict_SetItem(d, key, lst) < 0) {
+        Py_DECREF(lst);
+        return -1;
+      }
+      Py_DECREF(lst);
+    }
   }
   return 0;
 }
@@ -1855,7 +1927,7 @@ PyMODINIT_FUNC PyInit__wire_native(void) {
   Unknown = PyObject_CallObject((PyObject *)&PyBaseObject_Type, NULL);
   empty_tuple = PyTuple_New(0);
   if (FallbackError == NULL || Unknown == NULL || empty_tuple == NULL)
-    return NULL;
+    goto fail;
   Py_INCREF(FallbackError);
   PyModule_AddObject(mod, "FallbackError", FallbackError);
   Py_INCREF(Unknown);
@@ -1864,7 +1936,7 @@ PyMODINIT_FUNC PyInit__wire_native(void) {
 #define INTERN(var, name)                      \
   do {                                         \
     var = PyUnicode_InternFromString(name);    \
-    if (var == NULL) return NULL;              \
+    if (var == NULL) goto fail;                \
   } while (0)
   INTERN(s_from_shard, "from_shard");
   INTERN(s_tid, "tid");
@@ -1905,4 +1977,7 @@ PyMODINIT_FUNC PyInit__wire_native(void) {
   INTERN(s_crc, "crc");
 #undef INTERN
   return mod;
+fail:
+  Py_DECREF(mod);
+  return NULL;
 }

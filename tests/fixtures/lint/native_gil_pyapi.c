@@ -1,0 +1,48 @@
+/* native-gil-released-pyapi fixture: between Py_BEGIN_ALLOW_THREADS
+ * and Py_END_ALLOW_THREADS the GIL is not held, so any Python C-API
+ * call (bar the GIL-free allowlist: PyMem_Raw*, the macro accessors
+ * like PyBytes_AS_STRING) is undefined behaviour.  Annotated lines
+ * anchor the offending CALL. */
+#include <Python.h>
+#include <string.h>
+
+static PyObject *bad_api_in_region(PyObject *self, PyObject *arg) {
+  char *buf;
+  Py_BEGIN_ALLOW_THREADS
+  buf = PyMem_RawMalloc(64); /* RawMalloc is GIL-free: clean */
+  memset(buf, 0, 64);
+  PyErr_SetString(PyExc_ValueError, "boom"); // LINT: native-gil-released-pyapi
+  PyMem_RawFree(buf);
+  Py_END_ALLOW_THREADS
+  Py_RETURN_NONE;
+}
+
+static PyObject *bad_alloc_in_region(PyObject *self, PyObject *arg) {
+  PyObject *out = NULL;
+  Py_BEGIN_ALLOW_THREADS
+  out = PyBytes_FromStringAndSize(NULL, 16); // LINT: native-gil-released-pyapi
+  Py_END_ALLOW_THREADS
+  return out;
+}
+
+static PyObject *ok_pure_compute_region(PyObject *self, PyObject *arg) {
+  /* the intended shape: snapshot pointers under the GIL, release it
+   * for the raw-memory work, touch no Python object state inside */
+  char *data = PyBytes_AS_STRING(arg);
+  long n = PyBytes_GET_SIZE(arg);
+  long acc = 0;
+  Py_BEGIN_ALLOW_THREADS
+  for (long i = 0; i < n; i++)
+    acc += (unsigned char)data[i];
+  Py_END_ALLOW_THREADS
+  return PyLong_FromLong(acc);
+}
+
+static PyObject *ok_api_after_region(PyObject *self, PyObject *arg) {
+  long acc = 0;
+  Py_BEGIN_ALLOW_THREADS
+  acc = 42;
+  Py_END_ALLOW_THREADS
+  /* back under the GIL: calls here are fine */
+  return PyLong_FromLong(acc);
+}

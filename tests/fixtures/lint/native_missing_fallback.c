@@ -1,0 +1,40 @@
+/* native-missing-fallback fixture: a typed encoder branch that hits a
+ * value outside the value model must raise FallbackError (so the
+ * caller degrades to the MSG_VALUE envelope), never a concrete
+ * exception type -- a TypeError here turns a representable-but-novel
+ * message into a hard send failure.  Annotated lines anchor the
+ * PyErr_* call that raises the wrong type. */
+#include <Python.h>
+
+static PyObject *FallbackError;
+
+static int emit_widget(void *e, PyObject *v) {
+  if (!PyDict_Check(v)) {
+    PyErr_SetString(PyExc_TypeError, "widget must be a dict"); // LINT: native-missing-fallback
+    return -1;
+  }
+  return 0;
+}
+
+static int encode_gizmo_header(void *e, PyObject *v) {
+  if (PyLong_Check(v))
+    return 0;
+  PyErr_Format(PyExc_ValueError, "bad gizmo header: %R", v); // LINT: native-missing-fallback
+  return -1;
+}
+
+static int emit_gadget(void *e, PyObject *v) {
+  /* the correct shape: reject with FallbackError and let the caller
+   * fall back to the generic value codec */
+  if (!PyDict_Check(v)) {
+    PyErr_SetString(FallbackError, "gadget outside the value model");
+    return -1;
+  }
+  return 0;
+}
+
+static PyObject *py_lookup(PyObject *self, PyObject *key) {
+  /* not an encoder: concrete exception types are fine out here */
+  PyErr_SetString(PyExc_KeyError, "no such entry");
+  return NULL;
+}

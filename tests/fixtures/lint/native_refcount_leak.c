@@ -1,0 +1,61 @@
+/* native-refcount-leak-on-error-path fixture: an owned reference
+ * still live when an error exit fires is a leak; the twin that
+ * releases it on the way out is clean.  Annotated lines anchor the
+ * rule's expected findings (the ERROR EXIT line, not the creation --
+ * the fix goes where the cleanup is missing). */
+#include <Python.h>
+
+static PyObject *leak_on_error(PyObject *self, PyObject *arg) {
+  PyObject *tmp = PyList_New(4);
+  if (tmp == NULL) return NULL;
+  PyObject *item = PyLong_FromLong(7);
+  if (item == NULL)
+    return NULL; // LINT: native-refcount-leak-on-error-path
+  PyList_SET_ITEM(tmp, 0, item);
+  return tmp;
+}
+
+static PyObject *leak_before_errexit(PyObject *self, PyObject *args) {
+  PyObject *buf = PyBytes_FromStringAndSize(NULL, 64);
+  if (buf == NULL) return NULL;
+  if (PyTuple_Size(args) != 1) {
+    PyErr_SetString(PyExc_TypeError, "want exactly one argument");
+    return NULL; // LINT: native-refcount-leak-on-error-path
+  }
+  return buf;
+}
+
+static PyObject *ok_cleanup_on_error(PyObject *self, PyObject *arg) {
+  PyObject *tmp = PyList_New(4);
+  if (tmp == NULL) return NULL;
+  PyObject *item = PyLong_FromLong(7);
+  if (item == NULL) {
+    Py_DECREF(tmp);
+    return NULL;
+  }
+  PyList_SET_ITEM(tmp, 0, item);
+  return tmp;
+}
+
+static PyObject *ok_goto_fail(PyObject *self, PyObject *arg) {
+  PyObject *a = PyDict_New();
+  PyObject *b = NULL;
+  if (a == NULL) return NULL;
+  b = PyLong_FromLong(1);
+  if (b == NULL) goto fail;
+  if (PyDict_SetItemString(a, "k", b) < 0) goto fail;
+  Py_DECREF(b);
+  return a;
+fail:
+  Py_XDECREF(b);
+  Py_DECREF(a);
+  return NULL;
+}
+
+static PyObject *ok_borrowed_untouched(PyObject *self, PyObject *seq) {
+  /* borrowed references (GetItem et al.) need no release on error */
+  PyObject *first = PyList_GetItem(seq, 0);
+  if (first == NULL) return NULL;
+  Py_INCREF(first);
+  return first;
+}

@@ -1,0 +1,73 @@
+/* native-schema-drift fixture: each typed branch's field sequence is
+ * diffed op-for-op against msg/wire.py's linearization.  The beacon
+ * branch here drifts twice -- the encoder writes seq before name
+ * (wire.py writes name first), and the decoder reads the lag_ms
+ * compat tail unconditionally (wire.py guards it with a remaining-
+ * bytes check so short v-minus-one frames still parse).  The
+ * SUB_READ_REPLY and MGR_REPORT twins are faithful and stay clean.
+ * Annotated lines anchor the first mismatching C-side operation. */
+#include <Python.h>
+
+static int emit_body(emit_state *e, PyObject *msg) {
+  if (is_beacon(msg)) {
+    if (emit_u8(e, MSG_MGR_BEACON) < 0) return -1;
+    if (emit_varint(e, beacon_seq(msg)) < 0) return -1; // LINT: native-schema-drift
+    if (emit_string(e, beacon_name(msg)) < 0) return -1;
+    if (emit_value(e, beacon_lag(msg)) < 0) return -1;
+    return 0;
+  }
+  if (is_sub_read_reply(msg)) {
+    if (emit_u8(e, MSG_EC_SUB_READ_REPLY) < 0 ||
+        emit_varint(e, reply_from_shard(msg)) < 0 ||
+        emit_varint(e, reply_tid(msg)) < 0 ||
+        emit_value(e, reply_buffers(msg)) < 0 ||
+        emit_value(e, reply_attrs(msg)) < 0 ||
+        emit_value(e, reply_errors(msg)) < 0)
+      return -1;
+    return 0;
+  }
+  return 0;
+}
+
+static PyObject *decode_body_at(dec_state *d, int kind) {
+  PyObject *kw;
+  switch (kind) {
+  case MSG_MGR_BEACON:
+    kw = PyDict_New();
+    if (kw == NULL) return NULL;
+    if (kw_set(kw, s_name, dec_string(d)) < 0 ||
+        kw_set(kw, s_seq, dec_varint_obj(d)) < 0)
+      goto fail;
+    /* drift: the compat tail must sit behind a d->pos < d->end
+     * guard -- reading it unconditionally breaks old short frames */
+    if (kw_set(kw, s_lag_ms, dec_value(d)) < 0) goto fail; // LINT: native-schema-drift
+    return construct_beacon(kw);
+  case MSG_EC_SUB_READ_REPLY:
+    kw = PyDict_New();
+    if (kw == NULL) return NULL;
+    if (kw_set(kw, s_from_shard, dec_varint_obj(d)) < 0 ||
+        kw_set(kw, s_tid, dec_varint_obj(d)) < 0 ||
+        kw_set(kw, s_buffers_read, dec_value(d)) < 0 ||
+        kw_set(kw, s_attrs_read, dec_value(d)) < 0 ||
+        kw_set(kw, s_errors, dec_value(d)) < 0)
+      goto fail;
+    return construct_sub_read_reply(kw);
+  case MSG_MGR_REPORT:
+    kw = PyDict_New();
+    if (kw == NULL) return NULL;
+    if (kw_set(kw, s_name, dec_string(d)) < 0 ||
+        kw_set(kw, s_seq, dec_varint_obj(d)) < 0 ||
+        kw_set(kw, s_health, dec_value(d)) < 0 ||
+        kw_set(kw, s_pg_summary, dec_value(d)) < 0)
+      goto fail;
+    if (d->pos < d->end) {
+      if (kw_set(kw, s_lag_ms, dec_value(d)) < 0) goto fail;
+    }
+    return construct_report(kw);
+  }
+  PyErr_SetString(PyExc_ValueError, "unknown message kind");
+  return NULL;
+fail:
+  Py_DECREF(kw);
+  return NULL;
+}

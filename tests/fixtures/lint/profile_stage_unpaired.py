@@ -61,13 +61,26 @@ async def ok_exit_before_await():
 
 
 def leak_return_inside_try(flag):
-    # a `return` inside the try jumps straight out: the CFG (and the
-    # interpreter, for the value expression) leaves before the exit
+    # an early `return` with no finally to route through leaves the
+    # stage open on that path
     profiling.stage_enter(_PS)  # LINT: profile-stage-unpaired
     if flag:
         return work()
     profiling.stage_exit(_PS)
     return None
+
+
+def ok_return_inside_try_finally(flag):
+    # a `return` inside the try runs the finalbody on the way out, so
+    # the stage_exit in the finally is on every return path -- the CFG
+    # routes Return through the enclosing finally, not straight to EXIT
+    profiling.stage_enter(_PS)
+    try:
+        if flag:
+            return work()
+        return None
+    finally:
+        profiling.stage_exit(_PS)
 
 
 def ok_with_form():

@@ -69,9 +69,17 @@ FIXTURES = {
     "profile_stage_unpaired.py": None,
     "wire_hot_path_alloc.py": None,
     "suppressions.py": None,
+    # PR-21 native boundary: C sources run the `native` pack (refcount
+    # dataflow, GIL regions, fallback contract, cross-language schema
+    # diff against msg/wire.py)
+    "native_refcount_leak.c": None,
+    "native_gil_pyapi.c": None,
+    "native_missing_fallback.c": None,
+    "native_schema_drift.c": None,
 }
 
-_ANNOT = re.compile(r"#\s*LINT:\s*([a-z0-9\-]+(?:\s*,\s*[a-z0-9\-]+)*)")
+# annotations live after `#` in Python fixtures, `//` in C fixtures
+_ANNOT = re.compile(r"(?:#|//)\s*LINT:\s*([a-z0-9\-]+(?:\s*,\s*[a-z0-9\-]+)*)")
 
 
 def _expected(source: str):

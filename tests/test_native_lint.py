@@ -1,0 +1,147 @@
+"""Teeth for the native pack: the cross-language schema-drift rule and
+the refcount dataflow are proven LIVE against the real extension
+source, not just the fixtures.  Each sabotage test takes the shipped
+``wire_native.c``, re-introduces one historical bug class (a field
+reorder, a dropped compat-tail guard, a deleted error-path cleanup),
+and requires the exact finding to fire -- so a regression in the
+analyzer that silently stops comparing shows up here, not in a
+production drift."""
+
+import os
+
+from ceph_tpu.analysis import native_model
+from ceph_tpu.analysis import suppress as suppress_mod
+from ceph_tpu.analysis.runner import scan_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+NATIVE_C = os.path.join(REPO, "ceph_tpu", "native", "wire_native.c")
+PSEUDO = "ceph_tpu/native/wire_native.c"
+
+
+def _source() -> str:
+    with open(NATIVE_C, encoding="utf-8") as fh:
+        return fh.read()
+
+
+def _lint(source: str):
+    """scan + inline suppressions, no baseline (the runner's per-file
+    pipeline): returns (new, suppressed)."""
+    raw = scan_file(PSEUDO, source)
+    sup = suppress_mod.parse_suppressions(source)
+    new = [f for f in raw
+           if not suppress_mod.is_suppressed(sup, f.rule, f.line)]
+    suppressed = [f for f in raw
+                  if suppress_mod.is_suppressed(sup, f.rule, f.line)]
+    return new, suppressed
+
+
+# -- the shipped source is clean ---------------------------------------------
+
+def test_shipped_native_source_gates_clean():
+    """The real extension scans to ZERO live findings; the deliberate
+    escapes (typed-key TypeError parity with the Python encoder) are
+    inline-disabled and therefore audited, not invisible."""
+    new, suppressed = _lint(_source())
+    assert new == [], [f.format() for f in new]
+    assert {f.rule for f in suppressed} == {"native-missing-fallback"}
+    assert len(suppressed) == 3
+
+
+def test_model_parses_every_function():
+    """No silent soft-fails: every function in the real C source must
+    come out of the parser with ``parsed=True`` -- a tokenizer/parser
+    regression that starts skipping bodies would otherwise turn the
+    whole pack into a no-op while still 'passing' the gate."""
+    model = native_model.NativeModel(PSEUDO, _source())
+    bad = [f.name for f in model.functions.values() if not f.parsed]
+    assert not bad, f"functions the model failed to parse: {bad}"
+    assert len(model.functions) > 40  # the real file, not a stub
+
+
+def test_drift_rule_compares_every_wire_kind():
+    """The comparison is only as good as its coverage: both dispatch
+    directions must extract a schema branch for every typed message
+    kind msg/wire.py knows, so a parser regression cannot quietly
+    shrink the diffed surface to nothing."""
+    model = native_model.NativeModel(PSEUDO, _source())
+    enc = {k.lstrip("_") for k in native_model.encoder_branches(model)}
+    dec = {k.lstrip("_") for k in native_model.decoder_branches(model)}
+    typed = {"MSG_EC_SUB_WRITE", "MSG_EC_SUB_WRITE_REPLY",
+             "MSG_EC_SUB_READ", "MSG_EC_SUB_READ_REPLY",
+             "MSG_MGR_BEACON", "MSG_MGR_REPORT"}
+    assert typed <= enc, f"encoder branches missing: {typed - enc}"
+    # decode additionally dispatches the MSG_VALUE envelope itself
+    assert typed | {"MSG_VALUE"} <= dec, \
+        f"decoder branches missing: {(typed | {'MSG_VALUE'}) - dec}"
+
+
+# -- sabotage: schema drift --------------------------------------------------
+
+def test_sabotaged_field_reorder_fires_schema_drift():
+    """Swapping the beacon encoder's name/seq emission order (the
+    classic rebase-gone-wrong) must produce exactly one finding: the
+    beacon encode branch, field #1, op mismatch."""
+    real = _source()
+    broken = real.replace(
+        "    if (emit_u8(e, MSG_MGR_BEACON) < 0 ||\n"
+        "        emit_attr_string(e, msg, s_name) < 0 ||\n"
+        "        emit_attr_varint(e, msg, s_seq) < 0 ||",
+        "    if (emit_u8(e, MSG_MGR_BEACON) < 0 ||\n"
+        "        emit_attr_varint(e, msg, s_seq) < 0 ||\n"
+        "        emit_attr_string(e, msg, s_name) < 0 ||",
+    )
+    assert broken != real
+    new, _sup = _lint(broken)
+    assert [f.rule for f in new] == ["native-schema-drift"]
+    msg = new[0].message
+    assert "MGR_BEACON" in msg and "(encode)" in msg and "field #1" in msg
+
+
+def test_sabotaged_dropped_guard_fires_schema_drift():
+    """Deleting the ``d->pos < d->end`` remaining-bytes check around
+    the beacon's lag_ms compat tail must fire the drift rule's
+    guard-mismatch arm: wire.py keeps the field optional (``# cephlint:
+    wire-optional``) and an unconditional C read breaks every pre-lag
+    sender."""
+    real = _source()
+    guarded = (
+        "      if (d->pos < d->end) {\n"
+        "        if (kw_set(kw, s_lag_ms, dec_value(d)) < 0) goto fail;\n"
+        "      }\n"
+    )
+    assert real.count(guarded) == 2  # beacon first, then mgr report
+    broken = real.replace(
+        guarded,
+        "      if (kw_set(kw, s_lag_ms, dec_value(d)) < 0) goto fail;\n",
+        1)
+    assert broken != real
+    new, _sup = _lint(broken)
+    assert [f.rule for f in new] == ["native-schema-drift"]
+    msg = new[0].message
+    assert "MGR_BEACON" in msg and "(decode)" in msg
+    assert "optional-guarded" in msg and "wire-optional" in msg
+
+
+# -- sabotage: refcount dataflow ---------------------------------------------
+
+def test_sabotaged_deleted_cleanup_fires_refcount_leak():
+    """Reverting the module-init error path to a bare ``return NULL``
+    (dropping the goto into the Py_DECREF(mod) cleanup) must re-fire
+    the leak rule on that exit -- the exact true positive this pack
+    flagged on the pre-fix source."""
+    real = _source()
+    broken = real.replace(
+        "  if (FallbackError == NULL || Unknown == NULL || "
+        "empty_tuple == NULL)\n"
+        "    goto fail;",
+        "  if (FallbackError == NULL || Unknown == NULL || "
+        "empty_tuple == NULL)\n"
+        "    return NULL;",
+    )
+    assert broken != real
+    new, _sup = _lint(broken)
+    assert [f.rule for f in new] == ["native-refcount-leak-on-error-path"]
+    assert "'mod'" in new[0].message
+    # the finding anchors the error EXIT (where the fix goes)
+    exit_line = new[0].line
+    assert broken.splitlines()[exit_line - 1].strip() == "return NULL;"

@@ -61,7 +61,8 @@ def main(argv=None) -> int:
                     help="run only this rule (repeatable); unknown "
                          "names list the valid spellings")
     ap.add_argument("--changed", action="store_true",
-                    help="scan only .py files differing from git HEAD "
+                    help="scan only .py and native .c/.cpp files "
+                         "differing from git HEAD "
                          "(staged, unstaged and untracked) -- the fast "
                          "pre-commit/bench scope; exits 0 immediately "
                          "when nothing changed")

@@ -25,9 +25,15 @@
 #   --profile-smoke runs ONLY the wire-tax profiler smoke
 #   (ec_benchmark --workload wire-tax --smoke: every attribution gate
 #   armed at CI shape) and exits with its status.
+#   --san-smoke builds the ASan/UBSan-instrumented codec twin
+#   (wire_ext_san) and runs the differential fuzzer (tools/
+#   wire_fuzz.py: 600 seeded cases, python<->C byte equivalence both
+#   directions, truncated-tail/flip mutants) plus the repeated-pass
+#   leak gate under the sanitizers, exiting with its status.
 #   CEPHLINT_SARIF_OUT overrides the default cephlint.sarif.
 #   CEPHLINT_NO_SMOKE=1 skips the transfer + multichip smokes
-#   (lint-only runners).
+#   (lint-only runners).  CEPHLINT_NO_SAN=1 skips the sanitized codec
+#   fuzz in the default path (no-toolchain runners).
 
 set -eu
 
@@ -45,6 +51,22 @@ if [ "${1:-}" = "--native-codec-smoke" ]; then
     exit 0
 fi
 
+if [ "${1:-}" = "--san-smoke" ]; then
+    # sanitized codec fuzz (round 21): the native boundary's runtime
+    # teeth.  The interpreter is uninstrumented, so libasan rides in
+    # via LD_PRELOAD; leaks are gated by the fuzzer's repeated-pass
+    # gc/RSS check (LeakSanitizer drowns in CPython's arena noise),
+    # with a small quarantine so RSS stays an honest signal.
+    make -C ceph_tpu/native wire_ext_san > /dev/null
+    asan_lib="$(${CXX:-g++} -print-file-name=libasan.so)"
+    LD_PRELOAD="$asan_lib" \
+    ASAN_OPTIONS="detect_leaks=0:quarantine_size_mb=8" \
+    JAX_PLATFORMS=cpu python tools/wire_fuzz.py --san --cases 600 \
+        --leak-passes 6 > /dev/null
+    echo "cephlint: sanitized codec fuzz + leak gate passed" >&2
+    exit 0
+fi
+
 if [ "${1:-}" = "--profile-smoke" ]; then
     # wire-tax profiler smoke (round 19): the saturated-path cost
     # decomposition, profiler overhead and off-mode zero-allocation
@@ -59,6 +81,10 @@ out="${1:-${CEPHLINT_SARIF_OUT:-cephlint.sarif}}"
 
 python tools/cephlint.py --changed --format sarif > "$out"
 echo "cephlint: wrote diff-scoped SARIF to $out" >&2
+
+if [ "${CEPHLINT_NO_SAN:-}" != "1" ]; then
+    sh tools/ci_lint.sh --san-smoke
+fi
 
 if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
     python tools/ec_benchmark.py --plugin tpu --workload storage-path \
