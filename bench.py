@@ -896,6 +896,47 @@ def main() -> int:
             if wt_host else None),
         "wire_codec_share_ratio": (
             wt_host.get("wire_codec_share_ratio") if wt_host else None),
+        # round-22 batch-exec + ring A/Bs (gated inside the stage:
+        # shard bytes identical across modes/transports, OSD-execution
+        # share <= 0.6x its per-op baseline, rings actually carrying
+        # the traffic)
+        "osd_exec_share_perop_pct": (
+            wt_host.get("osd_exec_share_perop_pct") if wt_host
+            else None),
+        "osd_exec_share_batched_pct": (
+            wt_host.get("osd_exec_share_batched_pct") if wt_host
+            else None),
+        "osd_exec_share_ratio": (
+            wt_host.get("osd_exec_share_ratio") if wt_host else None),
+        "osd_batch_gain": (
+            wt_host.get("osd_batch_gain") if wt_host else None),
+        "ring_gain": (
+            wt_host.get("ring_gain") if wt_host else None),
+        "tcp_ops_per_sec": (
+            wt_host.get("tcp_ops_per_sec") if wt_host else None),
+        "ring_ops_per_sec": (
+            wt_host.get("ring_ops_per_sec") if wt_host else None),
+        "tcp_frame_send_ns": (
+            wt_host.get("tcp_frame_send_ns") if wt_host else None),
+        "ring_frame_send_ns": (
+            wt_host.get("ring_frame_send_ns") if wt_host else None),
+        "ring_conns": (
+            wt_host.get("ring_conns") if wt_host else None),
+        # round-22 loadgen 10^4 scale stage (gated inside qos_bench:
+        # exactly-once audit exact, closed-loop starvation bound, p99
+        # no worse than the same-run 1k reference)
+        "qos_path_scale10x_clients": (
+            qp_host.get("qos_path_scale10x_clients") if qp_host
+            else None),
+        "qos_path_scale10x_ops_per_s": (
+            qp_host.get("qos_path_scale10x_ops_per_s") if qp_host
+            else None),
+        "qos_path_scale10x_p99_ms": (
+            qp_host.get("qos_path_scale10x_p99_ms") if qp_host
+            else None),
+        "qos_path_scale10x_cas_exact": (
+            qp_host.get("qos_path_scale10x_cas_exact") if qp_host
+            else None),
         "wire_tax_host": wt_host,
         "lint_findings_total": lint_stage["total"] if lint_stage else None,
         "lint_findings_by_rule": (
@@ -978,8 +1019,17 @@ def main() -> int:
         f"native-codec gain "
         f"{wt_host.get('wire_codec_gain') if wt_host else '?'}x "
         f"(serialization share ratio "
-        f"{wt_host.get('wire_codec_share_ratio') if wt_host else '?'}) "
-        f"on {jax.devices()[0].platform}",
+        f"{wt_host.get('wire_codec_share_ratio') if wt_host else '?'}), "
+        f"osd-exec share ratio "
+        f"{wt_host.get('osd_exec_share_ratio') if wt_host else '?'} "
+        f"(batch gain "
+        f"{wt_host.get('osd_batch_gain') if wt_host else '?'}x), "
+        f"ring gain {wt_host.get('ring_gain') if wt_host else '?'}x "
+        f"over tcp, scale10x "
+        f"{qp_host.get('qos_path_scale10x_clients') if qp_host else '?'}"
+        f" clients at p99 "
+        f"{qp_host.get('qos_path_scale10x_p99_ms') if qp_host else '?'}"
+        f"ms on {jax.devices()[0].platform}",
         file=sys.stderr,
     )
     print(json.dumps(result))

@@ -40,9 +40,11 @@ from ceph_tpu.loadgen.profiles import PROFILES
 from ceph_tpu.utils.encoding import Decoder
 
 #: clients per hub messenger (bounds sockets AND dispatch-loop tasks
-#: per hub); hubs = ceil(clients / HUB_FANOUT), capped
+#: per hub); hubs = ceil(clients / HUB_FANOUT), capped.  The cap
+#: clears the 10^4-client stage (qos_bench scale10x): 10_000 / 256 =
+#: 40 hubs, still just tens of sockets against the cluster
 HUB_FANOUT = 256
-MAX_HUBS = 8
+MAX_HUBS = 40
 
 
 @dataclasses.dataclass(frozen=True)

@@ -41,6 +41,12 @@ class FaultInjector:
         #: reply frame is sent (None = disarmed, "*" = any op kind)
         self._kill_after_apply: Optional[str] = None
         self.apply_kills = 0
+        #: one-shot shared-memory ring tear: the Nth next ring record
+        #: push publishes a TORN record (header out, body half-written
+        #: -- the producer "died" mid-publish) and the producer writes
+        #: nothing further (None = disarmed)
+        self._ring_tear_countdown: Optional[int] = None
+        self.ring_tears = 0
 
     @classmethod
     def from_config(cls) -> "FaultInjector":
@@ -131,6 +137,32 @@ class FaultInjector:
         except ImportError:  # analysis stripped from a deploy: fine
             return
         _runtime.on_tear(kind)
+
+    # -- ring-level injection (torn-record manufacture) --------------------
+
+    def schedule_ring_tear(self, after_records: int = 0) -> None:
+        """Arm a one-shot shared-memory ring tear: after ``after_records``
+        more ring records publish cleanly, the NEXT record goes out torn
+        (length header published, body incomplete -- a producer crash
+        mid-``memcpy``) and the producing side writes nothing further.
+        The consumer's record crc turns the torn record into a
+        ``RingTear`` (a ConnectionResetError), driving the messenger's
+        ordinary drop + reconnect + session-replay path."""
+        self._ring_tear_countdown = max(0, after_records)
+
+    def ring_tear_fire(self) -> bool:
+        """Consulted by the ring writer before each record push; True
+        exactly once when the armed countdown reaches the record about
+        to be pushed (firing disarms)."""
+        if self._ring_tear_countdown is None:
+            return False
+        if self._ring_tear_countdown > 0:
+            self._ring_tear_countdown -= 1
+            return False
+        self._ring_tear_countdown = None
+        self.ring_tears += 1
+        self._notify_tear("shm ring torn record")
+        return True
 
     # -- connection-level injection (torn-burst manufacture) ---------------
 

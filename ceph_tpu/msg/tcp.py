@@ -68,6 +68,7 @@ from collections import deque
 from typing import Awaitable, Callable, Dict, Iterable, List, Optional, \
     Tuple
 
+from ceph_tpu.msg import shm_ring as _shm
 from ceph_tpu.msg.fault import FaultInjector
 from ceph_tpu.msg.wire import decode_message, message_encoder
 from ceph_tpu.native import wire_codec
@@ -389,6 +390,20 @@ class TCPMessenger:
         self.cork = bool(cfg.get_val("osd_msgr_cork")) if cork is None \
             else bool(cork)
         self.cork_bytes = int(cfg.get_val("osd_msgr_cork_bytes"))
+        #: shared-memory frame rings (osd_msgr_shm_ring): colocated
+        #: peers whose accept endpoint is ring-registered in THIS
+        #: process get a seqlock'd byte-ring conduit instead of the
+        #: localhost TCP hop; the whole protocol above the byte
+        #: transport (banner, auth, sessions, acks, replay) is
+        #: unchanged.  False (default) = TCP everywhere, the A/B
+        #: baseline.
+        try:
+            self.shm_ring = bool(cfg.get_val("osd_msgr_shm_ring"))
+            self.ring_bytes = int(cfg.get_val("osd_shm_ring_bytes"))
+        except KeyError:
+            self.shm_ring = False
+            self.ring_bytes = _shm.DEFAULT_RING_BYTES
+        self._ring_registered = False
         #: batched native wire codec (_wire_native via
         #: ceph_tpu/native/wire_codec.py), resolved once per messenger:
         #: None = the pure-Python codec (gated off, no toolchain, or
@@ -411,7 +426,7 @@ class TCPMessenger:
             "msgs_sent": 0, "frames_sent": 0, "bursts": 0, "drains": 0,
             "bytes_sent": 0, "acks_piggybacked": 0, "acks_standalone": 0,
             "acks_elided": 0, "acks_piggybacked_recv": 0,
-            "unknown_msg_dropped": 0,
+            "unknown_msg_dropped": 0, "ring_conns": 0, "tcp_conns": 0,
         }
         #: ack-lag attribution (observability): enqueue -> delivery-ack
         #: latency per pruned message, a prometheus histogram family
@@ -450,9 +465,26 @@ class TCPMessenger:
         self._server = await asyncio.start_server(
             self._serve_connection, host, port
         )
+        if self.shm_ring:
+            # announce our accept endpoint as ring-reachable: colocated
+            # peers dialing (host, port) get a ring conduit whose server
+            # side enters the SAME accept path as a TCP connection
+            _shm.register((host, port), self._accept_ring,
+                          ring_bytes=self.ring_bytes)
+            self._ring_registered = True
+
+    def _accept_ring(self, reader, writer) -> None:
+        """Ring-conduit accept: the colocated analogue of the
+        ``asyncio.start_server`` callback -- same serve coroutine, ring
+        stream adapters instead of sockets."""
+        asyncio.get_event_loop().create_task(
+            self._serve_connection(reader, writer))
 
     async def shutdown(self) -> None:
         self._closing = True  # stops lossless reconnect loops
+        if self._ring_registered:
+            _shm.unregister(tuple(self.addr_map[self.node]))
+            self._ring_registered = False
         if self._server is not None:
             self._server.close()
         for conn in self._conns.values():
@@ -871,7 +903,14 @@ class TCPMessenger:
         from ceph_tpu.auth.cephx import AuthHandshake
 
         host, port = self.addr_map[node]
-        reader, writer = await asyncio.open_connection(host, port)
+        ring = _shm.connect((host, port), fault=self.fault) \
+            if self.shm_ring else None
+        if ring is not None:
+            reader, writer = ring
+            self.counters["ring_conns"] += 1
+        else:
+            reader, writer = await asyncio.open_connection(host, port)
+            self.counters["tcp_conns"] += 1
         framer = _FrameReader(reader, buffered=self.cork,
                               native=self._native)
         nonce = AuthHandshake.new_nonce() if self.keyring is not None else b""
@@ -1219,6 +1258,7 @@ class TCPMessenger:
                 writer.writelines(bufs)
             writer.transport.abort()
             self._conn_failed(node, writer, lossless)
+            self._requeue_lossy(node, q, batch, lossless)
             return
         prof_on = _profiler.enabled()
         t_burst = _time.perf_counter_ns() if prof_on else 0
@@ -1244,6 +1284,7 @@ class TCPMessenger:
                 writer.writelines(bufs)
         except (ConnectionError, OSError, RuntimeError):
             self._conn_failed(node, writer, lossless)
+            self._requeue_lossy(node, q, batch, lossless)
             return
         if nbytes < 0:
             nbytes = sum(len(b) for b in bufs)
@@ -1268,6 +1309,26 @@ class TCPMessenger:
                 self._drain_conn(node, q, conn))
             self.adopt_task(f"drain.{node}.{self._cork_seq}", task)
     # cephlint: end-wire-hot-section
+
+    def _requeue_lossy(self, node: str, q: _CorkQueue, batch,
+                       lossless: bool) -> None:
+        """A LOSSY conn died mid-burst in the sync fast path: hand the
+        batch back to the queue and the slow-path flusher, which
+        re-establishes and retries once before dropping -- the same
+        one-shot redelivery courtesy ``_cork_flush`` already gives its
+        own failures.  Without this the fast path silently loses the
+        unsent tail of the burst while the peer stays up, and the
+        client's probe loop -- which only demotes DEAD primaries --
+        waits out the whole op deadline (the ring transport made this
+        reachable: conns establish fast enough that the sync path, not
+        the slow path, consumes mid-burst kills).  Lossless conns skip
+        this: their entries live on ``sess.sent`` and the session
+        replay machinery owns redelivery."""
+        if lossless or self._closing:
+            return
+        q.entries = batch + q.entries
+        q.nbytes = sum(e.nbytes for e in q.entries)
+        self._spawn_cork_flush(node)
 
     def _conn_failed(self, node: str, writer, lossless: bool) -> None:
         """Shared dead-connection handling for the sync send path."""

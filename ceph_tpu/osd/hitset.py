@@ -63,11 +63,19 @@ class BloomHitSet:
         return [(h1 + i * h2) % self.nbits for i in range(self.nhash)]
 
     def insert(self, oid: str) -> None:
-        if not self.contains(oid):
+        # one _positions walk serves both the membership probe and the
+        # bit sets (insert-via-contains paid the blake2b twice; this
+        # runs on every client op through the hit-set tracker)
+        bits = self._bits
+        seen = True
+        for p in self._positions(oid):
+            mask = 1 << (p & 7)
+            if not bits[p >> 3] & mask:
+                seen = False
+                bits[p >> 3] |= mask
+        if not seen:
             self._count += 1  # approx DISTINCT count, comparable to
             # ExplicitHitSet's len and to the fpp sizing basis
-        for p in self._positions(oid):
-            self._bits[p >> 3] |= 1 << (p & 7)
 
     def contains(self, oid: str) -> bool:
         return all(self._bits[p >> 3] & (1 << (p & 7))
@@ -125,6 +133,14 @@ class HitSetTracker:
     def record(self, oid: str, now: Optional[float] = None) -> None:
         self._maybe_roll(now)
         self.current.insert(oid)
+
+    def record_many(self, oids, now: Optional[float] = None) -> None:
+        """Batch form of :meth:`record` (the OSD's array-batched op
+        path): one roll check covers the whole run."""
+        self._maybe_roll(now)
+        insert = self.current.insert
+        for oid in oids:
+            insert(oid)
 
     def temperature(self, oid: str, now: Optional[float] = None) -> float:
         """Fraction of retained periods (newest weighted heaviest) in

@@ -143,6 +143,16 @@ class PGLog:
     def lookup_dup(self, reqid) -> Optional[PGLogDup]:
         return self.dups.get(tuple(reqid))
 
+    def lookup_dups_batch(self, reqids) -> List[Optional[PGLogDup]]:
+        """Batch dup resolution for the OSD's array-batched client-op
+        fast path (osd/shard.py): one bound-method fetch + one pass of
+        dict gets over the whole batch instead of a ``lookup_dup`` call
+        per op.  ``None`` rows (non-dedupable ops) pass through as
+        ``None`` misses; semantics per row are exactly
+        :meth:`lookup_dup`."""
+        get = self.dups.get
+        return [None if r is None else get(tuple(r)) for r in reqids]
+
     @property
     def dup_head_seq(self) -> int:
         return self._dup_seq

@@ -22,8 +22,15 @@ Two sub-stages, both over the real-TCP path (ceph_tpu/loadgen):
   closed-loop client made progress, and the saturation p99 + per-class
   fairness spread are reported as headline keys.
 
+* **scale10x** (round 22) -- SCALE_CLIENTS x 10 concurrent clients
+  (10^4 full): a closed-loop transactional cohort carries the
+  exactly-once audit and fairness spread, an open-loop bulk offered at
+  half the same-run 1k-stage throughput carries the concurrency.
+  GATES: client count, exact audit, bounded closed-loop starvation,
+  and pooled p99 no worse than the same-run 1k closed-loop p99.
+
 ``--smoke`` (tools/ec_benchmark.py --workload qos-path --smoke, wired
-into tools/ci_lint.sh) shrinks both stages to a few hundred clients and
+into tools/ci_lint.sh) shrinks the stages to a few hundred clients and
 a few seconds; the full stage is the ROADMAP item-3 acceptance run.
 """
 
@@ -40,6 +47,14 @@ from typing import Dict, Optional
 RESERVATION_FRACTION = 0.15
 #: the full stage's concurrent-client floor (the acceptance criterion)
 SCALE_CLIENTS = 1000
+#: the scale10x stage's multiplier over SCALE_CLIENTS (round 22: 10^3
+#: -> 10^4 hub-multiplexed clients)
+SCALE10X_FACTOR = 10
+#: scale10x offered load as a fraction of the same-run 1k-stage
+#: measured throughput -- open-loop at half capacity keeps the
+#: p99-no-worse gate honest (closed-loop p99 scales with client count
+#: by queueing arithmetic alone, proving nothing about the stack)
+SCALE10X_LOAD_FRACTION = 0.5
 
 
 def _apply_profile(cfg, gold_res_mibs: float) -> Dict[str, object]:
@@ -238,6 +253,73 @@ async def _scale_stage(smoke: bool) -> Dict:
     return out
 
 
+async def _scale10x_stage(smoke: bool, ref: Optional[Dict]) -> Dict:
+    """10x the scale stage's client count (10^4 full), round 22: the
+    hub-multiplexed transport must carry an order of magnitude more
+    CONCURRENT clients without the tail degrading past the same-run
+    1k closed-loop saturation p99.
+
+    Two cohorts: a closed-loop transactional group (the exactly-once
+    audit and fairness-spread carriers -- closed loops give every
+    client a comparable ops budget, so the spread means something) and
+    an open-loop bulk carrying the concurrency, offered at
+    SCALE10X_LOAD_FRACTION of the 1k stage's MEASURED throughput.
+    GATES: client count >= 10x, exactly-once audit exact, closed-loop
+    starvation bounded, and pooled p99 <= the same-run 1k-stage p99
+    (skipped, and recorded null, when the 1k stage did not run)."""
+    from ceph_tpu.loadgen import ClientGroup, Scenario, run_scenario
+
+    n = 500 if smoke else SCALE_CLIENTS * SCALE10X_FACTOR
+    closed_n = 64 if smoke else 256
+    open_n = n - closed_n
+    ref_ops_s = float((ref or {}).get("ops_per_s") or 0.0)
+    ref_p99 = float((ref or {}).get("p99_ms") or 0.0)
+    # offered aggregate = half the measured 1k capacity, spread evenly
+    # over the open cohort (floor keeps the run non-degenerate when the
+    # reference is missing or tiny)
+    agg = max(20.0, SCALE10X_LOAD_FRACTION * ref_ops_s)
+    rate = agg / max(1, open_n)
+    scn = Scenario(
+        name="qos-scale10x-smoke" if smoke else "qos-scale10x",
+        duration_s=5.0 if smoke else 12.0,
+        groups=(
+            ClientGroup(count=closed_n, profile="txn"),
+            ClientGroup(count=open_n, profile="rgw", mode="open",
+                        rate_ops_s=rate),
+        ),
+        seed=79,
+    )
+    res = await run_scenario(
+        scn, n_osds=6, op_timeout=30.0 if smoke else 90.0,
+        tuning={"client_probe_grace": 6.0 if smoke else 30.0},
+    )
+    out = res.to_dict()
+    out["offered_ops_s"] = round(agg, 3)
+    out["ref_1k_ops_per_s"] = ref_ops_s or None
+    out["ref_1k_p99_ms"] = ref_p99 or None
+    if res.n_clients < n:
+        raise AssertionError("qos-path scale10x: client count shortfall")
+    if not res.cas_exact:
+        raise AssertionError(
+            f"qos-path scale10x: exactly-once audit failed "
+            f"({res.cas_mismatches} counter(s) off the acked books)")
+    if res.ops == 0:
+        raise AssertionError("qos-path scale10x: the scenario moved no ops")
+    closed = [g for g in out["groups"] if g["mode"] == "closed"]
+    starved = sum(g["clients_at_zero"] for g in closed)
+    total_closed = sum(g["clients"] for g in closed)
+    if not smoke and total_closed and \
+            starved > max(2, total_closed // 50):
+        raise AssertionError(
+            f"qos-path scale10x: {starved}/{total_closed} closed-loop "
+            "clients finished zero ops -- fairness collapse")
+    if not smoke and ref_p99 and res.p99_ms > ref_p99:
+        raise AssertionError(
+            f"qos-path scale10x: p99 {res.p99_ms:.1f}ms at 10x client "
+            f"count exceeds the same-run 1k-stage p99 {ref_p99:.1f}ms")
+    return out
+
+
 def run_qos_path_bench(*, smoke: bool = False,
                        stages: Optional[str] = None) -> Dict:
     """The stage entry point; ``stages`` limits to "overload"/"scale"
@@ -254,6 +336,9 @@ def run_qos_path_bench(*, smoke: bool = False,
         if stages in (None, "scale"):
             result["scale"] = loop.run_until_complete(
                 _scale_stage(smoke))
+        if stages in (None, "scale10x"):
+            result["scale10x"] = loop.run_until_complete(
+                _scale10x_stage(smoke, result.get("scale")))
     finally:
         loop.close()
     scale = result.get("scale") or {}
@@ -273,6 +358,14 @@ def run_qos_path_bench(*, smoke: bool = False,
         "qos_path_dup_op_hits": chaos.get("dup_op_hits"),
         "qos_path_inflight_hwm": scale.get("inflight_hwm"),
     })
+    scale10x = result.get("scale10x") or {}
+    if scale10x:
+        result.update({
+            "qos_path_scale10x_clients": scale10x.get("n_clients"),
+            "qos_path_scale10x_p99_ms": scale10x.get("p99_ms"),
+            "qos_path_scale10x_ops_per_s": scale10x.get("ops_per_s"),
+            "qos_path_scale10x_cas_exact": scale10x.get("cas_exact"),
+        })
     return result
 
 

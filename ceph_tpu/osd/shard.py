@@ -39,8 +39,18 @@ from ceph_tpu.osd.types import (
     Transaction,
 )
 from ceph_tpu.native.gf_native import crc32c
+from ceph_tpu.profiling import ledger as _profiler
 from ceph_tpu.utils import trace
 from ceph_tpu.utils.perf import PerfCounters, stage_histogram
+
+#: wire-tax cost centers for the OSD execution seam (fetched once; a
+#: global-bool branch when profiling is off).  ``osd.op_exec`` bills the
+#: per-op bookkeeping sections of the one-at-a-time path;
+#: ``osd.batch_exec`` bills the array passes of the batched fast path --
+#: the pair is what the bench's OSD-exec share A/B compares.  Markers
+#: never span an await (exclusive-time protocol).
+_PS_OP = _profiler.stage("osd.op_exec")
+_PS_BATCH = _profiler.stage("osd.batch_exec")
 
 #: client-op kinds subject to reqid dup detection: every kind that
 #: mutates state (re-executing a replay would double-apply or return a
@@ -222,6 +232,15 @@ class OSDShard:
         #: thread-count role)
         self._cop_sem = asyncio.Semaphore(64)
         self._cop_seq = 0
+        #: array-batched client-op execution (osd_op_batch_exec): the
+        #: worker drains same-kind client-op RUNS off the queue and runs
+        #: their bookkeeping as batch passes -- one optracker request,
+        #: one dups-registry scan, per-class amortized QoS admission,
+        #: one corked reply burst (resolved once per daemon; the bench
+        #: builds a fresh harness per A/B mode)
+        self._batch_exec = bool(_get_config().get_val("osd_op_batch_exec"))
+        self._batch_max = max(1, int(_get_config().get_val(
+            "osd_op_batch_max")))
         #: queued-or-executing client ops (the background throttle's
         #: saturation signal: recovery/scrub batches back off while
         #: this is high -- osd/recovery.py BackgroundThrottle)
@@ -1136,17 +1155,237 @@ class OSDShard:
                             self._client_ops_queued -= 1
                     continue
                 src, msg = item
-                try:
-                    await self._execute_op(src, msg)
-                except asyncio.CancelledError:
-                    raise
-                except Exception:  # noqa: BLE001 — op failure must not
-                    # kill the worker; log and keep serving (the reference
-                    # logs and drops misbehaving ops too)
-                    import sys
-                    import traceback
+                singles = [(src, msg)]
+                if (self._batch_exec and isinstance(msg, dict)
+                        and msg.get("op") == "client_op"
+                        and not self.client_caps):
+                    # batched fast path: the decoded burst's client ops
+                    # are all buffered in the queue already (the
+                    # dispatch loop drains a corked burst before this
+                    # worker wakes), so the RUN gathered here is real.
+                    # Entities with registered caps keep the per-op
+                    # path (op_capable stays per-op audited).
+                    batch, spill = self._gather_client_run(src, msg)
+                    if len(batch) > 1:
+                        # ONE task for the whole batch (vs one per op):
+                        # the gathered backend calls still land in the
+                        # same event-loop tick, so the codec coalescer
+                        # sees the identical fan-in
+                        self._cop_seq += 1
+                        self.messenger.adopt_task(
+                            f"{self.name}.cob{self._cop_seq}",
+                            asyncio.get_event_loop().create_task(
+                                self._run_client_op_batch(batch)),
+                        )
+                        singles = []
+                    else:
+                        singles = batch
+                    if spill is not None:
+                        singles.append(spill)
+                for one_src, one_msg in singles:
+                    try:
+                        await self._execute_op(one_src, one_msg)
+                    except asyncio.CancelledError:
+                        raise
+                    except Exception:  # noqa: BLE001 — op failure must
+                        # not kill the worker; log and keep serving (the
+                        # reference logs and drops misbehaving ops too)
+                        import sys
+                        import traceback
 
-                    traceback.print_exc(file=sys.stderr)
+                        traceback.print_exc(file=sys.stderr)
+
+    def _gather_client_run(self, src: str, msg: dict):
+        """Drain the client-op RUN already buffered behind ``msg`` (up
+        to ``osd_op_batch_max``).  Sync -- no awaits, so no state
+        (frozen / mark_down / caps) can change mid-gather.  Returns
+        ``(batch, spill)``: the first non-client item dequeued ends the
+        run and is handed back for ordinary execution."""
+        batch = [(src, msg)]
+        spill = None
+        while len(batch) < self._batch_max:
+            if self.op_queue_type == "mclock":
+                nxt = self.opq.dequeue()
+            else:
+                nxt = None if self.opq.empty() else self.opq.dequeue()
+            if nxt is None:
+                break
+            nmsg = nxt[1]
+            if isinstance(nmsg, dict) and nmsg.get("op") == "client_op":
+                batch.append(nxt)
+            else:
+                spill = nxt
+                break
+        return batch, spill
+
+    async def _run_client_op_batch(self, items) -> None:
+        """Array-batched client-op execution (osd_op_batch_exec, the
+        round-22 post-codec fast path): the per-op bookkeeping the
+        wire-tax profiler ranked as the residual wall -- optracker
+        stamping, reqid/dup lookups, QoS slot admission, perf/hitset
+        accounting, reply sends -- runs as BATCH passes over the run
+        instead of per-op dict walks:
+
+        * one tracked request + one trace span for the batch (queue-wait
+          attribution stays per op);
+        * the dups registry is scanned in ONE pass over the batch's
+          reqids; hits answer with the original result exactly like the
+          per-op path (exactly-once unchanged);
+        * QoS execution slots are claimed once per (class) group with
+          the SUMMED byte cost -- the coalescer's admission discipline;
+        * the backend calls run CONCURRENTLY (gather), so the codec
+          coalescer gathers the same one-tick fan-in as per-op tasks;
+        * counters, the latency grid, hit sets and budget releases fold
+          into one array pass; replies go out as one corked burst.
+
+        Semantics are the per-op path's exactly: dup answers, typed
+        error replies, composite-kind dup fan-out, apply-window kills
+        (a fired kill marks this daemon down and suppresses the batch's
+        replies -- the client resends and is answered from the dups
+        registry)."""
+        t_exec = time.monotonic()
+        n = len(items)
+        with _PS_BATCH:
+            qats = [m.pop("_queued_mono", None) for _, m in items]
+            t0 = min((q for q in qats if q is not None), default=t_exec)
+            op = self.optracker.create_request(
+                f"client_op_batch(n={n})",
+                span=trace.join(None, "osd:client_op_batch", t0=t0),
+                t0=t0,
+            )
+            sizes = [len(m.get("data") or b"") for _, m in items]
+            self.h_queue_wait.inc_pairs([
+                ((t_exec - (qat if qat is not None else t_exec)) * 1e6, sz)
+                for qat, sz in zip(qats, sizes)])
+            op.mark_event("dequeued")
+            replies = [{"op": "client_reply", "tid": m["tid"]}
+                       for _, m in items]
+            default_pool = next(iter(self.pools)) if self.pools else None
+            backends = []
+            for _, m in items:
+                b = self.pools.get(m.get("pool") or "")
+                if b is None and default_pool is not None:
+                    b = self.pools[default_pool]
+                backends.append(b)
+            kinds = [m.get("kind", "") for _, m in items]
+            reqids = [m.get("reqid") for _, m in items]
+            dedupable = [r is not None and k in MUTATING_KINDS
+                         for r, k in zip(reqids, kinds)]
+            # one-pass batch dup scan (the per-op path pays a lookup per
+            # op; here the registry dict is touched once per batch row)
+            hits = self.pglog.lookup_dups_batch(
+                [reqids[i] if dedupable[i] else None for i in range(n)])
+            run = []
+            dup_hits = 0
+            for i in range(n):
+                if backends[i] is None:
+                    replies[i].update(
+                        ok=False, etype="IOError",
+                        error=f"{self.name} hosts no pool")
+                elif hits[i] is not None:
+                    replies[i].update(ok=True, result=hits[i].result)
+                    dup_hits += 1
+                else:
+                    run.append(i)
+            if dup_hits:
+                self.perf.inc("dup_op_hit", dup_hits)
+            groups: Dict[str, list] = {}
+            for i in run:
+                klass = items[i][1].get("qos_class") or "client"
+                if self.qos_ops is None or \
+                        klass not in self.qos_ops.classes:
+                    klass = "client"
+                groups.setdefault(klass, []).append(i)
+        op.mark_event("started")
+
+        async def _exec_one(i):
+            reply = replies[i]
+            try:
+                reply.update(
+                    ok=True, result=await backends[i].client_op(items[i][1]))
+            except asyncio.CancelledError:
+                raise
+            except Exception as e:  # noqa: BLE001 -- every failure
+                # travels back to the client as a typed error
+                reply.update(ok=False, etype=type(e).__name__, error=str(e))
+
+        async def _exec_group(klass, idxs):
+            # amortized admission: ONE slot claim per class with the
+            # summed cost (per-op pays a tag + slot round trip each)
+            cost = sum(max(4096, sizes[i]) for i in idxs)
+            if self.qos_ops is not None and klass in self.qos_ops.classes:
+                guard = self.qos_ops.slot(klass, cost)
+            else:
+                guard = self._cop_sem
+            async with guard:
+                await asyncio.gather(*(_exec_one(i) for i in idxs))
+
+        try:
+            with trace.use_span(op.span):
+                if groups:
+                    await asyncio.gather(*(
+                        _exec_group(k, idxs) for k, idxs in groups.items()))
+                for i in run:
+                    if not (dedupable[i] and replies[i].get("ok")):
+                        continue
+                    m = items[i][1]
+                    if kinds[i] in _RESULT_FANOUT_KINDS:
+                        # composite kinds keep the awaited acting-set
+                        # dup fan-out (result only exists at completion)
+                        await self._record_op_dup(
+                            backends[i], m, replies[i].get("result"))
+                    else:
+                        self.pglog.record_dup(
+                            reqids[i], replies[i].get("result"),
+                            oid=m.get("oid", ""))
+            op.mark_event("replied")
+        finally:
+            dur_us = (time.monotonic() - t_exec) * 1e6
+            with _PS_BATCH:
+                n_ok = wr = rd = 0
+                oids = []
+                for (_, m), reply, size in zip(items, replies, sizes):
+                    if reply.get("ok"):
+                        n_ok += 1
+                        wr += size
+                        result = reply.get("result")
+                        if isinstance(result, (bytes, bytearray)):
+                            rd += len(result)
+                    if m.get("oid"):
+                        oids.append(m["oid"])
+                    release = m.pop("_budget_release", None)
+                    if release is not None:
+                        release()
+                    if m.pop("_client_gauge", None):
+                        self._client_ops_queued -= 1
+                # the latency grids take the whole run in one locked
+                # pass each; the hit set rolls once for the run
+                self.op_hist.inc_many(dur_us, sizes)
+                self.h_dispatch.inc_many(dur_us, sizes)
+                if oids:
+                    self.hitsets.record_many(oids)
+                if n_ok:
+                    self.perf.inc("client_ops", n_ok)
+                if wr:
+                    self.perf.inc("client_wr_bytes", wr)
+                if rd:
+                    self.perf.inc("client_rd_bytes", rd)
+            op.finish()
+        fault = getattr(self.messenger, "fault", None)
+        if fault is not None:
+            for i in range(n):
+                if (replies[i].get("ok") and dedupable[i]
+                        and fault.kill_after_apply_fire(kinds[i])):
+                    # injected dup-detection window: the whole batch
+                    # applied (dup entries recorded above) but this
+                    # primary dies before its reply burst -- the client
+                    # resends and is answered from a surviving PG log
+                    self.messenger.mark_down(self.name)
+                    return
+        if self.frozen or self.messenger.is_down(self.name):
+            return
+        await self.messenger.send_messages(
+            self.name, [(items[i][0], replies[i]) for i in range(n)])
 
     async def _execute_op(self, src: str, msg) -> None:
         if isinstance(msg, dict):
@@ -1198,21 +1437,22 @@ class OSDShard:
         (src/osd/OSD.cc:9072, src/osd/PrimaryLogPG.cc:1649)."""
         t_exec = time.monotonic()
         qat = msg.pop("_queued_mono", None)
-        # the op backdates to its queue-entry stamp; its span (when the
-        # client's trace context rode the op) starts there too, so the
-        # timeline's first segment is the true queue wait
-        op = self.optracker.create_request(
-            f"client_op({msg.get('kind')} oid={msg.get('oid')} "
-            f"from={src})",
-            span=trace.join(msg.get("trace"), f"osd:{msg.get('kind')}",
-                            t0=qat),
-            t0=qat,
-        )
-        self.h_queue_wait.inc(
-            (t_exec - (qat if qat is not None else t_exec)) * 1e6,
-            len(msg.get("data") or b""))
-        op.mark_event("dequeued")
-        reply = {"op": "client_reply", "tid": msg["tid"]}
+        with _PS_OP:
+            # the op backdates to its queue-entry stamp; its span (when
+            # the client's trace context rode the op) starts there too,
+            # so the timeline's first segment is the true queue wait
+            op = self.optracker.create_request(
+                f"client_op({msg.get('kind')} oid={msg.get('oid')} "
+                f"from={src})",
+                span=trace.join(msg.get("trace"), f"osd:{msg.get('kind')}",
+                                t0=qat),
+                t0=qat,
+            )
+            self.h_queue_wait.inc(
+                (t_exec - (qat if qat is not None else t_exec)) * 1e6,
+                len(msg.get("data") or b""))
+            op.mark_event("dequeued")
+            reply = {"op": "client_reply", "tid": msg["tid"]}
         try:
             # the op span is task-current for the whole execution: the
             # engine's fan-outs stamp it onto sub-ops and the coalescer
@@ -1220,15 +1460,16 @@ class OSDShard:
             with trace.use_span(op.span):
                 await self._run_client_op_inner(src, msg, op, reply)
         finally:
-            self.h_dispatch.inc(
-                (time.monotonic() - t_exec) * 1e6,
-                len(msg.get("data") or b""))
-            release = msg.pop("_budget_release", None)
-            if release is not None:
-                release()  # claimed messenger dispatch-throttle budget
-            if msg.pop("_client_gauge", None):
-                self._client_ops_queued -= 1
-            op.finish()
+            with _PS_OP:
+                self.h_dispatch.inc(
+                    (time.monotonic() - t_exec) * 1e6,
+                    len(msg.get("data") or b""))
+                release = msg.pop("_budget_release", None)
+                if release is not None:
+                    release()  # claimed dispatch-throttle budget
+                if msg.pop("_client_gauge", None):
+                    self._client_ops_queued -= 1
+                op.finish()
 
     async def _run_client_op_inner(self, src: str, msg: dict, op,
                                    reply: dict) -> None:
@@ -1249,55 +1490,60 @@ class OSDShard:
         else:
             guard = self._cop_sem
         async with guard:
-            op.mark_event("started")
-            pool_name = msg.get("pool") or ""
-            backend = self.pools.get(pool_name)
-            if backend is None and self.pools:
-                # fall back to the hosted pool -- and make the cap
-                # check below use the pool the op will actually RUN on,
-                # never the requested name (a grant on an unhosted name
-                # must not leak onto the hosted pool)
-                pool_name = next(iter(self.pools))
-                backend = self.pools[pool_name]
-            cap = self.client_caps.get(src.split("[")[0])
-            if cap is not None and backend is not None:
-                # OSDCap enforcement (PrimaryLogPG
-                # op_has_sufficient_caps): an entity with registered
-                # caps is confined to them; unregistered entities keep
-                # the open-cluster default (client.admin allow *)
-                from ceph_tpu.auth.caps import op_capable
+            # the sync bookkeeping head is a declared wire-tax cost
+            # center (osd.op_exec): what the batched fast path amortizes
+            with _PS_OP:
+                op.mark_event("started")
+                pool_name = msg.get("pool") or ""
+                backend = self.pools.get(pool_name)
+                if backend is None and self.pools:
+                    # fall back to the hosted pool -- and make the cap
+                    # check below use the pool the op will actually RUN
+                    # on, never the requested name (a grant on an
+                    # unhosted name must not leak onto the hosted pool)
+                    pool_name = next(iter(self.pools))
+                    backend = self.pools[pool_name]
+                cap = self.client_caps.get(src.split("[")[0])
+                if cap is not None and backend is not None:
+                    # OSDCap enforcement (PrimaryLogPG
+                    # op_has_sufficient_caps): an entity with registered
+                    # caps is confined to them; unregistered entities
+                    # keep the open-cluster default (client.admin
+                    # allow *)
+                    from ceph_tpu.auth.caps import op_capable
 
-                if not op_capable(cap, pool_name,
-                                  msg.get("oid", ""), msg.get("kind", "")):
+                    if not op_capable(cap, pool_name, msg.get("oid", ""),
+                                      msg.get("kind", "")):
+                        reply.update(
+                            ok=False, etype="PermissionError",
+                            error=f"{src} caps do not permit "
+                                  f"{msg.get('kind')} on {msg.get('oid')}",
+                        )
+                        backend = None
+                        self.perf.inc("cap_denied")
+                kind = msg.get("kind", "")
+                reqid = msg.get("reqid")
+                dedupable = reqid is not None and kind in MUTATING_KINDS
+                execute = False
+                if backend is None and "etype" not in reply:
                     reply.update(
-                        ok=False, etype="PermissionError",
-                        error=f"{src} caps do not permit "
-                              f"{msg.get('kind')} on {msg.get('oid')}",
+                        ok=False, etype="IOError",
+                        error=f"{self.name} hosts no pool",
                     )
-                    backend = None
-                    self.perf.inc("cap_denied")
-            kind = msg.get("kind", "")
-            reqid = msg.get("reqid")
-            dedupable = reqid is not None and kind in MUTATING_KINDS
-            if backend is None and "etype" not in reply:
-                reply.update(
-                    ok=False, etype="IOError",
-                    error=f"{self.name} hosts no pool",
-                )
-            elif backend is not None and dedupable and (
-                self.pglog.lookup_dup(reqid) is not None
-            ):
-                # replay of an op this PG already applied (the client
-                # resent after a failover): answer with the ORIGINAL
-                # result from the log instead of re-executing -- the
-                # exactly-once guarantee (reference:
-                # PrimaryLogPG::do_op eversion/reqid check via
-                # pg_log_dup_t, src/osd/osd_types.h)
-                reply.update(
-                    ok=True, result=self.pglog.lookup_dup(reqid).result
-                )
-                self.perf.inc("dup_op_hit")
-            elif backend is not None:
+                elif backend is not None and dedupable and (
+                    (hit := self.pglog.lookup_dup(reqid)) is not None
+                ):
+                    # replay of an op this PG already applied (the
+                    # client resent after a failover): answer with the
+                    # ORIGINAL result from the log instead of
+                    # re-executing -- the exactly-once guarantee
+                    # (reference: PrimaryLogPG::do_op eversion/reqid
+                    # check via pg_log_dup_t, src/osd/osd_types.h)
+                    reply.update(ok=True, result=hit.result)
+                    self.perf.inc("dup_op_hit")
+                elif backend is not None:
+                    execute = True
+            if execute:
                 try:
                     reply.update(ok=True, result=await backend.client_op(msg))
                 except asyncio.CancelledError:
@@ -1312,21 +1558,22 @@ class OSDShard:
                         backend, msg, reply.get("result"))
             op.mark_event("replied")
         op.finish()
-        self.op_hist.inc(op.duration * 1e6,
-                         len(msg.get("data") or b""))
-        if reply.get("ok"):
-            # rate-engine feed (mgr/pgmap.py): consecutive MgrReport
-            # deltas of these become the `ceph -s` io block (client
-            # ops/s + throughput, distinct from recovery_bytes)
-            self.perf.inc("client_ops")
-            wr = len(msg.get("data") or b"")
-            if wr:
-                self.perf.inc("client_wr_bytes", wr)
-            result = reply.get("result")
-            if isinstance(result, (bytes, bytearray)):
-                self.perf.inc("client_rd_bytes", len(result))
-        if msg.get("oid"):
-            self.hitsets.record(msg["oid"])
+        with _PS_OP:
+            self.op_hist.inc(op.duration * 1e6,
+                             len(msg.get("data") or b""))
+            if reply.get("ok"):
+                # rate-engine feed (mgr/pgmap.py): consecutive MgrReport
+                # deltas of these become the `ceph -s` io block (client
+                # ops/s + throughput, distinct from recovery_bytes)
+                self.perf.inc("client_ops")
+                wr = len(msg.get("data") or b"")
+                if wr:
+                    self.perf.inc("client_wr_bytes", wr)
+                result = reply.get("result")
+                if isinstance(result, (bytes, bytearray)):
+                    self.perf.inc("client_rd_bytes", len(result))
+            if msg.get("oid"):
+                self.hitsets.record(msg["oid"])
         fault = getattr(self.messenger, "fault", None)
         if (
             fault is not None and reply.get("ok") and dedupable

@@ -34,6 +34,17 @@ Four gates, every one raising on violation:
   saturated wall, and ops/s at >= 1.5x the remeasured python-mode
   baseline.  Skipped (and recorded) when the native codec is
   unavailable -- the graceful-fallback contract.
+* **OSD-exec A/B** (round 22): per-op client-op execution
+  (``osd_op_batch_exec`` off) vs the array-batched fast path, same
+  payloads and submit batching -- stored shard bytes byte-identical
+  across modes, and the OSD execution cost centers (``osd.op_exec`` +
+  ``osd.batch_exec``) at <= ``osd_share_ratio_max`` of their per-op
+  share of the saturated wall.
+* **ring-vs-TCP A/B** (round 22): localhost TCP vs shared-memory frame
+  rings (``osd_msgr_shm_ring``) for the colocated daemons -- the rings
+  must actually carry the traffic (``ring_conns`` counter), shard
+  bytes identical, ops/s >= ``ring_gain_min`` x the TCP baseline, and
+  per-frame send cost recorded per mode.
 
 Used by bench.py (``wire_tax_host`` + the ``wire_tax_*`` headline
 keys), ``tools/ec_benchmark.py --workload wire-tax [--smoke]``, and
@@ -75,6 +86,16 @@ def _serialization_share(decomp: dict) -> float:
         row["pct"] for row in decomp["rows"]
         if row["stage"] in ("wire.encode", "wire.decode_body",
                             "wire.envelope")), 3)
+
+
+def _osd_exec_share(decomp: dict) -> float:
+    """The OSD execution cost centers' summed share of the wall:
+    ``osd.op_exec`` (the per-op bookkeeping sections) plus
+    ``osd.batch_exec`` (the batched fast path's array passes) -- what
+    the round-22 batch-execution A/B compares across modes."""
+    return round(sum(
+        row["pct"] for row in decomp["rows"]
+        if row["stage"] in ("osd.op_exec", "osd.batch_exec")), 3)
 
 
 def _codec_frame_bytes_gate() -> None:
@@ -172,7 +193,9 @@ def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
                        top_n: int = 5,
                        codec_gain_min: float = 1.5,
                        codec_share_ratio_max: float = 0.5,
-                       codec_batch: int = 8) -> dict:
+                       codec_batch: int = 8,
+                       osd_share_ratio_max: float = 0.6,
+                       ring_gain_min: float = 0.85) -> dict:
     """The full stage; raises on any gate violation.  Returns the
     JSON-ready dict bench.py records as ``wire_tax_host``."""
     from ceph_tpu.msg.cluster_bench import ClusterHarness, make_payloads
@@ -367,6 +390,197 @@ def run_wire_tax_bench(ec=None, *, n_objects: int = 48,
                     f"python-codec baseline, below the "
                     f"{codec_gain_min}x gate")
 
+        # -- OSD-exec A/B (the round-22 batch-execution gate) ---------
+        # The per-op execution loop (osd_op_batch_exec off, the pre-r22
+        # baseline) against the array-batched fast path, same payloads,
+        # vectorized submit in BOTH modes so the only delta is the OSD
+        # execution architecture.  Submit shape leans batch-heavy
+        # (fewer writers, deeper bursts) so the dispatch loop hands the
+        # shards real runs -- both modes get the identical shape.  Two
+        # gates: the OSD execution cost centers (osd.op_exec +
+        # osd.batch_exec) at <= osd_share_ratio_max of their per-op
+        # share of the saturated wall (min ratio across bounded
+        # attempts -- the overhead gate's machine-drift defense; the
+        # shares are ~2% of wall, inside single-run noise), and the
+        # stored shard bytes byte-identical across modes (asserted
+        # directly on the stores every attempt, so a batching shortcut
+        # can never hide behind a throughput win).
+        cfg3 = _get_config()
+        prior_batch_exec = bool(cfg3.get_val("osd_op_batch_exec"))
+        seg_cycles3 = max(2, iters)
+        ab_writers = max(2, writers // 3)
+        ab_batch = max(codec_batch,
+                       -(-n_objects // ab_writers))  # ceil division
+        abx_best: Optional[dict] = None
+        attempts3 = 0
+        try:
+            while True:
+                attempts3 += 1
+                abx: Dict[str, dict] = {}
+                mode_shards: Dict[str, dict] = {}
+                for mode, batch_on in (("perop", False),
+                                       ("batched", True)):
+                    cfg3.apply_changes({"osd_op_batch_exec": batch_on})
+                    h3 = ClusterHarness(
+                        ec, n_osds, cork=True,
+                        pool=f"oxab{attempts3}{mode}")
+                    loop.run_until_complete(h3.start())
+                    try:
+                        for oid in payloads:
+                            h3.objecter.acting_set(oid)
+                        loop.run_until_complete(_cycle(
+                            h3, payloads, ab_writers, batch=ab_batch))
+                        profiling.configure(mode="on")
+                        profiling.reset()
+                        t0 = time.perf_counter_ns()
+                        for _ in range(seg_cycles3):
+                            loop.run_until_complete(_cycle(
+                                h3, payloads, ab_writers,
+                                batch=ab_batch))
+                        wall3 = time.perf_counter_ns() - t0
+                        abx[mode] = {
+                            "ops_per_sec": round(
+                                seg_cycles3 * 2 * n_objects
+                                / (wall3 / 1e9), 1),
+                            "osd_exec_share_pct": _osd_exec_share(
+                                profiling.decomposition(wall3)),
+                        }
+                        profiling.configure(mode="off")
+                        mode_shards[mode] = h3.shard_bytes()
+                    finally:
+                        loop.run_until_complete(h3.shutdown())
+                if mode_shards["perop"] != mode_shards["batched"]:
+                    raise AssertionError(
+                        "wire-tax osd-exec A/B: batched and per-op "
+                        "execution left different shard bytes in the "
+                        "stores")
+                abx["ratio"] = abx["batched"]["osd_exec_share_pct"] / \
+                    max(1e-9, abx["perop"]["osd_exec_share_pct"])
+                if abx_best is None or abx["ratio"] < abx_best["ratio"]:
+                    abx_best = abx
+                if abx_best["ratio"] <= osd_share_ratio_max or \
+                        attempts3 >= max(1, retries):
+                    break
+        finally:
+            cfg3.apply_changes({"osd_op_batch_exec": prior_batch_exec})
+        out["osd_exec_shard_bytes_identical"] = True
+        out["osd_exec_ab_attempts"] = attempts3
+        out["osd_exec_perop_ops_per_sec"] = \
+            abx_best["perop"]["ops_per_sec"]
+        out["osd_exec_batched_ops_per_sec"] = \
+            abx_best["batched"]["ops_per_sec"]
+        out["osd_batch_gain"] = round(
+            abx_best["batched"]["ops_per_sec"]
+            / max(1e-9, abx_best["perop"]["ops_per_sec"]), 3)
+        out["osd_exec_share_perop_pct"] = \
+            abx_best["perop"]["osd_exec_share_pct"]
+        out["osd_exec_share_batched_pct"] = \
+            abx_best["batched"]["osd_exec_share_pct"]
+        out["osd_exec_share_ratio"] = round(abx_best["ratio"], 3)
+        if abx_best["ratio"] > osd_share_ratio_max:
+            raise AssertionError(
+                f"wire-tax osd-exec A/B: OSD-execution share with "
+                f"batching is {abx_best['ratio']:.2f}x the per-op "
+                f"share after {attempts3} attempts, above the "
+                f"{osd_share_ratio_max} gate")
+
+        # -- ring-vs-TCP A/B (the round-22 shm frame-ring gate) -------
+        # The same saturated path over localhost TCP against the
+        # shared-memory frame rings (osd_msgr_shm_ring on; every daemon
+        # pair colocated here, so every connection is ring-eligible).
+        # Gates: the rings actually carried the traffic (ring_conns >
+        # 0 in ring mode, 0 in tcp mode), stored shard bytes identical
+        # across transports, and ring-mode ops/s >= ring_gain_min x the
+        # tcp-mode baseline.  Per-frame send cost (wire.writelines ns /
+        # frames sent in the measured segment) is recorded per mode as
+        # the frame-latency evidence.
+        cfg4 = _get_config()
+        prior_ring = bool(cfg4.get_val("osd_msgr_shm_ring"))
+        abr_best: Optional[dict] = None
+        attempts4 = 0
+        try:
+            while True:
+                attempts4 += 1
+                abr: Dict[str, dict] = {}
+                ring_shards: Dict[str, dict] = {}
+                for mode, ring_on in (("tcp", False), ("ring", True)):
+                    cfg4.apply_changes({"osd_msgr_shm_ring": ring_on})
+                    h4 = ClusterHarness(
+                        ec, n_osds, cork=True,
+                        pool=f"rgab{attempts4}{mode}")
+                    loop.run_until_complete(h4.start())
+                    try:
+                        for oid in payloads:
+                            h4.objecter.acting_set(oid)
+                        loop.run_until_complete(_cycle(
+                            h4, payloads, writers, batch=codec_batch))
+                        frames_warm = h4.wire_counters().get(
+                            "frames_sent", 0)
+                        profiling.configure(mode="on")
+                        profiling.reset()
+                        t0 = time.perf_counter_ns()
+                        for _ in range(seg_cycles3):
+                            loop.run_until_complete(_cycle(
+                                h4, payloads, writers,
+                                batch=codec_batch))
+                        wall4 = time.perf_counter_ns() - t0
+                        decomp4 = profiling.decomposition(wall4)
+                        wc4 = h4.wire_counters()
+                        frames_seg = max(
+                            1, wc4.get("frames_sent", 0) - frames_warm)
+                        send_ns = sum(
+                            r["ns"] for r in decomp4["rows"]
+                            if r["stage"] in ("wire.writelines",
+                                              "ring.push"))
+                        abr[mode] = {
+                            "ops_per_sec": round(
+                                seg_cycles3 * 2 * n_objects
+                                / (wall4 / 1e9), 1),
+                            "frame_send_ns": round(
+                                send_ns / frames_seg),
+                            "ring_conns": wc4.get("ring_conns", 0),
+                            "tcp_conns": wc4.get("tcp_conns", 0),
+                        }
+                        profiling.configure(mode="off")
+                        ring_shards[mode] = h4.shard_bytes()
+                    finally:
+                        loop.run_until_complete(h4.shutdown())
+                if abr["ring"]["ring_conns"] <= 0:
+                    raise AssertionError(
+                        "wire-tax ring A/B: ring mode opened no "
+                        "shm-ring connections -- the A/B measured TCP "
+                        "twice")
+                if abr["tcp"]["ring_conns"] != 0:
+                    raise AssertionError(
+                        "wire-tax ring A/B: tcp baseline mode carried "
+                        "traffic over shm rings")
+                if ring_shards["tcp"] != ring_shards["ring"]:
+                    raise AssertionError(
+                        "wire-tax ring A/B: ring and TCP transports "
+                        "left different shard bytes in the stores")
+                abr["gain"] = abr["ring"]["ops_per_sec"] / \
+                    max(1e-9, abr["tcp"]["ops_per_sec"])
+                if abr_best is None or abr["gain"] > abr_best["gain"]:
+                    abr_best = abr
+                if abr_best["gain"] >= ring_gain_min or \
+                        attempts4 >= max(1, retries):
+                    break
+        finally:
+            cfg4.apply_changes({"osd_msgr_shm_ring": prior_ring})
+        out["ring_shard_bytes_identical"] = True
+        out["ring_ab_attempts"] = attempts4
+        out["ring_conns"] = abr_best["ring"]["ring_conns"]
+        out["tcp_ops_per_sec"] = abr_best["tcp"]["ops_per_sec"]
+        out["ring_ops_per_sec"] = abr_best["ring"]["ops_per_sec"]
+        out["ring_gain"] = round(abr_best["gain"], 3)
+        out["tcp_frame_send_ns"] = abr_best["tcp"]["frame_send_ns"]
+        out["ring_frame_send_ns"] = abr_best["ring"]["frame_send_ns"]
+        if out["ring_gain"] < ring_gain_min:
+            raise AssertionError(
+                f"wire-tax ring A/B: {out['ring_gain']:.2f}x ops/s "
+                f"over the TCP baseline after {attempts4} attempts, "
+                f"below the {ring_gain_min}x gate")
+
         # -- export contract: a short full-mode sampled segment -------
         profiling.configure(mode="full")
         loop.run_until_complete(_cycle(harness, payloads, writers))
@@ -412,7 +626,8 @@ def main(argv=None) -> int:
         result = run_wire_tax_bench(
             n_objects=8, obj_bytes=4096, writers=4, iters=1,
             coverage_min_pct=50.0, overhead_limit_pct=50.0,
-            codec_gain_min=0.5, codec_share_ratio_max=0.95)
+            codec_gain_min=0.5, codec_share_ratio_max=0.95,
+            osd_share_ratio_max=5.0, ring_gain_min=0.3)
     else:
         result = run_wire_tax_bench()
     print(json.dumps(result, indent=2), file=sys.stderr)

@@ -373,6 +373,35 @@ OPTIONS: Dict[str, Option] = {
              "many pending frame bytes flushes immediately instead of "
              "waiting for the end-of-tick flush",
              see_also=("osd_msgr_cork",)),
+        _opt("osd_msgr_shm_ring", bool, False, LEVEL_ADVANCED,
+             "carry frame bursts between mesh-colocated daemons over "
+             "seqlock'd shared-memory byte rings (msg/shm_ring.py) "
+             "instead of the localhost TCP hop.  The protocol above "
+             "the byte transport -- banner, auth, session watermarks, "
+             "cumulative acks, frame crcs, torn-burst replay -- runs "
+             "unchanged; peers without a ring-registered accept "
+             "endpoint fall back to TCP per connection.  False "
+             "(default) keeps TCP everywhere, the A/B baseline",
+             see_also=("osd_shm_ring_bytes", "osd_msgr_cork")),
+        _opt("osd_shm_ring_bytes", int, 4 << 20, LEVEL_ADVANCED,
+             "per-direction byte capacity of each shared-memory frame "
+             "ring; a full ring back-pressures the producer's drain() "
+             "exactly like a full socket buffer",
+             see_also=("osd_msgr_shm_ring",)),
+        _opt("osd_op_batch_exec", bool, True, LEVEL_ADVANCED,
+             "execute decoded client-op bursts through the OSD shard's "
+             "array-batched fast path (osd/shard.py): one optracker "
+             "request, one dups-registry pass, per-class amortized QoS "
+             "admission and one corked reply burst per batch instead "
+             "of per-op dict walks.  Semantics (dup answers, typed "
+             "errors, apply-window kills, caps) are identical; false "
+             "runs the per-op path, the A/B baseline the wire-tax "
+             "bench compares against",
+             see_also=("osd_op_batch_max", "osd_wire_codec_native")),
+        _opt("osd_op_batch_max", int, 64, LEVEL_ADVANCED,
+             "max client ops gathered into one batched execution run "
+             "(bounds per-batch reply latency and memory)",
+             see_also=("osd_op_batch_exec",)),
         _opt("osd_wire_codec_native", bool, True, LEVEL_ADVANCED,
              "batch-encode/decode v4 frame bodies through the "
              "_wire_native C extension (ceph_tpu/native/wire_codec.py); "

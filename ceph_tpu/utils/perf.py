@@ -165,6 +165,35 @@ class PerfHistogram:
             self._x_sum += x_value * amount
             self._count += amount
 
+    def inc_many(self, x_value: float, y_values, amount: int = 1) -> None:
+        """Batch form of :meth:`inc` for one shared x observation over a
+        run of y values (the OSD's array-batched op path): the x bucket
+        is computed once and the whole run folds in under ONE lock
+        acquisition instead of one per observation."""
+        base = self.x.bucket_for(x_value) * self.y.buckets
+        bucket_y = self.y.bucket_for
+        n = 0
+        with self._lock:
+            for y in y_values:
+                self._values[base + bucket_y(y)] += amount
+                n += 1
+            self._x_sum += x_value * amount * n
+            self._count += amount * n
+
+    def inc_pairs(self, pairs) -> None:
+        """Batch form of :meth:`inc` for (x, y) observation pairs: one
+        lock acquisition for the whole run."""
+        bucket_x = self.x.bucket_for
+        bucket_y = self.y.bucket_for
+        yb = self.y.buckets
+        with self._lock:
+            n = 0
+            for x, y in pairs:
+                self._values[bucket_x(x) * yb + bucket_y(y)] += 1
+                self._x_sum += x
+                n += 1
+            self._count += n
+
     def snapshot(self) -> dict:
         with self._lock:
             return {

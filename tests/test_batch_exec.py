@@ -1,0 +1,263 @@
+"""Array-batched client-op execution (osd_op_batch_exec): semantics.
+
+The round-22 post-codec fast path folds per-op OSD bookkeeping --
+optracker stamping, dup lookups, QoS admission, perf/hitset accounting,
+reply sends -- into array passes over a gathered run of client ops
+(osd/shard.py _run_client_op_batch).  These tests pin the contract the
+per-op path already guarantees:
+
+* bit-exactness: the batched and per-op paths store IDENTICAL shard
+  bytes for identical payloads and round-trip every object (the same
+  gate wire_tax_bench applies before timing the A/B);
+* exactly-once: a primary killed in the apply-reply window MID-BATCH
+  (every op applied, dups recorded, no reply burst) is healed by the
+  clients' resends, each answered with the ORIGINAL result from the
+  PG-log dups registry -- zero double-applies;
+* the dup scan really is batched: a replayed burst is answered from
+  ``PGLog.lookup_dups_batch`` hits without re-executing anything.
+"""
+
+from __future__ import annotations
+
+import asyncio
+
+import pytest
+
+from ceph_tpu import profiling
+from ceph_tpu.msg.fault import FaultInjector
+from ceph_tpu.osd.cluster import ECCluster
+from ceph_tpu.utils.encoding import Decoder
+from ceph_tpu.utils.config import get_config
+from ceph_tpu.utils.perf import PerfCounters
+
+PROFILE = {"k": "2", "m": "1", "technique": "reed_sol_van",
+           "plugin": "jerasure"}
+
+
+def run(coro):
+    return asyncio.new_event_loop().run_until_complete(coro)
+
+
+def _mk(n_osds=6, **kw):
+    PerfCounters.reset_all()
+    fault = FaultInjector(seed=3)
+    cluster = ECCluster(n_osds, dict(PROFILE), fault=fault, **kw)
+    return cluster, fault
+
+
+class _Config:
+    """Apply config overrides for the test body; restore on exit."""
+
+    def __init__(self, **overrides):
+        self.overrides = overrides
+
+    def __enter__(self):
+        self.cfg = get_config()
+        self.prior = {k: self.cfg.get_val(k) for k in self.overrides}
+        self.cfg.apply_changes(dict(self.overrides))
+        return self
+
+    def __exit__(self, *exc):
+        self.cfg.apply_changes(self.prior)
+        return False
+
+
+def _ec():
+    from ceph_tpu.plugins import registry as registry_mod
+
+    return registry_mod.instance().factory(
+        "jerasure", {"k": "2", "m": "1", "technique": "reed_sol_van"})
+
+
+# -- bit-exactness: batched vs per-op store identical bytes ------------------
+
+
+def test_batched_vs_perop_byte_identical_stores():
+    """Same payloads through both execution modes over real TCP: the
+    stored shard bytes must be identical and every object must round
+    trip.  The batched run must actually take the batch path (the
+    ``osd.batch_exec`` cost center fires)."""
+    from ceph_tpu.msg.cluster_bench import ClusterHarness, make_payloads
+
+    payloads = make_payloads(24, 2048, seed=11)
+    stage_calls = {}
+
+    async def one_mode(batch_on: bool):
+        with _Config(osd_op_batch_exec=batch_on):
+            h = ClusterHarness(_ec(), 3, cork=True,
+                               pool=f"bx{int(batch_on)}")
+            await h.start()
+            try:
+                profiling.configure(mode="on")
+                profiling.reset()
+                # writers < objects/batch so the submit bursts arrive as
+                # multi-op runs the worker can gather
+                await h.run_writes(payloads, writers=2, batch=12)
+                stages = profiling.snapshot()["stages"]
+                stage_calls[batch_on] = stages.get(
+                    "osd.batch_exec", {}).get("calls", 0)
+                _, got = await h.run_reads(payloads, readers=2, batch=12)
+                assert got == payloads
+                return h.shard_bytes()
+            finally:
+                profiling.configure(mode="off")
+                await h.shutdown()
+
+    async def main():
+        perop = await one_mode(False)
+        batched = await one_mode(True)
+        assert perop == batched, "batched path stored different bytes"
+        assert stage_calls[True] >= 1, "batch path never engaged"
+        assert stage_calls[False] == 0, "per-op mode ran the batch path"
+
+    run(main())
+
+
+# -- exactly-once: mid-batch primary kill, replay answered from dups ---------
+
+
+def test_mid_batch_kill_replayed_from_dups_zero_double_applies():
+    """A batch of non-idempotent execs applies fully, then the primary
+    dies BEFORE the reply burst (FaultInjector apply-window kill fired
+    mid-batch).  The replayed burst must be answered entirely from the
+    dups registry with the ORIGINAL results -- each counter incremented
+    exactly once."""
+
+    async def main():
+        cluster, fault = _mk()
+        try:
+            # oids that share one primary so the gathered run lands on a
+            # single shard's queue as one batch
+            acting0 = cluster.backend.acting_set("bk0")
+            oids = ["bk0"]
+            probe = 0
+            while len(oids) < 4:
+                probe += 1
+                cand = f"bk{probe}x"
+                if cluster.backend.acting_set(cand)[0] == acting0[0]:
+                    oids.append(cand)
+            shard = cluster.osds[acting0[0]]
+
+            replies = {}
+            done = asyncio.Event()
+
+            async def raw_dispatch(src, msg):
+                if isinstance(msg, dict) and msg.get("op") == "client_reply":
+                    replies[msg["tid"]] = msg
+                    if len(replies) >= len(oids):
+                        done.set()
+
+            cluster.messenger.register("rawclient", raw_dispatch)
+
+            def burst(tid0):
+                return [{
+                    "op": "client_op", "tid": tid0 + i, "kind": "exec",
+                    "oid": oid, "pool": cluster.pool, "cls": "version",
+                    "method": "inc", "inp": b"",
+                    "reqid": ["rawclient", 1, i + 1],
+                } for i, oid in enumerate(oids)]
+
+            profiling.configure(mode="on")
+            profiling.reset()
+            try:
+                fault.schedule_kill_after_apply("exec")
+                # enqueue the whole burst before the op worker wakes:
+                # dispatch() only stamps + enqueues, so the worker's
+                # gather sees the full run
+                for msg in burst(100):
+                    await shard.dispatch("rawclient", msg)
+                for _ in range(200):
+                    if fault.apply_kills:
+                        break
+                    await asyncio.sleep(0.01)
+                stages = profiling.snapshot()["stages"]
+                assert stages.get("osd.batch_exec", {}).get("calls", 0) >= 1
+            finally:
+                profiling.configure(mode="off")
+
+            # the kill window: every op applied (dups recorded), the
+            # primary marked down, the reply burst suppressed
+            assert fault.apply_kills == 1
+            assert not replies, "replies escaped the apply-window kill"
+            for i in range(len(oids)):
+                assert shard.pglog.lookup_dup(("rawclient", 1, i + 1)) \
+                    is not None, "batch applied without recording dups"
+
+            # replay: same reqids, revived primary -- answered from dups
+            cluster.revive_osd(acting0[0])
+            for msg in burst(200):
+                await shard.dispatch("rawclient", msg)
+            await asyncio.wait_for(done.wait(), timeout=10.0)
+            for i in range(len(oids)):
+                r = replies[200 + i]
+                assert r["ok"], r
+                ret, out = r["result"]
+                assert ret == 0 and Decoder(out).value() == 1, \
+                    "double-applied (counter != 1) or wrong dup result"
+            snap = shard.perf.snapshot()
+            assert snap.get("dup_op_hit", 0) >= len(oids)
+
+            # exactly-once, independently read back: every counter is 1
+            for oid in oids:
+                ret, out = await cluster.backend.exec(oid, "version", "get")
+                assert ret == 0 and Decoder(out).value() == 1
+        finally:
+            await cluster.shutdown()
+
+    run(main())
+
+
+# -- batch formation: the gather respects osd_op_batch_max -------------------
+
+
+def test_gather_respects_batch_max_and_spill():
+    """A run longer than ``osd_op_batch_max`` splits; a non-client item
+    behind the run ends the gather and is handed back (spill)."""
+
+    async def main():
+        with _Config(osd_op_batch_max=4):
+            cluster, _fault = _mk(n_osds=3)
+            try:
+                acting0 = cluster.backend.acting_set("gm0")
+                shard = cluster.osds[acting0[0]]
+                replies = {}
+                done = asyncio.Event()
+
+                async def raw_dispatch(src, msg):
+                    if isinstance(msg, dict) \
+                            and msg.get("op") == "client_reply":
+                        replies[msg["tid"]] = msg
+                        if len(replies) >= 6:
+                            done.set()
+
+                cluster.messenger.register("rawclient", raw_dispatch)
+                profiling.configure(mode="on")
+                profiling.reset()
+                try:
+                    for i in range(6):
+                        await shard.dispatch("rawclient", {
+                            "op": "client_op", "tid": 300 + i,
+                            "kind": "write", "oid": f"gm{i}",
+                            "pool": cluster.pool, "data": b"x" * 64,
+                            "reqid": ["rawclient", 2, i + 1],
+                        })
+                    await asyncio.wait_for(done.wait(), timeout=10.0)
+                    stages = profiling.snapshot()["stages"]
+                    # 6 ops at batch_max=4 -> two batch runs; each run
+                    # enters the stage twice (pre-pass + finally pass),
+                    # so >2 calls distinguishes two runs from one
+                    assert stages.get("osd.batch_exec",
+                                      {}).get("calls", 0) > 2
+                finally:
+                    profiling.configure(mode="off")
+                for i in range(6):
+                    assert replies[300 + i]["ok"]
+                    assert await cluster.backend.read(f"gm{i}") == b"x" * 64
+            finally:
+                await cluster.shutdown()
+
+    run(main())
+
+
+if __name__ == "__main__":
+    raise SystemExit(pytest.main([__file__, "-q"]))

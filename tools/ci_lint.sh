@@ -25,6 +25,11 @@
 #   --profile-smoke runs ONLY the wire-tax profiler smoke
 #   (ec_benchmark --workload wire-tax --smoke: every attribution gate
 #   armed at CI shape) and exits with its status.
+#   --ring-smoke runs the shared-memory frame ring smoke (byte
+#   fidelity through wraparound, torn-record -> RingTear, the stream
+#   adapters end to end) plus the ring-framing mutant fuzz (header/
+#   record byte corruption never crashes or silently corrupts a pop),
+#   exiting with its status.
 #   --san-smoke builds the ASan/UBSan-instrumented codec twin
 #   (wire_ext_san) and runs the differential fuzzer (tools/
 #   wire_fuzz.py: 600 seeded cases, python<->C byte equivalence both
@@ -64,6 +69,18 @@ if [ "${1:-}" = "--san-smoke" ]; then
     JAX_PLATFORMS=cpu python tools/wire_fuzz.py --san --cases 600 \
         --leak-passes 6 > /dev/null
     echo "cephlint: sanitized codec fuzz + leak gate passed" >&2
+    exit 0
+fi
+
+if [ "${1:-}" = "--ring-smoke" ]; then
+    # shm frame ring smoke (round 22): the colocated byte transport's
+    # seqlock/crc layout -- wraparound fidelity, torn-record tears and
+    # the messenger stream adapters -- then the framing mutant fuzz
+    # (ring corruption may only ever surface as RingTear)
+    JAX_PLATFORMS=cpu python -m ceph_tpu.msg.shm_ring --smoke > /dev/null
+    JAX_PLATFORMS=cpu python tools/wire_fuzz.py --cases 14 \
+        --mutations 0 --ring-cases 300 > /dev/null
+    echo "cephlint: shm ring smoke + framing mutant fuzz passed" >&2
     exit 0
 fi
 

@@ -23,7 +23,13 @@ property over a seeded corpus:
   deletion) before reporting, so the repro in CI output is small;
 * **leak gate** (``--leak-passes N``): N identical passes over the
   corpus through the native module; after a warm-up pass the gc object
-  count and process RSS must stay flat.
+  count and process RSS must stay flat;
+* **ring-framing mutants** (``--ring-cases N``): byte corruption of the
+  shm frame ring's layout (msg/shm_ring.py) -- header words (head/
+  tail/wseq seqlock) and data-region record bytes.  The consumer-side
+  property: ``pop()`` returns the EXACT bytes of a pushed record or
+  raises ``RingTear``; it must never crash with anything else and never
+  hand back bytes that were not pushed (silent corruption).
 
 ``--san`` loads the ASan/UBSan-instrumented twin
 (``make -C ceph_tpu/native wire_ext_san``); the interpreter itself is
@@ -351,6 +357,83 @@ def _check_message(wire, nat, msg: object,
     return n_mut, fell_back
 
 
+# -- ring-framing mutants -----------------------------------------------------
+
+def ring_fuzz(cases: int = 200, seed: int = 11, flips: int = 4) -> dict:
+    """Mutation fuzz over the shm frame ring's byte layout.
+
+    Each case walks a ring through interleaved pushes/pops (so records
+    wrap the data region at arbitrary offsets), verifies clean FIFO
+    fidelity, then flips bits across the raw buffer -- the
+    ``[u64 head][u64 tail][u64 wseq]`` header words and the record
+    region alike -- and drains.  Every post-corruption ``pop()`` must
+    return the exact bytes of some record that was pushed, or raise
+    :class:`RingTear`; any other exception (a wild length driving an
+    allocation, a struct error) or any byte string that was never
+    pushed is a divergence."""
+    from collections import Counter
+
+    from ceph_tpu.msg.shm_ring import (_HDR_BYTES, RingTear, ShmRing)
+
+    rng = random.Random(seed ^ 0x51A6)
+    report: dict = {"cases": 0, "flips": 0, "pops_clean": 0,
+                    "pops_after_flip": 0, "tears": 0, "divergences": []}
+    for case in range(cases):
+        cap = 1 << rng.choice([10, 12, 14])
+        ring = ShmRing(cap)
+        fifo: List[bytes] = []
+        clean = True
+        # interleaved pushes/pops advance head/tail so the flips below
+        # land on wrapped records, consumed space and live space alike
+        for _ in range(rng.randrange(1, 40)):
+            p = rng.randbytes(rng.randrange(0, cap // 4))
+            if ring.try_push(p):
+                fifo.append(p)
+            if fifo and rng.random() < 0.5:
+                if ring.pop() != fifo.pop(0):
+                    report["divergences"].append({
+                        "case": case, "stage": "clean",
+                        "detail": "fifo fidelity broken without mutation"})
+                    clean = False
+                    break
+                report["pops_clean"] += 1
+        if not clean:
+            report["cases"] += 1
+            continue
+        for _ in range(flips):
+            if rng.random() < 0.4:
+                i = rng.randrange(_HDR_BYTES)  # head/tail/wseq words
+            else:
+                i = _HDR_BYTES + rng.randrange(ring.capacity)
+            ring._buf[i] ^= 1 << rng.randrange(8)
+            report["flips"] += 1
+        remaining = Counter(fifo)
+        for _ in range(len(fifo) + 8):  # bounded drain
+            try:
+                got = ring.pop()
+            except RingTear:
+                report["tears"] += 1
+                break
+            except Exception as e:  # noqa: BLE001 -- the property under
+                # test: corruption may only surface as RingTear
+                report["divergences"].append({
+                    "case": case, "stage": "mutated",
+                    "detail": f"pop raised {type(e).__name__}: {e}"})
+                break
+            if got is None:
+                break
+            if remaining[got] <= 0:
+                report["divergences"].append({
+                    "case": case, "stage": "mutated",
+                    "detail": f"pop returned {len(got)}B never pushed"})
+                break
+            remaining[got] -= 1
+            report["pops_after_flip"] += 1
+        report["cases"] += 1
+    report["ok"] = not report["divergences"]
+    return report
+
+
 # -- leak gate ----------------------------------------------------------------
 
 def _rss_kb() -> int:
@@ -398,7 +481,8 @@ def leak_gate(wire, nat, msgs: List[object], passes: int,
 # -- driver -------------------------------------------------------------------
 
 def run_fuzz(cases: int = 600, seed: int = 11, san: bool = False,
-             mutations: int = 4, leak_passes: int = 0) -> dict:
+             mutations: int = 4, leak_passes: int = 0,
+             ring_cases: int = 200) -> dict:
     from ceph_tpu.msg import wire
 
     nat = load_native(san=san)
@@ -429,8 +513,11 @@ def run_fuzz(cases: int = 600, seed: int = 11, san: bool = False,
     if leak_passes:
         report["leak_gate"] = leak_gate(
             wire, nat, msgs[:40], passes=leak_passes)
-    report["ok"] = not report["divergences"] and (
-        not leak_passes or report["leak_gate"]["flat"])
+    if ring_cases:
+        report["ring"] = ring_fuzz(cases=ring_cases, seed=seed)
+    report["ok"] = (not report["divergences"]
+                    and (not leak_passes or report["leak_gate"]["flat"])
+                    and (not ring_cases or report["ring"]["ok"]))
     return report
 
 
@@ -445,19 +532,26 @@ def main(argv=None) -> int:
                     help="mutants per corpus case (default 4)")
     ap.add_argument("--leak-passes", type=int, default=0,
                     help="arm the repeated-pass leak gate")
+    ap.add_argument("--ring-cases", type=int, default=200,
+                    help="shm-ring framing mutant cases (0 disables)")
     args = ap.parse_args(argv)
     report = run_fuzz(cases=args.cases, seed=args.seed, san=args.san,
                       mutations=args.mutations,
-                      leak_passes=args.leak_passes)
+                      leak_passes=args.leak_passes,
+                      ring_cases=args.ring_cases)
     json.dump(report, sys.stdout, indent=2)
     print(file=sys.stdout)
     status = "ok" if report["ok"] else "FAILED"
+    ring = report.get("ring")
     print(f"wire_fuzz: {status} -- {report['cases']} cases, "
           f"{report['mutants']} mutants, {report['fallbacks']} fallbacks, "
           f"{len(report['divergences'])} divergences"
           + (", leak gate "
              + ("flat" if report.get("leak_gate", {}).get("flat")
-                else "NOT FLAT") if args.leak_passes else ""),
+                else "NOT FLAT") if args.leak_passes else "")
+          + (f", ring {ring['cases']} cases/{ring['flips']} flips/"
+             f"{ring['tears']} tears "
+             + ("ok" if ring["ok"] else "DIVERGED") if ring else ""),
           file=sys.stderr)
     return 0 if report["ok"] else 1
 
