@@ -579,6 +579,25 @@ def main() -> int:
 
     rp_host = _staged("recovery_path_host", _recovery_path_host)
 
+    def _repair_path_host():
+        """Regenerating-code repair metric: rebuild a wiped OSD on a
+        product-matrix MSR pool (plugin 'regen', d = 2k-2) through the
+        beta-fractional repair lane vs the classic full-stripe gather
+        on the SAME pool -- survivors answer beta-sized helper symbols
+        (one fused GF matmul per sub-read message) and the replacement
+        shard regenerates in one fused dispatch.  Correctness-gated:
+        wipe -> degraded peak -> monotone drain -> clean in both modes,
+        bit-exact reads, byte-identical rebuilt stores across modes,
+        measured gather-bytes ratio <= 0.75 and time-to-clean no worse
+        (ceph_tpu/osd/repair_bench.py)."""
+        from ceph_tpu.osd.repair_bench import run_repair_path_bench
+
+        return run_repair_path_bench(
+            n_osds=8, n_objects=48, obj_bytes=24 << 10
+        )
+
+    rpr_host = _staged("repair_path_host", _repair_path_host)
+
     def _mesh_path_host():
         """Round-15 tentpole metric: the full TCP cluster path vs mesh
         shard count (osd_mesh_data_plane, ceph_tpu/parallel/
@@ -810,6 +829,13 @@ def main() -> int:
             rp_host["batched"]["counters"]["recovery_ops_batched"]
             if rp_host else None),
         "recovery_path_host": rp_host,
+        "repair_path_repair_bytes_ratio": (
+            rpr_host["repair_bytes_ratio"] if rpr_host else None),
+        "repair_path_time_to_clean_ratio": (
+            rpr_host["time_to_clean_ratio"] if rpr_host else None),
+        "repair_path_bytes_saved": (
+            rpr_host["bytes_saved"] if rpr_host else None),
+        "repair_path_host": rpr_host,
         "mesh_path_speedup_4x": (
             mp_host["speedup_4x"] if mp_host else None),
         "mesh_path_speedup_max": (

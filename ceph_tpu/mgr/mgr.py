@@ -209,6 +209,17 @@ def prometheus_text(state: dict) -> str:
         for name, s in sorted(state["osd_stats"].items()):
             lines.append(f'ceph_osd_{counter}{{ceph_daemon="{name}"}} '
                          f"{s['perf'].get(counter, 0)}")
+    # repair-bandwidth win of the sub-extent/regenerating gather
+    # (docs/ec-regenerating.md): classic-gather bytes minus what the
+    # coalescer actually read; flat-at-zero on pools whose codec only
+    # speaks whole-shard plans
+    lines += ["# HELP ceph_osd_recovery_bytes_saved_total gather bytes "
+              "avoided by sub-extent/regenerating repair plans",
+              "# TYPE ceph_osd_recovery_bytes_saved_total counter"]
+    for name, s in sorted(state["osd_stats"].items()):
+        lines.append(
+            f'ceph_osd_recovery_bytes_saved_total{{ceph_daemon="{name}"}} '
+            f"{s['perf'].get('recovery_bytes_saved', 0)}")
     # unified QoS admission (osd/qos.py, docs/qos.md): per-class
     # admitted ops/bytes and throttle waits (client classes counted per
     # op, recovery/scrub per batch), plus the load-generator-published

@@ -59,6 +59,9 @@ REPORTED_COUNTERS = frozenset({
     "read_crc_error", "deep_scrub", "snap_trim",
     "slow_ops", "cap_denied", "queued_client_op",
     "mesh_claim_miss", "pglog_rollback", "obj_versions_serve",
+    # regenerating-repair lane: beta-sized helper symbols computed by
+    # survivors (the repair-bandwidth story's survivor-side half)
+    "regen_helpers_served",
     # client-side Objecter counters (exported through the in-process
     # ClusterState client_perf block and any client-side scrape)
     "primary_failover", "write_conflict_retry", "client_inflight_hwm",

@@ -477,8 +477,10 @@ class TCPMessenger:
         """Ring-conduit accept: the colocated analogue of the
         ``asyncio.start_server`` callback -- same serve coroutine, ring
         stream adapters instead of sockets."""
-        asyncio.get_event_loop().create_task(
-            self._serve_connection(reader, writer))
+        self.adopt_task(
+            f"ring-accept.{id(reader)}",
+            asyncio.get_event_loop().create_task(
+                self._serve_connection(reader, writer)))
 
     async def shutdown(self) -> None:
         self._closing = True  # stops lossless reconnect loops

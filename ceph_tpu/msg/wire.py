@@ -132,6 +132,8 @@ def message_encoder(msg: object) -> Encoder:
         enc.value(list(msg.trace) if isinstance(
             msg.trace, (tuple, list)) else msg.trace)
         enc.value(msg.qos_class)
+        enc.value({k: [int(c) for c in v] for k, v in msg.regen.items()}
+                  if isinstance(msg.regen, dict) else msg.regen)
     elif isinstance(msg, ECSubReadReply):
         enc.u8(_MSG_EC_SUB_READ_REPLY)
         enc.varint(msg.from_shard).varint(msg.tid)
@@ -208,6 +210,9 @@ def decode_message(data: bytes) -> object:
             # cephlint: wire-optional -- pre-qos senders end at the
             # trace context
             qos_class=dec.value() if dec.remaining() else None,
+            # cephlint: wire-optional -- pre-regen senders end at the
+            # qos class
+            regen=dec.value() if dec.remaining() else None,
         )
     if kind == _MSG_EC_SUB_READ_REPLY:
         return ECSubReadReply(

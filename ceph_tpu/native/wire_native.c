@@ -90,7 +90,7 @@ static PyObject *s_from_shard, *s_tid, *s_oid, *s_transaction,
     *s_attrs_to_read, *s_subchunks, *s_buffers_read, *s_attrs_read,
     *s_errors, *s_name, *s_seq, *s_interval, *s_stats, *s_lag_ms,
     *s_ops, *s_op, *s_offset, *s_data, *s_attr_name, *s_attr_value,
-    *s_version, *s_prior_size, *s_parts, *s_crc;
+    *s_version, *s_prior_size, *s_parts, *s_crc, *s_regen;
 static PyObject *empty_tuple;
 
 /* -- output emitter -------------------------------------------------------- */
@@ -650,7 +650,8 @@ static int emit_body(Emit *e, PyObject *msg) {
         emit_attr_extent_map(e, msg, s_subchunks) < 0 ||
         emit_attr_string(e, msg, s_op_class) < 0 ||
         emit_attr_value_norm(e, msg, s_trace, WT_LIST) < 0 ||
-        emit_attr_value(e, msg, s_qos_class) < 0)
+        emit_attr_value(e, msg, s_qos_class) < 0 ||
+        emit_attr_value(e, msg, s_regen) < 0)
       return -1;
     return 0;
   }
@@ -1453,6 +1454,9 @@ static PyObject *decode_body_at(Dec *d) {
       if (d->pos < d->end) {
         if (kw_set(kw, s_qos_class, dec_value(d)) < 0) goto fail;
       }
+      if (d->pos < d->end) {
+        if (kw_set(kw, s_regen, dec_value(d)) < 0) goto fail;
+      }
       out = construct(cls_sub_read, kw);
       Py_DECREF(kw);
       return out;
@@ -1957,6 +1961,7 @@ PyMODINIT_FUNC PyInit__wire_native(void) {
   INTERN(s_to_read, "to_read");
   INTERN(s_attrs_to_read, "attrs_to_read");
   INTERN(s_subchunks, "subchunks");
+  INTERN(s_regen, "regen");
   INTERN(s_buffers_read, "buffers_read");
   INTERN(s_attrs_read, "attrs_read");
   INTERN(s_errors, "errors");

@@ -166,18 +166,22 @@ class BackgroundThrottle:
 
 async def batched_sub_reads(
     backend,
-    reads: List[Tuple[str, int, Dict[str, list], List[str]]],
+    reads: List[tuple],
     op_class: str,
     timeout: float,
 ) -> List[Optional[object]]:
-    """``reads``: (osd_name, from_shard, {oid: extents}, attrs_to_read)
-    per message.  Returns one ECSubReadReply (or None on loss/timeout)
-    per entry, in order."""
+    """``reads``: (osd_name, from_shard, {oid: extents}, attrs_to_read
+    [, regen]) per message -- the optional 5th element is the
+    regenerating-repair coefficient map ({oid: phi_f}) carried on the
+    ECSubRead wire field.  Returns one ECSubReadReply (or None on
+    loss/timeout) per entry, in order."""
     loop = asyncio.get_event_loop()
     wire_ctx = trace.current_wire()  # stitch into the batch span
     pend = []
     subs = []
-    for osd_name, s, to_read, attrs in reads:
+    for entry in reads:
+        osd_name, s, to_read, attrs = entry[:4]
+        regen = entry[4] if len(entry) > 4 else None
         tid = backend._new_tid()
         done = loop.create_future()
         backend._pending[tid] = {
@@ -188,7 +192,7 @@ async def batched_sub_reads(
             from_shard=s, tid=tid,
             to_read={oid: list(ext) for oid, ext in to_read.items()},
             attrs_to_read=list(attrs), op_class=op_class,
-            trace=wire_ctx,
+            trace=wire_ctx, regen=regen,
         )))
     await backend.messenger.send_messages(backend.name, subs)
     if pend:
@@ -354,9 +358,19 @@ class RecoveryCoalescer:
         share = batch_bytes // max(1, len(group)) // max(1, backend.k)
         win = max(cs, share // cs * cs)
 
+        # sub-chunk geometry: every codec's minimum_to_decode speaks
+        # (offset, count) plans over get_sub_chunk_count() sub-chunks;
+        # converting them to byte extents here means a fractional plan
+        # (regenerating codes, future Clay-style codecs) gathers ONLY
+        # the bytes the fused decode consumes
+        scc = max(1, int(getattr(backend.ec, "get_sub_chunk_count",
+                                 lambda: 1)()))
+        sub_bytes = win // scc if win % scc == 0 else 0
+
         plans: Dict[str, dict] = {}
         reads: Dict[Tuple[str, int], Dict[str, list]] = {}
         attr_reads: Dict[Tuple[str, int], Dict[str, list]] = {}
+        regen_maps: Dict[Tuple[str, int], Dict[str, list]] = {}
         for oid, jobs in group.items():
             acting = backend.acting_set(oid)
             want = sorted({s for s, _t, _rb in jobs})
@@ -365,14 +379,42 @@ class RecoveryCoalescer:
                 if s not in want and backend._shard_up(acting, s)
             ]
             try:
-                src = backend._min_sources(want, up)
+                mtd = backend.ec.minimum_to_decode(list(want), up)
             except Exception:  # noqa: BLE001 -- unassemblable right now
                 fall_back.add(oid)
                 continue
+            src = sorted(mtd.keys())
             plans[oid] = {"acting": acting, "want": want, "src": src}
-            for s in src:
-                key = (f"osd.{acting[s]}", s)
-                reads.setdefault(key, {})[oid] = [(0, win)]
+            # regenerating-repair lane: the codec advertises fractional
+            # repair AND handed back a sub-chunk plan strictly below a
+            # whole shard per helper -- the gather carries phi_f and the
+            # survivors reply beta-sized helper symbols (d * chunk/alpha
+            # bytes on the wire instead of k whole chunks)
+            fractional = (
+                bool(getattr(backend.ec, "fractional_repair", False))
+                and bool(cfg.get_val("osd_ec_fractional_repair"))
+                and len(want) == 1 and sub_bytes > 0
+                and all(sum(ln for _o, ln in ext) < scc
+                        for ext in mtd.values())
+                and not self._want_promote(oid, 1)
+            )
+            if fractional:
+                lost = want[0]
+                coeffs = backend.ec.repair_coeffs(lost)
+                plans[oid]["regen"] = {"lost": lost, "helpers": src,
+                                       "coeffs": coeffs}
+                for s in src:
+                    key = (f"osd.{acting[s]}", s)
+                    reads.setdefault(key, {})[oid] = [(0, sub_bytes)]
+                    regen_maps.setdefault(key, {})[oid] = coeffs
+            else:
+                for s in src:
+                    key = (f"osd.{acting[s]}", s)
+                    ext = mtd.get(s) or [(0, scc)]
+                    reads.setdefault(key, {})[oid] = [
+                        (off * sub_bytes, ln * sub_bytes)
+                        for off, ln in ext
+                    ] if sub_bytes and scc > 1 else [(0, win)]
             for s in up:
                 if s in src:
                     continue
@@ -383,7 +425,7 @@ class RecoveryCoalescer:
                 attr_reads.setdefault(key, {})[oid] = [(0, 0)]
 
         read_list = [
-            (osd, s, to_read, sorted(to_read))
+            (osd, s, to_read, sorted(to_read), regen_maps.get((osd, s)))
             for (osd, s), to_read in list(reads.items())
             + list(attr_reads.items())
         ]
@@ -392,12 +434,15 @@ class RecoveryCoalescer:
             backend, read_list, "recovery", timeout)
         trace.event("gather_done")
 
-        # collate per (oid, shard): chunks / versions / sizes / attrs
+        # collate per (oid, shard): chunks / helpers / versions / sizes
         per_oid: Dict[str, dict] = {
-            oid: {"chunks": {}, "versions": {}, "sizes": {}, "attrs": {}}
+            oid: {"chunks": {}, "helpers": {}, "versions": {},
+                  "sizes": {}, "attrs": {}}
             for oid in plans
         }
-        for (osd, s, to_read, _attrs), reply in zip(read_list, replies):
+        gather_bytes = 0
+        for (osd, s, to_read, _attrs, regen), reply in zip(
+                read_list, replies):
             if reply is None:
                 continue
             for oid in to_read:
@@ -406,8 +451,13 @@ class RecoveryCoalescer:
                 slot = per_oid[oid]
                 bufs = reply.buffers_read.get(oid)
                 if bufs and len(bufs[0][1]):
-                    slot["chunks"][s] = np.frombuffer(
-                        bufs[0][1], dtype=np.uint8)
+                    gather_bytes += sum(len(b) for _off, b in bufs)
+                    arr = np.frombuffer(bufs[0][1], dtype=np.uint8)
+                    if regen and oid in regen:
+                        # beta-sized helper symbol, not shard bytes
+                        slot["helpers"][s] = arr
+                    else:
+                        slot["chunks"][s] = arr
                 attrs = reply.attrs_read.get(oid) or {}
                 if attrs:
                     slot["attrs"][s] = attrs
@@ -415,14 +465,30 @@ class RecoveryCoalescer:
                         slot["sizes"][s] = attrs[SIZE_KEY]
                     slot["versions"][s] = vt(attrs.get(VERSION_KEY))
 
+        if gather_bytes:
+            backend.perf.inc("recovery_gather_bytes", gather_bytes)
+
         # -- per-object consistency election, then ONE fused decode ------
         maps: List[Dict[int, np.ndarray]] = []
         wants: List[List[int]] = []
         ready: List[str] = []
+        regen_groups: Dict[tuple, List[str]] = {}
         for oid, plan in plans.items():
             slot = per_oid[oid]
             if not slot["versions"]:
                 fall_back.add(oid)
+                continue
+            rg = plan.get("regen")
+            if rg is not None:
+                geom = self._elect_regen(plan, slot, win)
+                if geom is None:
+                    # stale/short/missing helper: the classic
+                    # full-gather path re-runs this object
+                    fall_back.add(oid)
+                elif geom[0]:
+                    key = (rg["lost"], tuple(rg["helpers"]), geom[1])
+                    regen_groups.setdefault(key, []).append(oid)
+                # chunk_total == 0 rides the attrs-only push below
                 continue
             target_v = max(slot["versions"].values())
             holders = [s for s, v in slot["versions"].items()
@@ -468,6 +534,32 @@ class RecoveryCoalescer:
             trace.event("decode_done")
         else:
             decoded = []
+
+        # -- fused regenerating dispatch ---------------------------------
+        # one device matmul per (lost shard, helper set, beta) signature:
+        # the d stacked helper symbols of EVERY object in the group ride
+        # a single batched repair-matrix apply
+        for (lost, helpers, beta), g_oids in regen_groups.items():
+            stacks = [
+                np.stack([per_oid[o]["helpers"][s] for s in helpers])
+                for o in g_oids
+            ]
+            try:
+                regenerated = backend.ec.regenerate_batch(
+                    lost, list(helpers), stacks)
+            except Exception:  # noqa: BLE001 -- refuse -> full gather
+                fall_back.update(g_oids)
+                continue
+            for o, shard in zip(g_oids, regenerated):
+                ready.append(o)
+                decoded.append({lost: shard})
+                # classic repair reads k whole chunks; this one read
+                # d beta-sized helper symbols
+                plans[o]["bytes_saved"] = (
+                    backend.k * plans[o]["chunk_total"]
+                    - len(helpers) * beta)
+        if regen_groups:
+            trace.event("regen_done")
 
         # -- corked multi-push burst --------------------------------------
         pushes: List[Tuple[str, ECSubWrite]] = []
@@ -516,6 +608,10 @@ class RecoveryCoalescer:
             backend.perf.inc("recover", len(ok_oids))
         if nbytes:
             backend.perf.inc("recovery_bytes", nbytes)
+        saved = sum(plans[o].get("bytes_saved", 0)
+                    for o in ok_oids if o in plans)
+        if saved > 0:
+            backend.perf.inc("recovery_bytes_saved", saved)
 
         # -- promote-on-recovery ------------------------------------------
         for oid in sorted(ok_oids):
@@ -534,6 +630,42 @@ class RecoveryCoalescer:
                     plan["size"], dirty=False, promote_from_recovery=True,
                 )
         return fall_back
+
+    def _elect_regen(self, plan: dict, slot: dict, win: int):
+        """Consistency election for one regenerating-repair object: ALL
+        d planned helpers must have answered at the authoritative
+        version with helper symbols spanning the FULL stored shard
+        (beta * alpha == chunk_total).  Returns (chunk_total, beta) or
+        None -- None sends the object back through the classic
+        full-gather path, never a partial regeneration."""
+        backend = self.backend
+        rg = plan["regen"]
+        target_v = max(slot["versions"].values())
+        holders = {s for s, v in slot["versions"].items()
+                   if v == target_v}
+        size = next((slot["sizes"][s] for s in sorted(holders)
+                     if slot["sizes"].get(s) is not None), None)
+        if size is None:
+            return None
+        plan["version"] = target_v
+        plan["size"] = size
+        plan["attrs"] = next(
+            (slot["attrs"][s] for s in sorted(holders)
+             if s in slot["attrs"]), {})
+        chunk_total = backend._shard_bytes_total(size)
+        plan["chunk_total"] = chunk_total
+        plan["have"] = {}
+        if chunk_total == 0:
+            return (0, 0)
+        alpha = max(1, int(getattr(backend.ec, "alpha", 1)))
+        if chunk_total % alpha or chunk_total > win:
+            return None
+        beta = chunk_total // alpha
+        hs = slot["helpers"]
+        if any(s not in holders or s not in hs or len(hs[s]) != beta
+               for s in rg["helpers"]):
+            return None
+        return (chunk_total, beta)
 
     def _want_promote(self, oid: str, logical: int) -> bool:
         """Promote-on-recovery predicate: writeback tier, toggle on,

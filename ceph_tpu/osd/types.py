@@ -174,6 +174,13 @@ class ECSubRead:
     #: originating client's QoS sub-class (see ECSubWrite.qos_class);
     #: trailing optional wire field
     qos_class: object = None
+    #: regenerating-code repair lane (plugins/regen.py): oid -> the
+    #: GF(2^8) helper coefficients (phi_f).  The serving shard does NOT
+    #: return raw extents for these oids -- it dots its own stored
+    #: sub-chunks with the coefficients and replies the beta-sized
+    #: helper symbol.  Trailing optional wire field, None for classic
+    #: extent reads / pre-regen senders.
+    regen: object = None
 
 
 @dataclasses.dataclass

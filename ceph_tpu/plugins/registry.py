@@ -46,6 +46,7 @@ _BUILTIN = {
     "shec": "ceph_tpu.plugins.shec",
     "lrc": "ceph_tpu.plugins.lrc",
     "tpu": "ceph_tpu.plugins.tpu",
+    "regen": "ceph_tpu.plugins.regen",
     "example": "ceph_tpu.plugins.example",
 }
 

@@ -133,6 +133,13 @@ OPTIONS: Dict[str, Option] = {
              "whose shards exceed the per-object share falls back to "
              "the windowed per-object path (bounded primary memory)",
              see_also=("osd_recovery_batched",)),
+        _opt("osd_ec_fractional_repair", bool, True, LEVEL_ADVANCED,
+             "let fractional-repair codecs (regenerating codes, plugin "
+             "'regen') rebuild a single lost shard from beta-sized "
+             "helper symbols instead of k whole chunks.  False forces "
+             "the classic full-stripe gather (kept as the repair-path "
+             "bench baseline)",
+             see_also=("osd_recovery_batched",)),
         _opt("osd_recovery_sleep", float, 0.0, LEVEL_ADVANCED,
              "seconds of awaited pacing between background recovery/"
              "scrub batches (the osd_recovery_sleep role); 0 still "

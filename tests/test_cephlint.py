@@ -49,6 +49,10 @@ FIXTURES = {
     # PR-15 mesh data plane: placement objects built once, cached
     "jax_percall_sharding_construction.py":
         "ceph_tpu/parallel/_fixture_sharding.py",
+    # regenerating-repair lane: phi_f / R_f upload once per signature,
+    # mesh slot placement built at plane construction
+    "jax_regen_repair_dispatch.py":
+        "ceph_tpu/plugins/_fixture_regen_dispatch.py",
     "ceph_config_undeclared.py": None,
     # PR-18 wire-fed telemetry: every counter must reach the report
     # schema / exposition (or carry a justified disable)

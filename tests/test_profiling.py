@@ -436,11 +436,14 @@ def test_wire_tax_bench_smoke():
     result = run_wire_tax_bench(
         _ec(2, 1), n_objects=6, obj_bytes=2048, writers=3, iters=1,
         coverage_min_pct=30.0, overhead_limit_pct=100.0, retries=1,
-        # the codec A/B rides along with its gates effectively open:
-        # at this tiny shape the gain/share ratios are noise -- the
-        # real 1.5x/0.5 gates run at the saturated bench shape
-        # (bench.py wire_tax_host) and in test_wire_native.py
-        codec_gain_min=0.0, codec_share_ratio_max=100.0)
+        # the codec and osd-exec A/Bs ride along with their gates
+        # effectively open: at this tiny shape the gain/share ratios
+        # are noise -- the real 1.5x/0.5/0.6 gates run at the
+        # saturated bench shape (bench.py wire_tax_host) and in
+        # test_wire_native.py; the tool's own --smoke arm opens the
+        # same gates for the same reason
+        codec_gain_min=0.0, codec_share_ratio_max=100.0,
+        osd_share_ratio_max=100.0, ring_gain_min=0.0)
     assert result["wire_tax_alloc_blocks_off"] == 0
     assert result["wire_tax_coverage_pct"] >= 30.0
     assert result["wire_tax_ops_per_sec"] > 0

@@ -130,6 +130,14 @@ if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
     JAX_PLATFORMS=cpu python -m ceph_tpu.mgr.telemetry_bench \
         --vstart-smoke > /dev/null
     echo "cephlint: wire-fed telemetry health smoke passed" >&2
+    # repair-path smoke: regenerating-code repair on a product-matrix
+    # MSR pool (plugin regen) -- chaos drain, bit-exactness,
+    # cross-mode shard bytes, gather ratio <= 0.75 and time-to-clean
+    # no worse all stay armed at smoke shape; any violation exits
+    # nonzero
+    JAX_PLATFORMS=cpu python tools/ec_benchmark.py \
+        --workload repair-path --smoke > /dev/null
+    echo "cephlint: regenerating repair-path smoke passed" >&2
     # multichip dryrun on simulated devices: jax_num_cpu_devices where
     # the jax supports it, the XLA_FLAGS device-count override otherwise
     JAX_PLATFORMS=cpu \

@@ -39,13 +39,14 @@ def parse_args(argv):
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
-                            "recovery-path", "mesh-path", "trace-path",
+                            "recovery-path", "repair-path", "mesh-path",
+                            "trace-path",
                             "qos-path", "telemetry-path", "wire-tax"])
     p.add_argument("--smoke", action="store_true",
-                   help="qos-path/telemetry-path: the fast CI shape "
-                        "(shrunk client counts and durations, loose "
-                        "overhead limits) instead of the full "
-                        "acceptance run")
+                   help="qos-path/telemetry-path/repair-path: the "
+                        "fast CI shape (shrunk client counts, object "
+                        "counts and durations, loose overhead limits) "
+                        "instead of the full acceptance run")
     p.add_argument("--stages", default=None,
                    choices=["overload", "chaos", "scale"],
                    help="qos-path only: run a single sub-stage")
@@ -261,6 +262,40 @@ def main(argv=None) -> int:
             f"{result['wire_tax_alloc_blocks_off']}, native-codec "
             f"gain {result.get('wire_codec_gain')}x at share ratio "
             f"{result.get('wire_codec_share_ratio')}); top: {top}",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "repair-path":
+        # Regenerating-repair stage: rebuild a wiped OSD on a
+        # product-matrix MSR pool (plugin regen, k=4 m=3, d=2k-2=6)
+        # through the beta-fractional repair lane vs the classic
+        # full-stripe gather on the SAME pool.  Chaos sequence
+        # (wipe -> degraded peak -> monotone drain -> clean),
+        # bit-exactness, cross-mode shard bytes, measured
+        # gather-bytes ratio <= 0.75 and time-to-clean no worse are
+        # all gated before any number is printed.  Prints one JSON
+        # line (the shape bench.py records as repair_path_*);
+        # --smoke runs the tiny CI shape.
+        import json
+
+        from ceph_tpu.osd.repair_bench import run_repair_path_bench
+
+        if args.smoke:
+            result = run_repair_path_bench(
+                n_osds=8, n_objects=8, obj_bytes=6 << 10)
+        else:
+            result = run_repair_path_bench(
+                n_objects=args.objects, obj_bytes=args.size)
+        print(json.dumps(result))
+        print(
+            f"repair-path {result['n_objects']}x{result['obj_bytes']}B:"
+            f" gather ratio {result['repair_bytes_ratio']} "
+            f"(gate 0.75), time-to-clean ratio "
+            f"{result['time_to_clean_ratio']}, "
+            f"{result['bytes_saved']} repair bytes saved, "
+            f"{result['fractional']['counters']['regen_helpers_served']}"
+            " helper symbols served",
             file=sys.stderr,
         )
         return 0
