@@ -598,6 +598,24 @@ def main() -> int:
 
     rpr_host = _staged("repair_path_host", _repair_path_host)
 
+    def _elastic_path_host():
+        """Elastic-membership metric: +2-OSD online expansion under
+        sustained client load -- mon osd_add incrementals, minimal-
+        movement CRUSH re-placement, misplaced census drained by the
+        relocation-aware backfill lane -- followed by three chaos arms
+        on the SAME cluster (kill the backfill target mid-migration,
+        rm a live primary under load, add-then-immediately-rm
+        flapping).  Correctness-gated: bytes moved <= 1.25x the
+        theoretical minimum, misplaced peak -> monotone drain (<= 2
+        upticks) -> HEALTH_OK per stage, bit-exact reads, exactly-once
+        write audit, zero client-visible errors
+        (ceph_tpu/osd/elastic_bench.py)."""
+        from ceph_tpu.osd.elastic_bench import run_elastic_path_bench
+
+        return run_elastic_path_bench()
+
+    el_host = _staged("elastic_path_host", _elastic_path_host)
+
     def _mesh_path_host():
         """Round-15 tentpole metric: the full TCP cluster path vs mesh
         shard count (osd_mesh_data_plane, ceph_tpu/parallel/
@@ -836,6 +854,13 @@ def main() -> int:
         "repair_path_bytes_saved": (
             rpr_host["bytes_saved"] if rpr_host else None),
         "repair_path_host": rpr_host,
+        "elastic_path_data_moved_ratio": (
+            el_host["data_moved_ratio"] if el_host else None),
+        "elastic_path_time_to_clean_s": (
+            el_host["time_to_clean_s"] if el_host else None),
+        "elastic_path_client_p99_during_expansion_ms": (
+            el_host["client_p99_during_expansion_ms"] if el_host else None),
+        "elastic_path_host": el_host,
         "mesh_path_speedup_4x": (
             mp_host["speedup_4x"] if mp_host else None),
         "mesh_path_speedup_max": (

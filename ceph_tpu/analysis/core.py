@@ -71,6 +71,7 @@ def all_rules() -> Dict[str, Rule]:
     from ceph_tpu.analysis import rules_interleave  # noqa: F401
     from ceph_tpu.analysis import rules_jax  # noqa: F401
     from ceph_tpu.analysis import rules_native  # noqa: F401
+    from ceph_tpu.analysis import rules_osdmap  # noqa: F401
     from ceph_tpu.analysis import rules_perf  # noqa: F401
     from ceph_tpu.analysis import rules_profile  # noqa: F401
     from ceph_tpu.analysis import rules_residency  # noqa: F401

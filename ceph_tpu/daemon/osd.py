@@ -90,14 +90,24 @@ async def _mon_integrate(args, shard, messenger, addr_map,
                 ec = registry_mod.instance().factory(plugin, profile)
                 km = ec.get_chunk_count()
             placement = CrushPlacement(n_osds, km, hosts=p.get("hosts"))
-            for osd_s, w in m["weights"].items():
-                placement.weights[int(osd_s)] = w
+            # seed the fresh placement through the shared gate (fresh
+            # view state, so the current epoch applies): weight pushes
+            # AND elastic map growth stay in one place -- a raw
+            # weights[] loop here IndexError'd on ids past n_osds
+            apply_map_view(m, {}, None, placements=[placement])
             hosted = shard.host_pool(
                 pname, ec, n_osds, placement,
                 pool_type=p.get("pool_type", "erasure"),
                 size=km, min_size=p.get("min_size") or None,
             )
             hosted.tier_mode = p.get("cache_mode", "none")
+        # elastic growth: widen every hosted engine's membership view
+        # to the map's max_osd, so peering enumerates osds that joined
+        # after boot (ids the addr map doesn't name yet read as down
+        # on the messenger until their daemon actually connects)
+        for b in shard.pools.values():
+            for j in range(len(b.osds), int(m.get("max_osd", 0))):
+                b.osds.append(j)
         shard.request_peering()  # re-peer on every map epoch
 
     async def mon_hook(src, msg):
@@ -128,9 +138,15 @@ async def _mon_integrate(args, shard, messenger, addr_map,
         # CACHED connection (the review found per-round probe() cycling
         # every peer's TCP connection); the expensive probe runs only to
         # CONFIRM a peer whose pongs went silent past the grace.
-        peers = [j for j in range(n_osds) if f"osd.{j}" != name]
+        # membership follows the map: added osds join the ping rounds,
+        # removed ones drop out (a boot-frozen list would report a
+        # removed id as failed forever)
+        def current_peers():
+            ids = sorted(state["up"]) if state["up"] else range(n_osds)
+            return [j for j in ids if f"osd.{j}" != name]
+
         start = loop.time()
-        for j in peers:  # never-ponged peers age from loop start
+        for j in current_peers():  # never-ponged peers age from start
             shard.hb_pongs.setdefault(f"osd.{j}", start)
         # budget-bounded fan-out (async-unbounded-fanout): the gathered
         # ping round holds at most this many coroutines in flight no
@@ -161,6 +177,10 @@ async def _mon_integrate(args, shard, messenger, addr_map,
             cfg = get_config()
             await asyncio.sleep(float(cfg.get_val("osd_heartbeat_interval")))
             grace = float(cfg.get_val("osd_heartbeat_grace"))
+            peers = current_peers()
+            now0 = loop.time()
+            for j in peers:  # a just-added peer ages from this round
+                shard.hb_pongs.setdefault(f"osd.{j}", now0)
             await asyncio.gather(*(ping_one(j) for j in peers))
             now = loop.time()
             suspects = [

@@ -11,7 +11,13 @@ QoS class), and a chaos set running CONCURRENTLY with the load:
   semantics) and the round-14 batched background plane rebuilds it
   under load, admitted through the unified QoS layer;
 * ``promote`` -- pools run in writeback tier mode, so hot objects
-  promote into the device tier during the run (tier ticks).
+  promote into the device tier during the run (tier ticks);
+* ``churn``   -- elastic membership under load (docs/elasticity.md): a
+  victim OSD is weighted OUT of CRUSH mid-run while its daemon keeps
+  serving -- data drains off through the placement-epoch-skew backfill
+  on the peering tick -- then weighted back IN, migrating everything
+  home again.  Both remaps run concurrently with the client load and
+  the exactly-once audit.
 
 Scale machinery: thousands of Objecters multiplex over a handful of
 client-hub messengers via the ``<name>@<hub>`` entity aliasing
@@ -109,6 +115,8 @@ class ScenarioResult:
     degraded_final: int = 0
     degraded_monotonic_violations: int = 0
     health_final: str = ""
+    #: chaos=churn: CRUSH weight flips applied mid-run (out + back in)
+    churn_events: int = 0
 
     def to_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -178,6 +186,7 @@ class ScenarioRunner:
         self._client_groups: List[Tuple[ClientGroup, List[LoadClient]]] = []
         self.kills = 0
         self.wipes = 0
+        self._churn_events = 0
         self._prior_cfg: Dict[str, object] = {}
         self._rng = random.Random(scenario.seed)
         self.perf = None
@@ -384,10 +393,13 @@ class ScenarioRunner:
         duration = self.scenario.duration_s
         thrash = "thrash" in self.scenario.chaos
         rebuild = "rebuild" in self.scenario.chaos
+        churn = "churn" in self.scenario.chaos
         loop = asyncio.get_event_loop()
         t0 = loop.time()
         wiped = False
         down: Optional[int] = None
+        churn_out: Optional[int] = None
+        churn_done = False
         while not stop.is_set():
             try:
                 await asyncio.wait_for(stop.wait(),
@@ -400,6 +412,22 @@ class ScenarioRunner:
                 self._wipe_osd(self._rng.randrange(self.n_osds))
                 wiped = True
                 continue
+            if churn and not churn_done:
+                if churn_out is None and elapsed >= duration / 4:
+                    # elastic membership drain (docs/elasticity.md):
+                    # weight the victim OUT of CRUSH while its daemon
+                    # keeps serving; every engine's next peering tick
+                    # sees the epoch skew and backfills the remap
+                    churn_out = self._rng.randrange(self.n_osds)
+                    self.placement.mark_out(churn_out)
+                    self._churn_events += 1
+                    continue
+                if churn_out is not None and elapsed >= duration * 0.6:
+                    self.placement.mark_in(churn_out)
+                    self._churn_events += 1
+                    churn_out = None
+                    churn_done = True
+                    continue
             if not thrash:
                 continue
             if down is not None:
@@ -413,6 +441,11 @@ class ScenarioRunner:
                 await self._kill_osd(down)
         if down is not None:
             await self._revive_osd(down)
+        if churn_out is not None:
+            # never leave the victim weighted out past the run: the
+            # settle window needs the full width for the audit
+            self.placement.mark_in(churn_out)
+            self._churn_events += 1
 
     # -- the run ------------------------------------------------------------
 
@@ -567,6 +600,7 @@ class ScenarioRunner:
             degraded_monotonic_violations=violations,
             health_final=(self.mgr.pgmap.health()["status"]
                           if self.mgr is not None else ""),
+            churn_events=self._churn_events,
         )
 
     async def _audit_exactly_once(self) -> Tuple[int, int, int]:

@@ -507,6 +507,21 @@ class PGMap:
             f"ceph_recovery_bytes_per_sec "
             f"{io['recovery_bytes_per_sec']}",
         ]
+        # elastic-membership migration traffic: bytes re-pushed because
+        # the copy sat on a non-acting osd (expansion/contraction
+        # backfill), distinct from rebuild bytes after data loss
+        lines += [
+            "# HELP ceph_osd_backfill_bytes_moved_total bytes migrated "
+            "by backfill to re-placed acting positions (wire-fed)",
+            "# TYPE ceph_osd_backfill_bytes_moved_total counter",
+        ]
+        for name, d in sorted(self.daemons.items()):
+            moved = (d.stats.get("perf") or {}).get(
+                "recovery_backfill_bytes")
+            if isinstance(moved, (int, float)):
+                lines.append(
+                    f'ceph_osd_backfill_bytes_moved_total{{'
+                    f'ceph_daemon="{name}"}} {moved}')
         # per-daemon perf counters, flattened (the report-schema slice)
         lines += ["# HELP ceph_osd_perf per-daemon perf counters "
                   "(report-schema slice)",

@@ -799,7 +799,40 @@ class Monitor:
             return (0, self.fsmap.to_dict()) if ok else (-11, "no quorum")
         if prefix == "fs dump":
             return 0, self.fsmap.to_dict()
+        if prefix == "osd add":
+            # elastic expansion (reference `osd new`, OSDMonitor.cc
+            # prepare_command_osd_new): one new id enters the map up+in;
+            # the epoch bump broadcasts and subscribers grow their
+            # placements through apply_map_view
+            osd = int(cmd["osd"])
+            if osd in self.osdmap.up:
+                return -17, f"osd.{osd} already exists"  # EEXIST
+            inc = {"op": "osd_add", "osd": osd}
+            if "weight" in cmd:
+                from ceph_tpu.crush.map import weight_fp
+
+                inc["weight"] = weight_fp(cmd["weight"])  # float -> 16.16
+            ok = await self._propose(inc)
+            return (0, {"epoch": self.osdmap.epoch}) if ok \
+                else (-11, "no quorum")
+        if prefix == "osd rm":
+            # elastic contraction; refuse to drop any pool below its
+            # mappable floor (registry-validation parity: same EBUSY
+            # shape as profile-in-use)
+            osd = int(cmd["osd"])
+            if osd not in self.osdmap.up:
+                return -2, f"osd.{osd} does not exist"  # ENOENT
+            blocked = self._min_size_block(osd)
+            if blocked:
+                return -16, blocked  # EBUSY
+            ok = await self._propose({"op": "osd_rm", "osd": osd})
+            return (0, {"epoch": self.osdmap.epoch}) if ok \
+                else (-11, "no quorum")
         if prefix in ("osd out", "osd in", "osd down", "osd up"):
+            if prefix == "osd out":
+                blocked = self._min_size_block(int(cmd["osd"]))
+                if blocked:
+                    return -16, blocked  # EBUSY
             inc = {"op": f"osd_{prefix.split()[1]}", "osd": cmd["osd"]}
             if prefix == "osd in" and "weight" in cmd:
                 from ceph_tpu.crush.map import weight_fp
@@ -808,6 +841,23 @@ class Monitor:
             ok = await self._propose(inc)
             return (0, "") if ok else (-11, "no quorum")
         return -38, f"unknown command {prefix}"  # ENOSYS
+
+    def _min_size_block(self, victim: int) -> Optional[str]:
+        """Would taking ``victim`` out of the data plane drop any pool's
+        mappable positions below min_size?  Returns the refusal message
+        (EBUSY text) or None when safe."""
+        survivors = sum(
+            1 for o, w in self.osdmap.weights.items()
+            if w > 0 and o != victim
+        )
+        for pool in self.osdmap.pools.values():
+            need = pool.min_size or (pool.k + pool.m if pool.k else pool.size)
+            if survivors < need:
+                return (
+                    f"removing osd.{victim} would leave {survivors} "
+                    f"mappable osds < min_size {need} for pool {pool.name}"
+                )
+        return None
 
 
 class MonClient:

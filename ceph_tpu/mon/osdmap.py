@@ -60,8 +60,20 @@ def apply_map_view(m: dict, state: dict, messenger=None, placements=(),
     for placement in placements:
         if placement is None:
             continue
-        for osd_s, w in m["weights"].items():
-            placement.weights[int(osd_s)] = w
+        broadcast = {int(k): w for k, w in m["weights"].items()}
+        for osd_id, w in sorted(broadcast.items()):
+            # elastic growth: a weight for an id the placement has never
+            # seen grows the crush map first (a fixed-size assignment
+            # here IndexError'd every subscriber on the first osd_add)
+            if osd_id >= len(placement.weights):
+                placement.ensure_osd(osd_id, w)
+            else:
+                placement.weights[osd_id] = w
+        # an id the mon dropped from the map (osd_rm) no longer
+        # broadcasts a weight: zero it so CRUSH remaps away
+        for osd_id in range(len(placement.weights)):
+            if osd_id not in broadcast:
+                placement.weights[osd_id] = 0
         placement.epoch += 1  # invalidate pg cache
     return True
 
@@ -133,6 +145,23 @@ class OSDMap:
             self.weights[inc["osd"]] = 0
         elif op == "osd_in":
             self.weights[inc["osd"]] = inc.get("weight", 0x10000)
+        elif op == "osd_add":
+            # elastic expansion: one new device, up + weighted in
+            osd = inc["osd"]
+            if osd in self.up:
+                raise ValueError(f"osd_add for existing osd {osd}")
+            self.up[osd] = True
+            self.weights[osd] = inc.get("weight", 0x10000)
+            self.max_osd = max(self.max_osd, osd + 1)
+        elif op == "osd_rm":
+            # elastic contraction: the id leaves the map entirely;
+            # subscribers zero any weight for ids absent from the
+            # broadcast (apply_map_view), so CRUSH remaps away
+            osd = inc["osd"]
+            if osd not in self.up:
+                raise ValueError(f"osd_rm for unknown osd {osd}")
+            self.up.pop(osd, None)
+            self.weights.pop(osd, None)
         elif op == "profile_set":
             self.ec_profiles[inc["name"]] = dict(inc["profile"])
         elif op == "profile_rm":

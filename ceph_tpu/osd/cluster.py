@@ -42,6 +42,10 @@ class ECCluster:
         min_size: Optional[int] = None,
     ):
         self.messenger = Messenger(fault)
+        # kept for elastic add_osd: new daemons clone the boot shape
+        self._op_queue = op_queue
+        self._objectstore = objectstore
+        self._data_path = data_path
         self.osds: List[OSDShard] = [
             OSDShard(i, self.messenger, op_queue=op_queue,
                      objectstore=objectstore, data_path=data_path)
@@ -282,6 +286,43 @@ class ECCluster:
             if primary is not None:
                 primary.pg_stats.note_down_victims(reason, [base])
 
+    def note_remap(self, before: Dict[int, list]) -> None:
+        """Event-time misplaced census after a CRUSH change (the
+        round-18 discipline: account where the event happens, never at
+        scrape time).  ``before`` is the default pool's pg->acting
+        snapshot taken BEFORE the map mutated; every stored object whose
+        pg moved is marked misplaced on its (new) primary, so the
+        misplaced peak is visible the moment the map commits and drains
+        monotonically as backfill completes."""
+        if self.placement is None:
+            return
+        from ceph_tpu.osd.placement import movement_plan
+        from ceph_tpu.osd.pg import POOL_KEY
+
+        moved_pgs = {
+            pg for pg, _pos, _src, _dst
+            in movement_plan(before, self.placement.pg_actings())
+        }
+        if not moved_pgs:
+            return
+        seen: set = set()
+        for osd in self.osds:
+            if self.messenger.is_down(osd.name):
+                continue
+            for stored in osd.store.list_objects():
+                base, _, tag = stored.rpartition("@")
+                if not base or tag == "meta" or base in seen:
+                    continue
+                ptag = osd.store.getattr(stored, POOL_KEY)
+                if ptag is not None and ptag != self.pool:
+                    continue  # other pools have their own placements
+                if self.placement.pg_of(base) not in moved_pgs:
+                    continue
+                seen.add(base)
+                primary = self._primary_backend_for(self.pool, base)
+                if primary is not None:
+                    primary.pg_stats.misplaced.add(base)
+
     def kill_osd(self, osd_id: int) -> None:
         self.messenger.mark_down(f"osd.{osd_id}")
         self._mark_down_victims(osd_id, f"osd.{osd_id}")
@@ -330,12 +371,77 @@ class ECCluster:
     def out_osd(self, osd_id: int) -> None:
         """Mark an OSD out: CRUSH remaps its shards (weight -> 0)."""
         if self.placement is not None:
+            before = self.placement.pg_actings()
             self.placement.mark_out(osd_id)
+            self.note_remap(before)
         self._notify_peering()
 
     def in_osd(self, osd_id: int, weight: float = 1.0) -> None:
         if self.placement is not None:
+            before = self.placement.pg_actings()
             self.placement.mark_in(osd_id, weight)
+            self.note_remap(before)
+        self._notify_peering()
+
+    # -- elastic membership (online add/remove) ----------------------------
+
+    def add_osd(self, weight: float = 1.0,
+                update_placement: bool = True) -> int:
+        """Online expansion: spawn a new OSD daemon, host every existing
+        pool on it, and widen every engine's membership view -- all while
+        the cluster keeps serving.  With ``update_placement`` the shared
+        CRUSH map grows and the osd weights in immediately (harness
+        mode); mon-backed clusters pass False and let the ``osd add``
+        broadcast drive placement growth through apply_map_view's epoch
+        gate, so data only moves once the committed map says so."""
+        new_id = len(self.osds)
+        shard = OSDShard(
+            new_id, self.messenger, op_queue=self._op_queue,
+            objectstore=self._objectstore, data_path=self._data_path,
+        )
+        # engines first, membership second: peering must never route to
+        # an id whose daemon has no engine for the pool yet
+        template = self.osds[0]
+        for pool_name, b in template.pools.items():
+            ec = getattr(b, "ec", None)
+            if ec is not None:
+                shard.host_pool(pool_name, ec, new_id + 1, b.placement,
+                                pool_type="erasure", size=b.km,
+                                min_size=b.min_size)
+            else:
+                shard.host_pool(pool_name, None, new_id + 1, b.placement,
+                                pool_type="replicated", size=b.size,
+                                min_size=b.min_size)
+            shard.pools[pool_name].tier_mode = b.tier_mode
+        self.osds.append(shard)
+        for osd in self.osds[:-1]:
+            for b in osd.pools.values():
+                if new_id not in b.osds:
+                    b.osds.append(new_id)
+        self.backend.n_osds = len(self.osds)
+        if update_placement and self.placement is not None:
+            before = self.placement.pg_actings()
+            self.placement.add_osd(new_id, weight)
+            self.note_remap(before)
+        self._notify_peering()
+        return new_id
+
+    def drain_osd(self, osd_id: int) -> None:
+        """Begin graceful contraction: the osd's CRUSH weight drops to 0
+        so every PG it serves remaps (primaries hand off first in map
+        order); data migrates off via backfill while the daemon keeps
+        answering, so clients never see its departure."""
+        if self.placement is not None:
+            before = self.placement.pg_actings()
+            self.placement.remove_osd(osd_id)
+            self.note_remap(before)
+        self._notify_peering()
+
+    def retire_osd(self, osd_id: int) -> None:
+        """Final departure of a DRAINED osd: mark it down without the
+        degraded census kill_osd runs -- its acting positions were
+        already handed off, so nothing it still stores is load-bearing."""
+        self.messenger.mark_down(f"osd.{osd_id}")
         self._notify_peering()
 
     # -- monitor-backed cluster (mon quorum owns the osdmap) ---------------
@@ -385,10 +491,15 @@ class ECCluster:
             if msg.get("type") == "osdmap" and backend.placement is not None:
                 from ceph_tpu.mon.osdmap import apply_map_view
 
+                # pg->acting snapshot BEFORE the epoch applies: if the
+                # map moved acting sets, the diff drives the event-time
+                # misplaced census (O(changes) accounting)
+                before = backend.placement.pg_actings()
                 # messenger=None: the in-process harness owns its own
                 # liveness view (kill_osd/revive_osd mark it directly)
                 if apply_map_view(msg["map"], map_state, None,
                                   placements=[backend.placement]):
+                    self.note_remap(before)
                     self._notify_peering()  # re-peer on every map epoch
         backend.mon_hook = mon_hook
         full_profile = dict(profile)

@@ -375,6 +375,20 @@ class Objecter:
                 reqid = self._new_reqid()
                 self.perf.inc("write_conflict_retry")
                 continue
+            if (self._primary_abs(oid) != primary
+                    or self.messenger.is_down(primary)):
+                # the serving primary LOST its role mid-op (died, or the
+                # map moved the object away): its error was computed
+                # against a stale acting view and is not authoritative.
+                # Re-dispatch to the current primary -- same reqid, so a
+                # shard that already applied the op answers from its
+                # dup entries (the reference resends in-flight ops on
+                # every osdmap epoch change, Objecter::handle_osd_map).
+                remain = deadline - loop.time()
+                if remain > 0:
+                    resends += 1
+                    self.perf.inc("op_resend_stale_primary")
+                    continue
             exc = _EXCEPTIONS.get(etype, IOError)
             raise exc(reply.get("error", f"{kind} {oid} failed"))
 

@@ -242,6 +242,12 @@ class PG:
         # CRUSH placement engine (ceph_tpu.osd.placement.CrushPlacement);
         # None falls back to the seeded-permutation CRUSH-lite below.
         self.placement = placement
+        #: placement epoch this engine last peered against: a CRUSH
+        #: change (osd add/rm/out/reweight) moves acting sets without
+        #: writing any pg log, so delta peering alone would never
+        #: discover the re-placed (misplaced) objects -- an epoch skew
+        #: forces the backfill scan exactly once per map change
+        self._placement_epoch = getattr(placement, "epoch", None)
         # -- delta peering state (pg_missing_t / peer_info roles) ----------
         #: last log sequence processed per peer OSD; a peer whose head
         #: equals its watermark contributes zero peering traffic
@@ -1539,7 +1545,8 @@ class PG:
             float(get_config().get_val("osd_recovery_sleep")))
 
     async def recover_shard(
-        self, oid: str, shard: int, target_osd: int, rollback: bool = False
+        self, oid: str, shard: int, target_osd: int, rollback: bool = False,
+        sources: Optional[Dict[int, int]] = None,
     ) -> None:
         """Reconstruct one lost/stale shard and push it to the target OSD
         in bounded windows (the READING->WRITING recovery state machine,
@@ -1555,14 +1562,23 @@ class PG:
         restarting it forever (the reference pins the object context for
         the duration of the push, src/osd/ECBackend.cc:535-700).  The
         version-moved restart loop remains as a safety net for writes
-        from a racing primary, which does not share this lock."""
+        from a racing primary, which does not share this lock.
+
+        ``sources`` maps shard position -> OSD id holding that shard's
+        authoritative copy on a NON-acting OSD (a remap leftover): the
+        gather reads those positions from the named holders instead of
+        the acting slots.  This is the backfill/relocation data path of
+        elastic membership -- after a CRUSH remap the acting set may
+        hold fewer than k shards, so reconstruction must read from
+        wherever the copies actually are."""
         from ceph_tpu.utils.config import get_config
 
         window = max(1, int(get_config().get_val("osd_recovery_max_chunk")))
         async with self._object_lock(oid):
             for attempt in range(3):
                 if await self._recover_shard_once(
-                    oid, shard, target_osd, window, rollback
+                    oid, shard, target_osd, window, rollback,
+                    sources=sources,
                 ):
                     self.perf.inc("recover")
                     return
@@ -1573,15 +1589,21 @@ class PG:
 
     async def _recover_shard_once(
         self, oid: str, shard: int, target_osd: int, window: int,
-        rollback: bool,
+        rollback: bool, sources: Optional[Dict[int, int]] = None,
     ) -> bool:
         """One windowed recovery attempt; False = restart (the object's
         version moved under us)."""
         acting = self.acting_set(oid)
+        if sources:
+            # relocation gather: read positions from the remap-leftover
+            # holders, not the (possibly empty) acting slots
+            acting = list(acting)
+            for pos, holder in sources.items():
+                acting[pos] = holder
         up_shards = [
             s
             for s in range(self.km)
-            if s != shard
+            if (s != shard or (sources and s in sources))
             and self._shard_up(acting, s)
         ]
         src = self._min_sources([shard], up_shards)
@@ -1648,6 +1670,11 @@ class PG:
                 {f"osd.{target_osd}"}, min_acks=1,
             )
             self.perf.inc("recover_window")
+            if sources:
+                # relocation pushes are backfill data movement by
+                # definition: account them for the elastic bench's
+                # data-moved gate
+                self.perf.inc("recovery_backfill_bytes", len(piece))
             if last:
                 return True
             await self._recovery_pace()
@@ -1704,6 +1731,27 @@ class PG:
         # leftovers roll back / get removed (the reference rolls back
         # divergent log entries the same way).
         return (0, "")
+
+    def _remap_sources(
+        self, shardmap: Dict[int, Dict[str, tuple]], reporting,
+    ) -> Tuple[Optional[tuple], Dict[int, int]]:
+        """Newest version visible on any up holder and, per shard
+        position, one up holder of that version -- the read-source map
+        for remap relocation.  Holders that stopped reporting are
+        excluded (their copies cannot be read)."""
+        vstar = None
+        for holders in shardmap.values():
+            for holder, v in holders.items():
+                if holder in reporting and (vstar is None or v > vstar):
+                    vstar = v
+        src: Dict[int, int] = {}
+        if vstar is not None:
+            for s, holders in shardmap.items():
+                for holder in sorted(holders):
+                    if holder in reporting and holders[holder] == vstar:
+                        src[s] = int(holder.split(".", 1)[1])
+                        break
+        return vstar, src
 
     async def peering_pass(self, max_active: int = None,
                            backfill: bool = False) -> int:
@@ -1774,6 +1822,12 @@ class PG:
         meta_candidates = set(self._dirty_meta)
         pre_heads: Dict[str, int] = {}
         need_backfill = backfill or restarted
+        # CRUSH epoch skew: the map changed since this engine last
+        # peered (osd add/rm/out/reweight remapped acting sets with NO
+        # log traffic) -- only a full scan finds the misplaced objects
+        placement_epoch = getattr(self.placement, "epoch", None)
+        if placement_epoch != self._placement_epoch:
+            need_backfill = True
         fetches = []
         for osd_name, info in infos.items():
             head, tail = info["head_seq"], info["tail_seq"]
@@ -1820,7 +1874,14 @@ class PG:
                 self.perf.inc("peering_delta_entries", len(rep["entries"]))
 
         if need_backfill:
-            return await self._peering_backfill(up_osds, max_active, pre_heads)
+            n = await self._peering_backfill(up_osds, max_active, pre_heads)
+            # the scan covered the re-placed objects for THIS epoch;
+            # advance only after it completes so a failed pass rescans.
+            # Deliberately the CAPTURED epoch, not the live one: remaps
+            # committed during the scan were not covered, and writing
+            # the stale value forces the next pass to rescan them
+            self._placement_epoch = placement_epoch  # cephlint: disable=async-rmw-across-await
+            return n
 
         if not candidates and not meta_candidates:
             self.perf.inc("peering_pass")
@@ -1960,6 +2021,9 @@ class PG:
             return False
 
         actions = []  # (oid, shard, target_osd, authoritative, rollback)
+        # relocation actions carry a 6th element: {position: holder_osd}
+        # read-source overrides for shards living on non-acting OSDs
+        reloc_actions = []
         unfinished: set = set()
         for oid in sorted(have):
             acting = self.acting_set(oid)
@@ -1993,12 +2057,30 @@ class PG:
                     counts_any[best] = counts_any.get(best, 0) + 1
             if placed_down:
                 unfinished.add(oid)  # probe again when the holder returns
-            if not counts:
+            if not counts and not counts_any:
                 continue
-            authoritative = self._peering_authoritative(
-                counts, unseen, counts_any,
-                all_visible=len(reporting) >= len(self.osds),
-            )
+            authoritative = None
+            if counts:
+                authoritative = self._peering_authoritative(
+                    counts, unseen, counts_any,
+                    all_visible=len(reporting) >= len(self.osds),
+                )
+            # remap relocation (the backfill data plane of elastic
+            # membership): the acting set cannot assemble the newest
+            # version, but every up holder anywhere -- including
+            # non-acting remap leftovers -- can.  With no acting holder
+            # unreachable (nothing newer can be hiding), recover toward
+            # that version reading from wherever the shards actually
+            # are.  Without this, an object whose CRUSH placement moved
+            # >= m+1 slots in one map change waits forever: the election
+            # keeps answering "wait for remap recovery" and no such
+            # mechanism would exist.
+            relocate_src: Optional[Dict[int, int]] = None
+            if authoritative is None and not placed_down:
+                vstar, src = self._remap_sources(shardmap, reporting)
+                if vstar is not None and len(src) >= self.k:
+                    authoritative = vstar
+                    relocate_src = src
             if authoritative is None:
                 self.perf.inc("peering_wait")
                 unfinished.add(oid)
@@ -2017,10 +2099,17 @@ class PG:
                     # data is safe, just in the wrong place -- the
                     # pg_stat_t misplaced (not degraded) distinction
                     self.pg_stats.misplaced.add(oid)
-                actions.append(
-                    (oid, s, acting[s], authoritative,
-                     cur is not None and cur > authoritative)
-                )
+                if relocate_src is not None:
+                    reloc_actions.append(
+                        (oid, s, acting[s], authoritative,
+                         cur is not None and cur > authoritative,
+                         relocate_src)
+                    )
+                else:
+                    actions.append(
+                        (oid, s, acting[s], authoritative,
+                         cur is not None and cur > authoritative)
+                    )
 
         meta_actions = []  # (oid, stale_targets)
         unfinished_meta: set = set()
@@ -2048,6 +2137,7 @@ class PG:
         # per-object note_recovered calls below and in osd/recovery.py
         # drain the count monotonically while a rebuild runs)
         action_oids = {a[0] for a in actions} | \
+            {a[0] for a in reloc_actions} | \
             {m[0] for m in meta_actions}
         self.pg_stats.note_recovering(action_oids)
         failed: set = set()
@@ -2058,10 +2148,11 @@ class PG:
             # prove consistent fall back to the per-object path inside
             failed |= await self._recovery().recover_actions(actions)
             actions = []
-        if actions or meta_actions:
+        if actions or reloc_actions or meta_actions:
             sem = asyncio.Semaphore(max_active)
 
-            async def recover_one(oid, s, target, authoritative, rb):
+            async def recover_one(oid, s, target, authoritative, rb,
+                                  sources=None):
                 async with sem:
                     try:
                         if rb and await self._try_log_rollback(
@@ -2076,7 +2167,7 @@ class PG:
                             self.pg_stats.note_recovered(oid)
                             return
                         await self.recover_shard(
-                            oid, s, target, rollback=rb
+                            oid, s, target, rollback=rb, sources=sources
                         )
                         self.pg_stats.note_recovered(oid)
                     except asyncio.CancelledError:
@@ -2108,6 +2199,7 @@ class PG:
 
             await asyncio.gather(
                 *(recover_one(*a) for a in actions),
+                *(recover_one(*a) for a in reloc_actions),
                 *(recover_meta(*m) for m in meta_actions),
             )
 
@@ -2130,7 +2222,7 @@ class PG:
             unfinished | unfinished_meta | failed,
         )
         self.perf.inc("peering_pass")
-        return len(actions) + len(meta_actions)
+        return len(actions) + len(reloc_actions) + len(meta_actions)
 
     async def _remove_shard_copy(self, oid: str, s: int,
                                  target: int) -> None:

@@ -56,6 +56,8 @@ class CrushPlacement:
         else:
             self.map, root = build_flat_map(n_osds)
             domain = 0
+        self._root = root
+        self._domain = domain
         self.ruleno = self.map.add_rule(
             erasure_rule(root, failure_domain_type=domain)
         )
@@ -124,3 +126,104 @@ class CrushPlacement:
     def reweight(self, osd_id: int, weight: float) -> None:
         self.weights[osd_id] = weight_fp(weight)
         self.epoch += 1
+
+    # -- elastic membership (online osd add/rm) ----------------------------
+
+    @property
+    def n_osds(self) -> int:
+        return len(self.weights)
+
+    def ensure_osd(self, osd_id: int, weight_fp16: int = 0) -> bool:
+        """Grow the placement so ``osd_id`` is a known device, initially
+        at the given 16.16 weight (default 0 = out, so a growth driven
+        by a map broadcast only moves data once the weight lands too).
+        Idempotent; returns True when the crush map actually grew.
+
+        Flat maps get the device appended to the root straw2 bucket;
+        hierarchies get a fresh single-osd host bucket (the smallest
+        failure-domain-preserving expansion).  straw2 makes either
+        growth minimal-movement by construction: only PGs whose draw
+        now favours the new item move.
+        """
+        if osd_id < len(self.weights):
+            return False
+        # fill any id gap with weight-0 devices NOT in the crush tree --
+        # do_rule treats ids past the weight vector as out, and a hole
+        # id never wins a straw2 draw at weight 0.
+        while len(self.weights) < osd_id:
+            self.weights.append(0)
+        self.weights.append(weight_fp16)
+        root = self.map.buckets[self._root]
+        if self._domain == 0:
+            root.add_item(osd_id, 0x10000)
+        else:
+            hb = self.map.new_bucket(
+                type=2, name=f"host-osd{osd_id}"
+            )
+            hb.add_item(osd_id, 0x10000)
+            root.add_item(hb.id, hb.weight)
+        self.map.note_device(osd_id)
+        self.epoch += 1
+        return True
+
+    def add_osd(self, osd_id: int, weight: float = 1.0) -> None:
+        """Grow the map AND bring the osd in, in one epoch step."""
+        if not self.ensure_osd(osd_id, weight_fp(weight)):
+            self.weights[osd_id] = weight_fp(weight)
+            self.epoch += 1
+
+    def remove_osd(self, osd_id: int) -> None:
+        """Contract: weight drops to 0 so CRUSH remaps away from the
+        device (straw2 touches only the PGs that mapped there).  The
+        crush bucket entry stays -- a departed id never wins a draw at
+        weight 0, and keeping the tree append-only keeps every other
+        PG's draw (and hence the movement set) untouched."""
+        if osd_id < len(self.weights):
+            self.weights[osd_id] = 0
+            self.epoch += 1
+
+    # -- movement accounting (expansion/contraction planning) --------------
+
+    def pg_actings(self) -> Dict[int, List[Optional[int]]]:
+        """Full pg -> acting snapshot at the current epoch (O(pg_num);
+        the expansion planner diffs two of these to find the minimal
+        movement set)."""
+        return {pg: list(self.acting_for_pg(pg)) for pg in range(self.pg_num)}
+
+
+def movement_plan(
+    before: Dict[int, List[Optional[int]]],
+    after: Dict[int, List[Optional[int]]],
+) -> List[Tuple[int, int, Optional[int], Optional[int]]]:
+    """Diff two pg->acting snapshots into the minimal movement set:
+    one (pg, position, src_osd, dst_osd) entry per acting-set slot
+    whose holder changed.  Unchanged positions never appear -- only
+    moved shards migrate."""
+    plan: List[Tuple[int, int, Optional[int], Optional[int]]] = []
+    for pg, old in before.items():
+        new = after.get(pg, old)
+        for pos, (src, dst) in enumerate(zip(old, new)):
+            if src != dst:
+                plan.append((pg, pos, src, dst))
+    return plan
+
+
+def theoretical_min_moved(
+    weights_before: Sequence[int],
+    weights_after: Sequence[int],
+    total_positions: int,
+) -> float:
+    """Lower bound on acting-set positions that MUST move for the
+    weight change: every osd whose capacity share grew must end up
+    holding its new share, so at least sum(max(0, share_after -
+    share_before)) of all positions migrate.  A perfectly minimal
+    placement (straw2's design goal) moves exactly this."""
+    tb = float(sum(weights_before)) or 1.0
+    ta = float(sum(weights_after)) or 1.0
+    gained = 0.0
+    n = max(len(weights_before), len(weights_after))
+    for i in range(n):
+        wb = weights_before[i] if i < len(weights_before) else 0
+        wa = weights_after[i] if i < len(weights_after) else 0
+        gained += max(0.0, wa / ta - wb / tb)
+    return gained * total_positions

@@ -592,12 +592,19 @@ class RecoveryCoalescer:
         ok_oids: set = set()
         bad_oids: set = set()
         nbytes = 0
+        backfill_bytes = 0
+        misplaced = backend.pg_stats.misplaced
         for oid, (target, sub), ok in zip(push_oids, pushes, results):
             if ok:
                 ok_oids.add(oid)
                 for top in sub.transaction.ops:
                     if top.op == "write":
                         nbytes += len(top.data)
+                        if oid in misplaced:
+                            # migration (not rebuild) traffic: the copy
+                            # exists elsewhere, it's just mis-placed --
+                            # feeds the data-moved-ratio elasticity gate
+                            backfill_bytes += len(top.data)
             else:
                 bad_oids.add(oid)
         ok_oids -= bad_oids
@@ -608,6 +615,8 @@ class RecoveryCoalescer:
             backend.perf.inc("recover", len(ok_oids))
         if nbytes:
             backend.perf.inc("recovery_bytes", nbytes)
+        if backfill_bytes:
+            backend.perf.inc("recovery_backfill_bytes", backfill_bytes)
         saved = sum(plans[o].get("bytes_saved", 0)
                     for o in ok_oids if o in plans)
         if saved > 0:

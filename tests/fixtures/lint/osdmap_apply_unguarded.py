@@ -1,0 +1,46 @@
+"""Fixture: osdmap-apply-unguarded."""
+
+from ceph_tpu.mon.osdmap import apply_map_view
+
+
+class _Placement:
+    def __init__(self):
+        self.weights = [0x10000] * 4
+        self.epoch = 0
+
+
+def raw_push(m, placement):
+    # the pre-elastic bug verbatim: no epoch gate, IndexError on the
+    # first osd add, removed ids never zero
+    for osd_id, w in m["weights"].items():  # LINT: osdmap-apply-unguarded
+        placement.weights[int(osd_id)] = w
+
+
+async def raw_push_async(msg, placement):
+    for osd_id, w in msg.get("weights", {}).items():  # LINT: osdmap-apply-unguarded
+        placement.weights[int(osd_id)] = w
+
+
+def guarded_push(m, state, placement):
+    # routed through the blessed applicator: a bookkeeping walk over
+    # the same table in the same function is fine
+    if not apply_map_view(m, state, None, placements=[placement]):
+        return False
+    for osd_id, w in m["weights"].items():
+        if not w:
+            continue
+    return True
+
+
+def bookkeeping_only(m):
+    # reads the table without pushing weights: out of scope
+    total = 0
+    for _osd_id, w in m["weights"].items():
+        total += w
+    return total
+
+
+def unrelated_loop(placement, updates):
+    # not an osdmap broadcast table: out of scope
+    for osd_id, w in updates:
+        placement.weights[osd_id] = w

@@ -54,6 +54,9 @@ FIXTURES = {
     "jax_regen_repair_dispatch.py":
         "ceph_tpu/plugins/_fixture_regen_dispatch.py",
     "ceph_config_undeclared.py": None,
+    # PR-23 elastic membership: osdmap broadcasts must apply through
+    # apply_map_view (epoch gate + crush growth + removed-id zeroing)
+    "osdmap_apply_unguarded.py": None,
     # PR-18 wire-fed telemetry: every counter must reach the report
     # schema / exposition (or carry a justified disable)
     "perf_counter_unexported.py": "ceph_tpu/osd/_fixture_perf_export.py",

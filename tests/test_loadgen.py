@@ -144,6 +144,27 @@ def test_scenario_chaos_thrash_rebuild_exactly_once():
     assert res.qos_counters.get("qos_recovery_ops", 0) > 0
 
 
+def test_scenario_chaos_membership_churn_exactly_once():
+    """Elastic membership under scenario load (docs/elasticity.md): a
+    victim OSD is weighted out of CRUSH mid-run while its daemon keeps
+    serving, data drains off through the peering tick's epoch-skew
+    backfill, then it's weighted back in -- with the exactly-once
+    audit exact across both remaps."""
+    scn = Scenario(
+        name="t1-churn", duration_s=4.0,
+        groups=(
+            ClientGroup(count=8, profile="rgw"),
+            ClientGroup(count=6, profile="txn"),
+        ),
+        chaos=("churn",),
+        seed=31,
+    )
+    res = asyncio.run(run_scenario(scn, n_osds=6))
+    assert res.churn_events >= 2, "churn never flipped a weight"
+    assert res.ops > 0
+    assert res.cas_clients > 0 and res.cas_exact, res.cas_mismatches
+
+
 @pytest.mark.slow
 def test_qos_bench_overload_smoke_reservation_floor():
     """The qos-path overload sub-stage at smoke shape: calibration,

@@ -25,6 +25,10 @@
 #   --profile-smoke runs ONLY the wire-tax profiler smoke
 #   (ec_benchmark --workload wire-tax --smoke: every attribution gate
 #   armed at CI shape) and exits with its status.
+#   --elastic-smoke runs ONLY the elastic-membership smoke
+#   (ec_benchmark --workload elastic-path --smoke: online +2-OSD
+#   expansion under load + the three chaos arms, every gate armed at
+#   CI shape) and exits with its status.
 #   --ring-smoke runs the shared-memory frame ring smoke (byte
 #   fidelity through wraparound, torn-record -> RingTear, the stream
 #   adapters end to end) plus the ring-framing mutant fuzz (header/
@@ -84,6 +88,18 @@ if [ "${1:-}" = "--ring-smoke" ]; then
     exit 0
 fi
 
+if [ "${1:-}" = "--elastic-smoke" ]; then
+    # elastic-path smoke: +2-OSD online expansion under client load,
+    # then the three chaos arms (target kill mid-migration, live-
+    # primary rm, add/rm flap) -- the movement-ratio, monotone-drain,
+    # bit-exactness and exactly-once audit gates all stay armed at
+    # smoke shape; any violation exits nonzero
+    JAX_PLATFORMS=cpu python tools/ec_benchmark.py \
+        --workload elastic-path --smoke > /dev/null
+    echo "cephlint: elastic-path membership smoke passed" >&2
+    exit 0
+fi
+
 if [ "${1:-}" = "--profile-smoke" ]; then
     # wire-tax profiler smoke (round 19): the saturated-path cost
     # decomposition, profiler overhead and off-mode zero-allocation
@@ -138,6 +154,9 @@ if [ "${CEPHLINT_NO_SMOKE:-}" != "1" ]; then
     JAX_PLATFORMS=cpu python tools/ec_benchmark.py \
         --workload repair-path --smoke > /dev/null
     echo "cephlint: regenerating repair-path smoke passed" >&2
+    # elastic-path smoke: online +2-OSD expansion + chaos arms (see
+    # the --elastic-smoke arm above for the gate list)
+    sh tools/ci_lint.sh --elastic-smoke
     # multichip dryrun on simulated devices: jax_num_cpu_devices where
     # the jax supports it, the XLA_FLAGS device-count override otherwise
     JAX_PLATFORMS=cpu \

@@ -39,11 +39,13 @@ def parse_args(argv):
     p.add_argument("-w", "--workload", default="encode",
                    choices=["encode", "decode", "storage-path",
                             "cluster-path", "tier-path",
-                            "recovery-path", "repair-path", "mesh-path",
+                            "recovery-path", "repair-path", "elastic-path",
+                            "mesh-path",
                             "trace-path",
                             "qos-path", "telemetry-path", "wire-tax"])
     p.add_argument("--smoke", action="store_true",
-                   help="qos-path/telemetry-path/repair-path: the "
+                   help="qos-path/telemetry-path/repair-path/elastic-path: "
+                        "the "
                         "fast CI shape (shrunk client counts, object "
                         "counts and durations, loose overhead limits) "
                         "instead of the full acceptance run")
@@ -296,6 +298,40 @@ def main(argv=None) -> int:
             f"{result['bytes_saved']} repair bytes saved, "
             f"{result['fractional']['counters']['regen_helpers_served']}"
             " helper symbols served",
+            file=sys.stderr,
+        )
+        return 0
+
+    if args.workload == "elastic-path":
+        # Elastic membership stage: +2-OSD online expansion under
+        # client load (movement <= 1.25x the theoretical-minimum
+        # bytes, misplaced peak -> monotone drain -> HEALTH_OK,
+        # bounded client p99), then three chaos arms on the SAME
+        # cluster: kill the backfill target mid-migration, rm a live
+        # primary under load, add-then-immediately-rm flapping.
+        # Bit-exact reads and an exactly-once write audit gate every
+        # stage before any number is printed.  Prints one JSON line
+        # (the shape bench.py records as elastic_path_*); --smoke
+        # runs the tiny CI shape.
+        import json
+
+        from ceph_tpu.osd.elastic_bench import run_elastic_path_bench
+
+        result = run_elastic_path_bench(smoke=args.smoke)
+        print(json.dumps(result))
+        print(
+            f"elastic-path {result['n_osds']}osd "
+            f"{result['n_objects']}x{result['obj_bytes']}B "
+            f"{result['n_clients']}cl: moved ratio "
+            f"{result['data_moved_ratio']} (gate 1.25), "
+            f"time-to-clean {result['time_to_clean_s']}s, "
+            f"client p99 {result['client_p99_during_expansion_ms']}ms, "
+            f"misplaced peak {result['misplaced_peak']} "
+            f"({result['misplaced_upticks']} upticks), chaos "
+            f"kill/rm/flap rounds "
+            f"{result['chaos']['target_kill']['rounds']}/"
+            f"{result['chaos']['primary_rm']['rounds']}/"
+            f"{result['chaos']['flap']['rounds']}",
             file=sys.stderr,
         )
         return 0
